@@ -1,0 +1,72 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding logic
+(burn-in workload, topology-aware collectives) is exercised without TPU
+hardware — the CI posture the reference achieves with its fake client +
+envtest (SURVEY.md §4).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("UNIT_TEST", "true")
+
+import pytest  # noqa: E402
+
+from tpu_operator.kube import FakeClient  # noqa: E402
+
+
+@pytest.fixture()
+def fake_client():
+    return FakeClient()
+
+
+def make_tpu_node(
+    name: str,
+    accelerator: str = "tpu-v5-lite-podslice",
+    topology: str = "2x4",
+    extra_labels: dict | None = None,
+) -> dict:
+    """A GKE-style TPU node (reference test nodes carry minimal NFD labels,
+    controllers/object_controls_test.go:60-65)."""
+    labels = {
+        "kubernetes.io/hostname": name,
+        "cloud.google.com/gke-tpu-accelerator": accelerator,
+        "cloud.google.com/gke-tpu-topology": topology,
+        "feature.node.kubernetes.io/kernel-version.full": "6.1.0-gke",
+        "feature.node.kubernetes.io/system-os_release.ID": "cos",
+        "feature.node.kubernetes.io/system-os_release.VERSION_ID": "117",
+    }
+    labels.update(extra_labels or {})
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": labels, "annotations": {}},
+        "status": {
+            "capacity": {},
+            "allocatable": {},
+            "nodeInfo": {
+                "containerRuntimeVersion": "containerd://1.7.0",
+                "kernelVersion": "6.1.0-gke",
+                "osImage": "Container-Optimized OS",
+            },
+        },
+    }
+
+
+def make_cpu_node(name: str) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
+        "status": {
+            "capacity": {},
+            "allocatable": {},
+            "nodeInfo": {"containerRuntimeVersion": "containerd://1.7.0"},
+        },
+    }

@@ -1,0 +1,92 @@
+"""CRD type tests (reference pattern: sample-CR round-trip in
+``controllers/object_controls_test.go:162-175`` and image-path tests)."""
+
+import os
+
+from tpu_operator.api.v1.clusterpolicy_types import (
+    ClusterPolicySpec,
+    LibtpuSpec,
+    State,
+    UpgradePolicySpec,
+    clusterpolicy_from_obj,
+    clusterpolicy_to_obj,
+)
+
+
+SAMPLE = {
+    "apiVersion": "tpu.k8s.io/v1",
+    "kind": "ClusterPolicy",
+    "metadata": {"name": "cluster-policy"},
+    "spec": {
+        "operator": {"defaultRuntime": "containerd", "runtimeClass": "tpu"},
+        "daemonsets": {"tolerations": [{"key": "google.com/tpu", "operator": "Exists", "effect": "NoSchedule"}]},
+        "libtpu": {
+            "enabled": True,
+            "repository": "gcr.io/tpu-operator",
+            "image": "libtpu-installer",
+            "version": "1.2.3",
+            "generationConfigs": {"v5p": "1.2.3-v5p"},
+            "upgradePolicy": {"autoUpgrade": True, "maxUnavailable": "25%"},
+        },
+        "devicePlugin": {"enabled": True, "repository": "gcr.io/tpu-operator", "image": "tpu-device-plugin", "version": "0.9"},
+        "validator": {"repository": "gcr.io/tpu-operator", "image": "tpu-operator-validator", "version": "0.9"},
+        "sandboxWorkloads": {"enabled": False},
+    },
+}
+
+
+def test_round_trip():
+    cp = clusterpolicy_from_obj(SAMPLE)
+    assert cp.name == "cluster-policy"
+    assert cp.spec.libtpu.repository == "gcr.io/tpu-operator"
+    assert cp.spec.libtpu.generation_configs == {"v5p": "1.2.3-v5p"}
+    assert cp.spec.libtpu.upgrade_policy.is_auto_upgrade_enabled()
+    obj = clusterpolicy_to_obj(cp)
+    assert obj["spec"]["libtpu"]["generationConfigs"] == {"v5p": "1.2.3-v5p"}
+    assert obj["spec"]["libtpu"]["upgradePolicy"]["autoUpgrade"] is True
+    # round-trip again is stable
+    assert clusterpolicy_to_obj(clusterpolicy_from_obj(obj)) == obj
+
+
+def test_image_path_resolution():
+    # reference api/v1/clusterpolicy_types.go:1552-1641
+    spec = LibtpuSpec(repository="gcr.io/x", image="libtpu-installer", version="9.9")
+    assert spec.image_path() == "gcr.io/x/libtpu-installer:9.9"
+    # digest form
+    spec.version = "sha256:" + "a" * 64
+    assert spec.image_path() == "gcr.io/x/libtpu-installer@sha256:" + "a" * 64
+    # env fallback
+    spec2 = LibtpuSpec()
+    os.environ["LIBTPU_INSTALLER_IMAGE"] = "gcr.io/env/libtpu:7"
+    try:
+        assert spec2.image_path() == "gcr.io/env/libtpu:7"
+    finally:
+        del os.environ["LIBTPU_INSTALLER_IMAGE"]
+
+
+def test_is_enabled_defaults():
+    # nil pointer = enabled, like the reference IsEnabled helpers (:1659-1832)
+    spec = ClusterPolicySpec()
+    assert spec.libtpu.is_enabled()
+    assert spec.device_plugin.is_enabled()
+    # sandbox gates default OFF
+    assert not spec.sandbox_workloads.is_enabled()
+    assert not spec.psp.is_enabled()
+    # CDI defaults ON for TPU (unlike reference where it defaults off)
+    assert spec.cdi.is_enabled() and spec.cdi.is_default()
+    spec.libtpu.enabled = False
+    assert not spec.libtpu.is_enabled()
+
+
+def test_state_enum():
+    assert State.READY == "ready"
+    assert State.NOT_READY == "notReady"
+    assert State.IGNORED == "ignored"
+    assert State.DISABLED == "disabled"
+
+
+def test_upgrade_policy_defaults():
+    up = UpgradePolicySpec.from_dict({})
+    assert not up.is_auto_upgrade_enabled()
+    assert up.max_parallel_upgrades == 1
+    assert up.max_unavailable == "25%"

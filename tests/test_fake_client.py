@@ -1,0 +1,95 @@
+"""FakeClient behaviour the controllers depend on."""
+
+import pytest
+
+from tpu_operator.kube import ConflictError, FakeClient, NotFoundError
+
+
+def mk(kind, name, ns="", labels=None, api="v1"):
+    meta = {"name": name}
+    if ns:
+        meta["namespace"] = ns
+    if labels:
+        meta["labels"] = labels
+    return {"apiVersion": api, "kind": kind, "metadata": meta}
+
+
+def test_crud_and_rv():
+    c = FakeClient()
+    c.create(mk("ConfigMap", "a", "ns1"))
+    got = c.get("v1", "ConfigMap", "a", "ns1")
+    assert got["metadata"]["resourceVersion"] == "1"
+    got["data"] = {"k": "v"}
+    updated = c.update(got)
+    assert updated["metadata"]["resourceVersion"] == "2"
+    with pytest.raises(ConflictError):
+        c.update(got)  # stale rv
+    c.delete("v1", "ConfigMap", "a", "ns1")
+    with pytest.raises(NotFoundError):
+        c.get("v1", "ConfigMap", "a", "ns1")
+
+
+def test_create_conflict():
+    c = FakeClient()
+    c.create(mk("ConfigMap", "a", "ns1"))
+    with pytest.raises(ConflictError):
+        c.create(mk("ConfigMap", "a", "ns1"))
+
+
+def test_label_selector_globs():
+    c = FakeClient()
+    c.create(mk("Pod", "p1", "ns", {"app": "tpu-libtpu-daemonset"}))
+    c.create(mk("Pod", "p2", "ns", {"app": "other"}))
+    assert len(c.list("v1", "Pod", "ns", label_selector={"app": "tpu-*"})) == 1
+    assert len(c.list("v1", "Pod", "ns", label_selector={"app": None})) == 2
+    assert len(c.list("v1", "Pod", label_selector={"app": "other"})) == 1
+
+
+def test_status_subresource_preserved_on_update():
+    c = FakeClient()
+    obj = mk("Node", "n1")
+    obj["status"] = {"capacity": {"google.com/tpu": "4"}}
+    c.create(obj)
+    node = c.get("v1", "Node", "n1")
+    del node["status"]
+    node["metadata"]["labels"] = {"x": "y"}
+    updated = c.update(node)
+    assert updated["status"]["capacity"]["google.com/tpu"] == "4"
+
+
+def test_update_status():
+    c = FakeClient()
+    c.create(mk("ClusterPolicy", "cp", api="tpu.k8s.io/v1"))
+    obj = c.get("tpu.k8s.io/v1", "ClusterPolicy", "cp")
+    obj["status"] = {"state": "ready"}
+    c.update_status(obj)
+    assert c.get("tpu.k8s.io/v1", "ClusterPolicy", "cp")["status"]["state"] == "ready"
+
+
+def test_watch_events():
+    c = FakeClient()
+    events = []
+    c.add_watcher(lambda e, o: events.append((e, o["metadata"]["name"])))
+    c.create(mk("ConfigMap", "a", "ns"))
+    obj = c.get("v1", "ConfigMap", "a", "ns")
+    c.update(obj)
+    c.delete("v1", "ConfigMap", "a", "ns")
+    assert events == [("ADDED", "a"), ("MODIFIED", "a"), ("DELETED", "a")]
+
+
+def test_apply_create_or_update():
+    c = FakeClient()
+    c.apply(mk("ConfigMap", "a", "ns"))
+    obj = mk("ConfigMap", "a", "ns")
+    obj["data"] = {"x": "1"}
+    c.apply(obj)
+    assert c.get("v1", "ConfigMap", "a", "ns")["data"] == {"x": "1"}
+
+
+def test_field_selector():
+    c = FakeClient()
+    p = mk("Pod", "p1", "ns")
+    p["spec"] = {"nodeName": "node-a"}
+    c.create(p)
+    assert len(c.list("v1", "Pod", "ns", field_selector={"spec.nodeName": "node-a"})) == 1
+    assert len(c.list("v1", "Pod", "ns", field_selector={"spec.nodeName": "node-b"})) == 0

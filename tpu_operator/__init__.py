@@ -1,0 +1,18 @@
+"""tpu-operator: a TPU-native Kubernetes operator.
+
+Provisions the full TPU software stack on cluster nodes through a single
+cluster-scoped ``ClusterPolicy`` CRD reconciled by an ordered state machine:
+libtpu installation, TPU runtime/CDI wiring, a device plugin advertising
+``google.com/tpu``, TPU feature discovery (chip/ICI topology labels), a slice
+partition manager, a libtpu metrics exporter, node validation whose
+end-to-end proof is a JAX/XLA matmul, and a cordon/drain rolling upgrade
+engine.
+
+Architecture mirrors the NVIDIA GPU Operator (reference: ``main.go``,
+``controllers/``, ``validator/``, ``assets/``) but is built TPU-native:
+userspace libtpu instead of kernel driver builds, CDI instead of runtime
+config rewriting, JAX instead of CUDA workloads, and ICI topology instead of
+MOFED/GPUDirect fabric enablement.
+"""
+
+__version__ = "0.1.0"

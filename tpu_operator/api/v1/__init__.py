@@ -1,0 +1,6 @@
+from tpu_operator.api.v1.clusterpolicy_types import (  # noqa: F401
+    ClusterPolicy,
+    ClusterPolicySpec,
+    ClusterPolicyStatus,
+    State,
+)

@@ -1,0 +1,746 @@
+"""ClusterPolicy CRD types for the TPU operator.
+
+TPU-native analogue of the reference CRD (``api/v1/clusterpolicy_types.go``):
+a cluster-scoped ``ClusterPolicy`` whose spec carries one sub-spec per operand
+(reference ``api/v1/clusterpolicy_types.go:36-84``), per-spec ``is_enabled``
+semantics via optional booleans (``:1659-1832``), image path resolution with
+environment-variable fallback and sha256 digest handling (``:1552-1641``),
+and a ``State`` enum ready/notReady/ignored/disabled (``:1496-1507``).
+
+The operand mapping is:
+
+====================  =========================================
+reference sub-spec     TPU sub-spec
+====================  =========================================
+Driver                libtpu (userspace libtpu installer)
+Toolkit               runtime (CDI / device wiring)
+DevicePlugin          devicePlugin (``google.com/tpu``)
+DCGM                  metricsd (standalone metrics daemon)
+DCGMExporter          metricsExporter (libtpu Prometheus exporter)
+GPUFeatureDiscovery   tfd (TPU feature discovery: chip/ICI labels)
+MIG / MIGManager      slice / sliceManager (subslice partitioning)
+GDS                   directStorage (GCS DirectPath / fuse)
+VGPUManager           vmManager (TPU-VM passthrough host manager)
+VGPUDeviceManager     vmDeviceManager
+====================  =========================================
+
+Objects are plain dataclasses; the wire format is camelCase dicts produced by
+``to_dict``/consumed by ``from_dict`` so CRs round-trip losslessly through
+YAML/JSON.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import typing
+from functools import lru_cache as _functools_lru_cache
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# Serialization machinery
+# ---------------------------------------------------------------------------
+
+_SNAKE_RE = re.compile(r"_([a-z0-9])")
+
+
+def _snake_to_camel(name: str) -> str:
+    return _SNAKE_RE.sub(lambda m: m.group(1).upper(), name)
+
+
+def _field_key(f: dataclasses.Field) -> str:
+    return f.metadata.get("json", _snake_to_camel(f.name))
+
+
+def _unwrap_optional(tp):
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def _coerce(tp, value):
+    """Coerce a decoded JSON value into the annotated field type."""
+    if value is None:
+        return None
+    tp = _unwrap_optional(tp)
+    origin = typing.get_origin(tp)
+    if origin in (list, List):
+        (item_tp,) = typing.get_args(tp) or (Any,)
+        return [_coerce(item_tp, v) for v in value]
+    if origin in (dict, Dict):
+        return dict(value)
+    if dataclasses.is_dataclass(tp) and isinstance(value, dict):
+        return _from_dict(tp, value)
+    return value
+
+
+@_functools_lru_cache(maxsize=None)
+def _class_hints(cls):
+    return typing.get_type_hints(cls)
+
+
+def _from_dict(cls, data: Dict[str, Any]):
+    kwargs = {}
+    hints = _class_hints(cls)
+    for f in dataclasses.fields(cls):
+        key = _field_key(f)
+        if key in data:
+            kwargs[f.name] = _coerce(hints[f.name], data[key])
+    return cls(**kwargs)
+
+
+def _to_jsonable(value):
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        out = {}
+        for f in dataclasses.fields(value):
+            v = getattr(value, f.name)
+            if v is None:
+                continue
+            if v == [] or v == {}:
+                continue
+            out[_field_key(f)] = _to_jsonable(v)
+        return out
+    if isinstance(value, list):
+        return [_to_jsonable(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _to_jsonable(v) for k, v in value.items()}
+    return value
+
+
+class SpecBase:
+    """Mixin providing dict round-tripping for all spec dataclasses."""
+
+    @classmethod
+    def from_dict(cls, data: Optional[Dict[str, Any]]):
+        return _from_dict(cls, data or {})
+
+    def to_dict(self) -> Dict[str, Any]:
+        return _to_jsonable(self)
+
+
+# ---------------------------------------------------------------------------
+# State enum (reference api/v1/clusterpolicy_types.go:1496-1507)
+# ---------------------------------------------------------------------------
+
+
+class State:
+    IGNORED = "ignored"
+    READY = "ready"
+    NOT_READY = "notReady"
+    DISABLED = "disabled"
+
+
+# ---------------------------------------------------------------------------
+# Image spec helpers (reference api/v1/clusterpolicy_types.go:1552-1641)
+# ---------------------------------------------------------------------------
+
+
+class _ImageSpec(SpecBase):
+    """Shared image-resolution behaviour for operand specs.
+
+    ``image_path`` resolves ``repository + image + version`` with a
+    per-component environment fallback and sha256 digest support, mirroring
+    the reference's ``ImagePath``/``imagePath`` helpers
+    (``api/v1/clusterpolicy_types.go:1552-1641``).
+    """
+
+    ENV_VAR: str = ""
+
+    def image_path(self) -> str:
+        repository = getattr(self, "repository", "") or ""
+        image = getattr(self, "image", "") or ""
+        version = getattr(self, "version", "") or ""
+        if image and version:
+            prefix = f"{repository}/{image}" if repository else image
+            if version.startswith("sha256:"):
+                return f"{prefix}@{version}"
+            return f"{prefix}:{version}"
+        if self.ENV_VAR:
+            env = os.environ.get(self.ENV_VAR, "")
+            if env:
+                return env
+        if image and not version:
+            prefix = f"{repository}/{image}" if repository else image
+            return prefix
+        return ""
+
+    def pull_policy(self) -> str:
+        return image_pull_policy(getattr(self, "image_pull_policy", None))
+
+    def is_enabled(self) -> bool:
+        enabled = getattr(self, "enabled", None)
+        if enabled is None:
+            return True
+        return bool(enabled)
+
+
+def image_pull_policy(policy: Optional[str]) -> str:
+    """Normalize an imagePullPolicy value (reference ``ImagePullPolicy`` helper)."""
+    return policy if policy in ("Always", "Never", "IfNotPresent") else "IfNotPresent"
+
+
+# ---------------------------------------------------------------------------
+# Common nested specs
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class EnvVar(SpecBase):
+    name: str = ""
+    value: str = ""
+
+
+@dataclass
+class ResourceRequirements(SpecBase):
+    limits: Dict[str, str] = field(default_factory=dict)
+    requests: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class RollingUpdateSpec(SpecBase):
+    max_unavailable: str = "1"
+
+
+@dataclass
+class InitContainerSpec(_ImageSpec):
+    repository: str = ""
+    image: str = "busybox"  # minimal init image used for host-prep chores
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+
+    ENV_VAR = "TPU_OPERATOR_INIT_CONTAINER_IMAGE"
+
+
+# ---------------------------------------------------------------------------
+# Operator / Daemonsets
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OperatorSpec(SpecBase):
+    """Operator-level knobs (reference ``OperatorSpec``)."""
+
+    default_runtime: str = "containerd"
+    runtime_class: str = "tpu"
+    use_ocp_driver_toolkit: Optional[bool] = None
+    init_container: InitContainerSpec = field(default_factory=InitContainerSpec)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class DaemonsetsSpec(SpecBase):
+    """Settings applied to every operand DaemonSet (reference ``DaemonsetsSpec``)."""
+
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Dict[str, Any]] = field(default_factory=list)
+    priority_class_name: str = "system-node-critical"
+    update_strategy: str = "RollingUpdate"
+    rolling_update: Optional[RollingUpdateSpec] = None
+
+
+# ---------------------------------------------------------------------------
+# Upgrade policy (reference DriverUpgradePolicySpec via k8s-operator-libs)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PodDeletionSpec(SpecBase):
+    force: Optional[bool] = None
+    timeout_seconds: int = 300
+    delete_emptydir_data: Optional[bool] = None
+
+
+@dataclass
+class DrainSpec(SpecBase):
+    enable: Optional[bool] = None
+    force: Optional[bool] = None
+    pod_selector: str = ""
+    timeout_seconds: int = 300
+    delete_emptydir_data: Optional[bool] = None
+
+
+@dataclass
+class UpgradePolicySpec(SpecBase):
+    """Safe rolling libtpu upgrades (reference ``v1alpha1.DriverUpgradePolicySpec``,
+    vendored ``k8s-operator-libs/api/upgrade/v1alpha1``)."""
+
+    auto_upgrade: Optional[bool] = None
+    max_parallel_upgrades: int = 1
+    max_unavailable: str = "25%"
+    wait_for_completion: Optional[Dict[str, Any]] = None
+    pod_deletion: Optional[PodDeletionSpec] = None
+    drain: Optional[DrainSpec] = None
+
+    def is_auto_upgrade_enabled(self) -> bool:
+        return bool(self.auto_upgrade)
+
+
+# ---------------------------------------------------------------------------
+# Operand specs
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LibtpuSpec(_ImageSpec):
+    """libtpu installer — the reference's ``DriverSpec`` slot
+    (``api/v1/clusterpolicy_types.go``; DS at ``assets/state-driver/0500_daemonset.yaml``).
+
+    TPU-native: there is no kernel module to build; the operand installs a
+    versioned ``libtpu.so`` onto the host and probes ``/dev/accel*``. The
+    per-kernel precompiled fan-out of the reference becomes per-TPU-generation
+    image fan-out (v4/v5e/v5p/v6e) via ``generation_configs``.
+    """
+
+    enabled: Optional[bool] = None
+    repository: str = ""
+    image: str = "libtpu-installer"
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    resources: Optional[ResourceRequirements] = None
+    install_dir: str = "/home/kubernetes/lib/tpu"
+    # map of TPU generation (v4, v5e, v5p, v6e) -> image version override;
+    # drives one DaemonSet per generation (reference per-kernel fan-out,
+    # controllers/object_controls.go:3405-3441).
+    generation_configs: Dict[str, str] = field(default_factory=dict)
+    upgrade_policy: Optional[UpgradePolicySpec] = None
+    rolling_update: Optional[RollingUpdateSpec] = None
+    startup_probe: Optional[Dict[str, Any]] = None
+    liveness_probe: Optional[Dict[str, Any]] = None
+    readiness_probe: Optional[Dict[str, Any]] = None
+
+    ENV_VAR = "LIBTPU_INSTALLER_IMAGE"
+
+
+@dataclass
+class RuntimeSpec(_ImageSpec):
+    """TPU runtime/device wiring — the reference's ``ToolkitSpec`` slot.
+
+    Instead of rewriting containerd/docker/crio configs
+    (``controllers/object_controls.go:1052-1184``), the TPU path generates a
+    CDI spec exposing ``/dev/accel*``, ``/dev/vfio`` and ``libtpu.so`` and
+    (optionally) installs a minimal containerd runtime hook for non-CDI
+    clusters.
+    """
+
+    enabled: Optional[bool] = None
+    repository: str = ""
+    image: str = "tpu-runtime"
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    install_dir: str = "/usr/local/tpu"
+
+    ENV_VAR = "TPU_RUNTIME_IMAGE"
+
+
+@dataclass
+class DevicePluginConfig(SpecBase):
+    """Custom plugin config via ConfigMap (reference ``DevicePluginConfig``)."""
+
+    name: str = ""
+    default: str = ""
+
+
+@dataclass
+class DevicePluginSpec(_ImageSpec):
+    """TPU device plugin advertising ``google.com/tpu`` with topology-aware
+    allocation — the reference's ``DevicePluginSpec`` slot."""
+
+    enabled: Optional[bool] = None
+    repository: str = ""
+    image: str = "tpu-device-plugin"
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    resources: Optional[ResourceRequirements] = None
+    config: Optional[DevicePluginConfig] = None
+
+    ENV_VAR = "TPU_DEVICE_PLUGIN_IMAGE"
+
+
+@dataclass
+class MetricsdSpec(_ImageSpec):
+    """Standalone TPU metrics daemon — the reference's ``DCGMSpec`` slot
+    (standalone hostengine, ``controllers/object_controls.go:95-98,1441-1495``)."""
+
+    enabled: Optional[bool] = None
+    repository: str = ""
+    image: str = "tpu-metricsd"
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+    host_port: int = 5555
+    env: List[EnvVar] = field(default_factory=list)
+
+    ENV_VAR = "TPU_METRICSD_IMAGE"
+
+
+@dataclass
+class MetricsConfig(SpecBase):
+    name: str = ""
+
+
+@dataclass
+class MetricsExporterSpec(_ImageSpec):
+    """libtpu Prometheus metrics exporter — the reference's
+    ``DCGMExporterSpec`` slot (``controllers/object_controls.go:1302-1439``)."""
+
+    enabled: Optional[bool] = None
+    repository: str = ""
+    image: str = "tpu-metrics-exporter"
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    resources: Optional[ResourceRequirements] = None
+    metrics_config: Optional[MetricsConfig] = None
+    service_monitor: Optional[Dict[str, Any]] = None
+
+    ENV_VAR = "TPU_METRICS_EXPORTER_IMAGE"
+
+
+@dataclass
+class NodeStatusExporterSpec(_ImageSpec):
+    """Validator image in metrics mode (reference ``NodeStatusExporterSpec``,
+    ``assets/state-node-status-exporter/0700_daemonset.yaml:31-37``)."""
+
+    enabled: Optional[bool] = None
+    repository: str = ""
+    image: str = "tpu-operator-validator"
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+
+    ENV_VAR = "TPU_VALIDATOR_IMAGE"
+
+
+@dataclass
+class TFDSpec(_ImageSpec):
+    """TPU feature discovery — the reference's ``GPUFeatureDiscoverySpec``
+    slot. Emits chip type/count, ICI topology and slice labels."""
+
+    enabled: Optional[bool] = None
+    repository: str = ""
+    image: str = "tpu-feature-discovery"
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    resources: Optional[ResourceRequirements] = None
+
+    ENV_VAR = "TPU_FEATURE_DISCOVERY_IMAGE"
+
+
+@dataclass
+class SliceSpec(SpecBase):
+    """Subslice exposure strategy — the reference's ``MIGSpec``.
+
+    ``strategy`` is ``none`` | ``single`` | ``mixed``: whether partitioned
+    subslices are advertised as uniform ``google.com/tpu`` or as
+    ``google.com/tpu-<shape>`` resources.
+    """
+
+    strategy: str = "single"
+
+
+@dataclass
+class SliceManagerSpec(_ImageSpec):
+    """TPU slice/partition manager — the reference's ``MIGManagerSpec`` slot
+    (``assets/state-mig-manager/``, named layouts ConfigMap, node-label FSM)."""
+
+    enabled: Optional[bool] = None
+    repository: str = ""
+    image: str = "tpu-slice-manager"
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    config: Optional[DevicePluginConfig] = None
+    chip_clients_config: Optional[MetricsConfig] = None
+
+    ENV_VAR = "TPU_SLICE_MANAGER_IMAGE"
+
+
+@dataclass
+class ValidatorSpec(_ImageSpec):
+    """Validation harness (reference ``ValidatorSpec``; binary in
+    ``validator/main.go``). Components: libtpu, runtime, plugin, jax, slice,
+    nodestatus (metrics mode)."""
+
+    enabled: Optional[bool] = None
+    repository: str = ""
+    image: str = "tpu-operator-validator"
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    resources: Optional[ResourceRequirements] = None
+    plugin: Optional[Dict[str, Any]] = None
+    jax: Optional[Dict[str, Any]] = None
+    libtpu: Optional[Dict[str, Any]] = None
+    runtime: Optional[Dict[str, Any]] = None
+
+    ENV_VAR = "TPU_VALIDATOR_IMAGE"
+
+
+@dataclass
+class DirectStorageSpec(_ImageSpec):
+    """High-bandwidth storage path — the reference's ``GPUDirectStorageSpec``
+    (GDS / nvidia-fs) slot. On TPU this wires GCS DirectPath / gcsfuse for
+    data loading; disabled by default."""
+
+    enabled: Optional[bool] = None
+    repository: str = ""
+    image: str = "tpu-direct-storage"
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+
+    ENV_VAR = "TPU_DIRECT_STORAGE_IMAGE"
+
+    def is_enabled(self) -> bool:
+        # storage fast-path defaults OFF, like the reference's GDS
+        return bool(self.enabled)
+
+
+@dataclass
+class SandboxWorkloadsSpec(SpecBase):
+    """Sandbox (VM-passthrough) workload gating — reference
+    ``SandboxWorkloadsSpec``. ``default_workload``: container | vm-passthrough."""
+
+    enabled: Optional[bool] = None
+    default_workload: str = "container"
+
+    def is_enabled(self) -> bool:
+        return bool(self.enabled)
+
+
+@dataclass
+class VFIOManagerSpec(_ImageSpec):
+    """Binds TPU PCI functions to vfio-pci for VM passthrough — reference
+    ``VFIOManagerSpec`` slot."""
+
+    enabled: Optional[bool] = None
+    repository: str = ""
+    image: str = "tpu-vfio-manager"
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+
+    ENV_VAR = "TPU_VFIO_MANAGER_IMAGE"
+
+
+@dataclass
+class SandboxDevicePluginSpec(_ImageSpec):
+    """Device plugin for VM workloads (kubevirt style) — reference
+    ``SandboxDevicePluginSpec`` slot."""
+
+    enabled: Optional[bool] = None
+    repository: str = ""
+    image: str = "tpu-sandbox-device-plugin"
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+
+    ENV_VAR = "TPU_SANDBOX_DEVICE_PLUGIN_IMAGE"
+
+
+@dataclass
+class VMManagerSpec(_ImageSpec):
+    """TPU-VM passthrough host manager — reference ``VGPUManagerSpec`` slot."""
+
+    enabled: Optional[bool] = None
+    repository: str = ""
+    image: str = "tpu-vm-manager"
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+
+    ENV_VAR = "TPU_VM_MANAGER_IMAGE"
+
+
+@dataclass
+class VMDeviceManagerSpec(_ImageSpec):
+    """Creates passthrough TPU devices per named config — reference
+    ``VGPUDeviceManagerSpec`` slot."""
+
+    enabled: Optional[bool] = None
+    repository: str = ""
+    image: str = "tpu-vm-device-manager"
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    config: Optional[DevicePluginConfig] = None
+
+    ENV_VAR = "TPU_VM_DEVICE_MANAGER_IMAGE"
+
+
+@dataclass
+class CDISpec(SpecBase):
+    """Container Device Interface knobs (reference ``CDIConfigSpec``,
+    ``controllers/object_controls.go:125-138``). On TPU, CDI is the default
+    device-injection path."""
+
+    enabled: Optional[bool] = None
+    default: Optional[bool] = None
+
+    def is_enabled(self) -> bool:
+        # CDI defaults ON for the TPU operator (modern path).
+        if self.enabled is None:
+            return True
+        return bool(self.enabled)
+
+    def is_default(self) -> bool:
+        if self.default is None:
+            return True
+        return bool(self.default)
+
+
+@dataclass
+class KataManagerSpec(_ImageSpec):
+    """Kata runtime artifacts — reference ``KataManagerSpec`` slot
+    (``controllers/object_controls.go:4336-4428``)."""
+
+    enabled: Optional[bool] = None
+    repository: str = ""
+    image: str = "tpu-kata-manager"
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    config: Optional[Dict[str, Any]] = None
+
+    ENV_VAR = "TPU_KATA_MANAGER_IMAGE"
+
+
+@dataclass
+class PSPSpec(SpecBase):
+    enabled: Optional[bool] = None
+
+    def is_enabled(self) -> bool:
+        return bool(self.enabled)
+
+
+@dataclass
+class PSASpec(SpecBase):
+    enabled: Optional[bool] = None
+
+    def is_enabled(self) -> bool:
+        return bool(self.enabled)
+
+
+# ---------------------------------------------------------------------------
+# ClusterPolicy
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ClusterPolicySpec(SpecBase):
+    """Spec with one sub-spec per operand (reference
+    ``api/v1/clusterpolicy_types.go:36-84`` — 23 sub-specs)."""
+
+    operator: OperatorSpec = field(default_factory=OperatorSpec)
+    daemonsets: DaemonsetsSpec = field(default_factory=DaemonsetsSpec)
+    libtpu: LibtpuSpec = field(default_factory=LibtpuSpec)
+    runtime: RuntimeSpec = field(default_factory=RuntimeSpec)
+    device_plugin: DevicePluginSpec = field(default_factory=DevicePluginSpec)
+    direct_storage: DirectStorageSpec = field(default_factory=DirectStorageSpec)
+    metricsd: MetricsdSpec = field(default_factory=MetricsdSpec)
+    metrics_exporter: MetricsExporterSpec = field(default_factory=MetricsExporterSpec)
+    node_status_exporter: NodeStatusExporterSpec = field(
+        default_factory=NodeStatusExporterSpec
+    )
+    tfd: TFDSpec = field(default_factory=TFDSpec)
+    slice: SliceSpec = field(default_factory=SliceSpec)
+    slice_manager: SliceManagerSpec = field(default_factory=SliceManagerSpec)
+    validator: ValidatorSpec = field(default_factory=ValidatorSpec)
+    sandbox_workloads: SandboxWorkloadsSpec = field(
+        default_factory=SandboxWorkloadsSpec
+    )
+    vfio_manager: VFIOManagerSpec = field(default_factory=VFIOManagerSpec)
+    sandbox_device_plugin: SandboxDevicePluginSpec = field(
+        default_factory=SandboxDevicePluginSpec
+    )
+    vm_manager: VMManagerSpec = field(default_factory=VMManagerSpec)
+    vm_device_manager: VMDeviceManagerSpec = field(default_factory=VMDeviceManagerSpec)
+    cdi: CDISpec = field(default_factory=CDISpec)
+    kata_manager: KataManagerSpec = field(default_factory=KataManagerSpec)
+    psp: PSPSpec = field(default_factory=PSPSpec)
+    psa: PSASpec = field(default_factory=PSASpec)
+
+    def sandbox_enabled(self) -> bool:
+        return self.sandbox_workloads.is_enabled()
+
+
+@dataclass
+class ClusterPolicyStatus(SpecBase):
+    """Status (reference ``api/v1/clusterpolicy_types.go:1509-1523``)."""
+
+    state: str = ""
+    namespace: str = ""
+    conditions: List[Dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
+class ClusterPolicy(SpecBase):
+    """The single cluster-scoped CR (reference ``ClusterPolicy`` ``:1525``)."""
+
+    api_version: str = "tpu.k8s.io/v1"
+    kind: str = "ClusterPolicy"
+    metadata: Dict[str, Any] = field(default_factory=dict)
+    spec: ClusterPolicySpec = field(default_factory=ClusterPolicySpec)
+    status: ClusterPolicyStatus = field(default_factory=ClusterPolicyStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.get("name", "")
+
+    def set_status(self, state: str, namespace: str) -> None:
+        """reference ``SetStatus`` (``api/v1/clusterpolicy_types.go:1547``)."""
+        self.status.state = state
+        self.status.namespace = namespace
+
+def clusterpolicy_from_obj(obj: Dict[str, Any]) -> ClusterPolicy:
+    """Decode a raw dict (as stored in the API server) into a ClusterPolicy."""
+    cp = ClusterPolicy(
+        api_version=obj.get("apiVersion", "tpu.k8s.io/v1"),
+        kind=obj.get("kind", "ClusterPolicy"),
+        metadata=dict(obj.get("metadata", {})),
+        spec=ClusterPolicySpec.from_dict(obj.get("spec", {})),
+        status=ClusterPolicyStatus.from_dict(obj.get("status", {})),
+    )
+    return cp
+
+
+def clusterpolicy_to_obj(cp: ClusterPolicy) -> Dict[str, Any]:
+    obj = {
+        "apiVersion": cp.api_version,
+        "kind": cp.kind,
+        "metadata": cp.metadata,
+        "spec": cp.spec.to_dict(),
+    }
+    status = cp.status.to_dict()
+    if status:
+        obj["status"] = status
+    return obj

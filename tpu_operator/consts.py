@@ -1,0 +1,137 @@
+"""Shared constants: the node-label bus and well-known paths.
+
+Node labels are the operator's cross-layer communication mechanism, exactly
+as in the reference (``controllers/state_manager.go:27-101``): feature
+discovery publishes hardware facts, the operator converts them to
+per-component deploy labels which are the DaemonSets' nodeSelectors, and the
+upgrade engine runs its FSM through per-node state labels.
+"""
+
+# --- CRD ---------------------------------------------------------------
+GROUP = "tpu.k8s.io"
+API_VERSION = f"{GROUP}/v1"
+CLUSTER_POLICY_KIND = "ClusterPolicy"
+CRD_NAME = f"clusterpolicies.{GROUP}"
+
+# --- resource names ----------------------------------------------------
+TPU_RESOURCE = "google.com/tpu"  # what the device plugin advertises
+TPU_SUBSLICE_RESOURCE_PREFIX = "google.com/tpu-"  # mixed-strategy subslices
+
+# --- hardware-fact labels (published by NFD / GKE / TPU feature discovery;
+#     reference analogue controllers/state_manager.go:40-44,97-101) -----
+# GKE node pools carry these natively:
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"  # e.g. tpu-v5-lite-podslice
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"  # e.g. 2x4
+# NFD fallback: Google PCI vendor id 1ae0 present on the node
+NFD_TPU_PCI_LABEL = "feature.node.kubernetes.io/pci-1ae0.present"
+NFD_KERNEL_LABEL = "feature.node.kubernetes.io/kernel-version.full"
+NFD_OS_LABEL = "feature.node.kubernetes.io/system-os_release.ID"
+NFD_OS_VERSION_LABEL = "feature.node.kubernetes.io/system-os_release.VERSION_ID"
+
+# --- operator-managed labels ------------------------------------------
+TPU_PRESENT_LABEL = f"{GROUP}/tpu.present"
+# per-component deploy labels = DaemonSet nodeSelectors
+# (reference nvidia.com/gpu.deploy.*, controllers/state_manager.go:72-95)
+DEPLOY_LABEL_PREFIX = f"{GROUP}/tpu.deploy."
+COMPONENT_LIBTPU = "libtpu"
+COMPONENT_RUNTIME = "tpu-runtime"
+COMPONENT_DEVICE_PLUGIN = "device-plugin"
+COMPONENT_METRICSD = "metricsd"
+COMPONENT_METRICS_EXPORTER = "metrics-exporter"
+COMPONENT_TFD = "tpu-feature-discovery"
+COMPONENT_SLICE_MANAGER = "slice-manager"
+COMPONENT_OPERATOR_VALIDATOR = "operator-validator"
+COMPONENT_NODE_STATUS_EXPORTER = "node-status-exporter"
+COMPONENT_VM_MANAGER = "vm-manager"
+COMPONENT_VM_DEVICE_MANAGER = "vm-device-manager"
+COMPONENT_VFIO_MANAGER = "vfio-manager"
+COMPONENT_SANDBOX_DEVICE_PLUGIN = "sandbox-device-plugin"
+COMPONENT_SANDBOX_VALIDATOR = "sandbox-validator"
+COMPONENT_KATA_MANAGER = "kata-manager"
+
+# container-workload components (reference gpuStateLabels["container"],
+# controllers/state_manager.go:72-86)
+CONTAINER_WORKLOAD_COMPONENTS = [
+    COMPONENT_LIBTPU,
+    COMPONENT_RUNTIME,
+    COMPONENT_DEVICE_PLUGIN,
+    COMPONENT_METRICSD,
+    COMPONENT_METRICS_EXPORTER,
+    COMPONENT_TFD,
+    COMPONENT_SLICE_MANAGER,
+    COMPONENT_OPERATOR_VALIDATOR,
+    COMPONENT_NODE_STATUS_EXPORTER,
+]
+# vm-passthrough components (reference gpuStateLabels["vm-passthrough"],
+# controllers/state_manager.go:87-95)
+VM_WORKLOAD_COMPONENTS = [
+    COMPONENT_VM_MANAGER,
+    COMPONENT_VM_DEVICE_MANAGER,
+    COMPONENT_VFIO_MANAGER,
+    COMPONENT_SANDBOX_DEVICE_PLUGIN,
+    COMPONENT_SANDBOX_VALIDATOR,
+    COMPONENT_KATA_MANAGER,
+]
+
+# per-node workload override label (reference nvidia.com/gpu.workload.config)
+WORKLOAD_CONFIG_LABEL = f"{GROUP}/tpu.workload.config"
+WORKLOAD_CONTAINER = "container"
+WORKLOAD_VM_PASSTHROUGH = "vm-passthrough"
+
+# slice partitioning label FSM (reference nvidia.com/mig.config[.state])
+SLICE_CONFIG_LABEL = f"{GROUP}/tpu.slice.config"
+SLICE_CONFIG_STATE_LABEL = f"{GROUP}/tpu.slice.config.state"
+
+# per-node device-plugin config override (reference nvidia.com/device-plugin.config)
+DEVICE_PLUGIN_CONFIG_LABEL = f"{GROUP}/device-plugin.config"
+
+# upgrade FSM label (reference nvidia.com/gpu-driver-upgrade-state)
+UPGRADE_STATE_LABEL = f"{GROUP}/libtpu-upgrade-state"
+UPGRADE_SKIP_DRAIN_LABEL = f"{GROUP}/libtpu-upgrade-drain.skip"
+UPGRADE_SKIP_LABEL = f"{GROUP}/libtpu-upgrade.skip"
+UPGRADE_ENABLED_ANNOTATION = f"{GROUP}/libtpu-upgrade-enabled"
+
+# feature-discovery published labels (GFD analogue)
+TFD_LABEL_PREFIX = f"{GROUP}/tpu."
+TFD_CHIP_TYPE_LABEL = f"{TFD_LABEL_PREFIX}chip-type"  # v4 | v5e | v5p | v6e
+TFD_CHIP_COUNT_LABEL = f"{TFD_LABEL_PREFIX}chip-count"
+TFD_HBM_GB_LABEL = f"{TFD_LABEL_PREFIX}hbm-gb"
+TFD_TOPOLOGY_LABEL = f"{TFD_LABEL_PREFIX}topology"  # e.g. 2x2x1
+TFD_SLICE_HOSTS_LABEL = f"{TFD_LABEL_PREFIX}slice-hosts"
+TFD_WORKER_ID_LABEL = f"{TFD_LABEL_PREFIX}worker-id"
+TFD_ICI_WRAP_LABEL = f"{TFD_LABEL_PREFIX}ici-wraparound"
+TFD_LIBTPU_VERSION_LABEL = f"{TFD_LABEL_PREFIX}libtpu-version"
+
+# --- host paths --------------------------------------------------------
+# status-file barrier directory (reference /run/nvidia/validations,
+# validator/main.go:123-157)
+VALIDATION_DIR = "/run/tpu/validations"
+STATUS_FILE_LIBTPU = "libtpu-ready"
+STATUS_FILE_RUNTIME = "runtime-ready"
+STATUS_FILE_PLUGIN = "plugin-ready"
+STATUS_FILE_JAX = "jax-ready"
+STATUS_FILE_SLICE = "slice-ready"
+STATUS_FILE_LIBTPU_CTR = ".libtpu-ctr-ready"  # startupProbe barrier
+
+LIBTPU_HOST_DIR = "/home/kubernetes/lib/tpu"
+DEVICE_GLOB = "/dev/accel*"
+VFIO_DIR = "/dev/vfio"
+
+# --- misc --------------------------------------------------------------
+OPERATOR_NAMESPACE_ENV = "OPERATOR_NAMESPACE"
+DEFAULT_NAMESPACE = "tpu-operator"
+LAST_APPLIED_HASH_ANNOTATION = f"{GROUP}/last-applied-hash"  # ref nvidia.com/last-applied-hash
+OPERAND_VERSION_ANNOTATION = f"{GROUP}/operand-version"
+PSA_LABEL_PREFIX = "pod-security.kubernetes.io/"
+
+# TPU generations the libtpu fan-out understands (per-kernel analogue)
+TPU_GENERATIONS = ["v4", "v5e", "v5p", "v6e"]
+
+# map GKE accelerator label value -> generation
+GKE_ACCELERATOR_TO_GENERATION = {
+    "tpu-v4-podslice": "v4",
+    "tpu-v5-lite-podslice": "v5e",
+    "tpu-v5-lite-device": "v5e",
+    "tpu-v5p-slice": "v5p",
+    "tpu-v6e-slice": "v6e",
+}

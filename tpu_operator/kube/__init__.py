@@ -1,0 +1,8 @@
+from tpu_operator.kube.client import (  # noqa: F401
+    Client,
+    FakeClient,
+    NotFoundError,
+    ConflictError,
+    obj_key,
+    match_labels,
+)

@@ -1,0 +1,270 @@
+"""Minimal Kubernetes client abstraction.
+
+The operator's controllers speak to the cluster through the small ``Client``
+interface below. Two implementations exist:
+
+* ``FakeClient`` — an in-memory object store with resourceVersions, label
+  selectors and watch events. This is the test double, playing the role the
+  reference's ``sigs.k8s.io/controller-runtime/pkg/client/fake`` plays in
+  ``controllers/object_controls_test.go:224-254``.
+* ``RestClient`` (``tpu_operator/kube/rest.py``) — a stdlib-only HTTP client
+  for in-cluster use (service-account token + CA), since the operator image
+  carries no vendored SDK.
+
+Objects are plain dicts in Kubernetes wire format (``apiVersion``/``kind``/
+``metadata``/...). Cluster-scoped objects have no ``metadata.namespace``.
+"""
+
+from __future__ import annotations
+
+import copy
+import fnmatch
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+Obj = Dict[str, Any]
+
+
+class NotFoundError(KeyError):
+    """Object does not exist (HTTP 404 analogue)."""
+
+
+class ConflictError(RuntimeError):
+    """resourceVersion conflict on update (HTTP 409 analogue)."""
+
+
+def obj_key(obj: Obj) -> Tuple[str, str, str, str]:
+    meta = obj.get("metadata", {})
+    return (
+        obj.get("apiVersion", ""),
+        obj.get("kind", ""),
+        meta.get("namespace", ""),
+        meta.get("name", ""),
+    )
+
+
+def match_labels(obj: Obj, selector: Optional[Dict[str, str]]) -> bool:
+    """Label-selector match supporting exact values and ``*`` globs.
+
+    Glob support mirrors how the reference filters e.g. ``nvidia.com/gpu*``
+    resource names (``main.go:161-183``) — used by tests and the upgrade
+    engine's pod filters.
+    """
+    if not selector:
+        return True
+    labels = obj.get("metadata", {}).get("labels", {}) or {}
+    for k, v in selector.items():
+        if k not in labels:
+            return False
+        if v is None or v == "":
+            continue
+        if "*" in v:
+            if not fnmatch.fnmatchcase(str(labels[k]), v):
+                return False
+        elif str(labels[k]) != str(v):
+            return False
+    return True
+
+
+class Client:
+    """Interface all controllers use. Mirrors the subset of
+    controller-runtime's client the reference exercises."""
+
+    # -- reads ----------------------------------------------------------
+    def get(
+        self, api_version: str, kind: str, name: str, namespace: str = ""
+    ) -> Obj:
+        raise NotImplementedError
+
+    def list(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str = "",
+        label_selector: Optional[Dict[str, str]] = None,
+        field_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Obj]:
+        raise NotImplementedError
+
+    # -- writes ---------------------------------------------------------
+    def create(self, obj: Obj) -> Obj:
+        raise NotImplementedError
+
+    def update(self, obj: Obj) -> Obj:
+        raise NotImplementedError
+
+    def update_status(self, obj: Obj) -> Obj:
+        raise NotImplementedError
+
+    def delete(
+        self, api_version: str, kind: str, name: str, namespace: str = ""
+    ) -> None:
+        raise NotImplementedError
+
+    # -- conveniences shared by all implementations ---------------------
+    def get_or_none(
+        self, api_version: str, kind: str, name: str, namespace: str = ""
+    ) -> Optional[Obj]:
+        try:
+            return self.get(api_version, kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def apply(self, obj: Obj) -> Obj:
+        """Create-or-update by key (server-side-apply analogue).
+
+        The caller's object is never mutated: a reconcile loop can re-apply
+        the same rendered manifest dict without a stale resourceVersion
+        leaking into its template.
+        """
+        av, kind, ns, name = obj_key(obj)
+        existing = self.get_or_none(av, kind, name, ns)
+        if existing is None:
+            return self.create(obj)
+        obj = copy.deepcopy(obj)
+        obj.setdefault("metadata", {})["resourceVersion"] = existing[
+            "metadata"
+        ].get("resourceVersion")
+        return self.update(obj)
+
+    def delete_if_exists(
+        self, api_version: str, kind: str, name: str, namespace: str = ""
+    ) -> bool:
+        try:
+            self.delete(api_version, kind, name, namespace)
+            return True
+        except NotFoundError:
+            return False
+
+
+class FakeClient(Client):
+    """In-memory API server double with watch support.
+
+    Thread-safe; resourceVersion is a monotonically increasing integer
+    stamped on every write, enabling optimistic-concurrency conflict checks
+    and hash-idempotency tests.
+    """
+
+    def __init__(self, objs: Iterable[Obj] = ()):  # noqa: D401
+        self._lock = threading.RLock()
+        self._store: Dict[Tuple[str, str, str, str], Obj] = {}
+        self._rv = 0
+        self._watchers: List[Callable[[str, Obj], None]] = []
+        for o in objs:
+            self.create(copy.deepcopy(o))
+
+    # -- watch ----------------------------------------------------------
+    def add_watcher(self, fn: Callable[[str, Obj], None]) -> None:
+        """Register ``fn(event_type, obj)``; event_type ∈ ADDED/MODIFIED/DELETED."""
+        with self._lock:
+            self._watchers.append(fn)
+
+    def _notify(self, event: str, obj: Obj) -> None:
+        for fn in list(self._watchers):
+            fn(event, copy.deepcopy(obj))
+
+    # -- reads ----------------------------------------------------------
+    def get(self, api_version, kind, name, namespace=""):
+        with self._lock:
+            key = (api_version, kind, namespace or "", name)
+            if key not in self._store:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(self._store[key])
+
+    def list(
+        self,
+        api_version,
+        kind,
+        namespace="",
+        label_selector=None,
+        field_selector=None,
+    ):
+        with self._lock:
+            out = []
+            for (av, k, ns, _), obj in sorted(self._store.items()):
+                if av != api_version or k != kind:
+                    continue
+                if namespace and ns != namespace:
+                    continue
+                if not match_labels(obj, label_selector):
+                    continue
+                if field_selector and not self._match_fields(obj, field_selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    @staticmethod
+    def _match_fields(obj: Obj, selector: Dict[str, str]) -> bool:
+        for path, want in selector.items():
+            cur: Any = obj
+            for part in path.split("."):
+                if not isinstance(cur, dict) or part not in cur:
+                    return False
+                cur = cur[part]
+            if str(cur) != str(want):
+                return False
+        return True
+
+    # -- writes ---------------------------------------------------------
+    def _stamp(self, obj: Obj) -> None:
+        self._rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+
+    def create(self, obj):
+        with self._lock:
+            key = obj_key(obj)
+            if not key[3]:
+                raise ValueError(f"object has no name: {obj}")
+            if key in self._store:
+                raise ConflictError(f"{key[1]} {key[2]}/{key[3]} already exists")
+            stored = copy.deepcopy(obj)
+            self._stamp(stored)
+            self._store[key] = stored
+            self._notify("ADDED", stored)
+            return copy.deepcopy(stored)
+
+    def update(self, obj):
+        with self._lock:
+            key = obj_key(obj)
+            if key not in self._store:
+                raise NotFoundError(f"{key[1]} {key[2]}/{key[3]} not found")
+            existing = self._store[key]
+            want_rv = obj.get("metadata", {}).get("resourceVersion")
+            have_rv = existing["metadata"].get("resourceVersion")
+            if want_rv is not None and str(want_rv) != str(have_rv):
+                raise ConflictError(
+                    f"resourceVersion conflict on {key}: {want_rv} != {have_rv}"
+                )
+            stored = copy.deepcopy(obj)
+            # status is a subresource: plain updates preserve existing status
+            if "status" in existing and "status" not in stored:
+                stored["status"] = copy.deepcopy(existing["status"])
+            self._stamp(stored)
+            self._store[key] = stored
+            self._notify("MODIFIED", stored)
+            return copy.deepcopy(stored)
+
+    def update_status(self, obj):
+        with self._lock:
+            key = obj_key(obj)
+            if key not in self._store:
+                raise NotFoundError(f"{key[1]} {key[2]}/{key[3]} not found")
+            existing = copy.deepcopy(self._store[key])
+            existing["status"] = copy.deepcopy(obj.get("status", {}))
+            self._stamp(existing)
+            self._store[key] = existing
+            self._notify("MODIFIED", existing)
+            return copy.deepcopy(existing)
+
+    def delete(self, api_version, kind, name, namespace=""):
+        with self._lock:
+            key = (api_version, kind, namespace or "", name)
+            if key not in self._store:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            obj = self._store.pop(key)
+            self._notify("DELETED", obj)
+
+    # -- test helpers ----------------------------------------------------
+    def all_objects(self) -> List[Obj]:
+        with self._lock:
+            return [copy.deepcopy(o) for o in self._store.values()]

@@ -1,0 +1,162 @@
+"""ClusterPolicy reconciler semantics (reference
+``controllers/clusterpolicy_controller.go``): singleton, requeue cadences,
+status updates, node-event predicates."""
+
+import os
+
+import pytest
+import yaml
+
+from tests.conftest import make_cpu_node, make_tpu_node
+from tpu_operator import consts
+from tpu_operator.api.v1.clusterpolicy_types import State
+from tpu_operator.controllers.clusterpolicy_controller import (
+    REQUEUE_NO_LABELS_S,
+    REQUEUE_NOT_READY_S,
+    ClusterPolicyReconciler,
+    node_event_needs_reconcile,
+)
+from tpu_operator.kube import FakeClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ASSETS = os.path.join(REPO, "assets")
+NS = "tpu-operator"
+
+
+def load_cr(name="cluster-policy"):
+    with open(os.path.join(REPO, "config", "samples", "v1_clusterpolicy.yaml")) as f:
+        obj = yaml.safe_load(f)
+    obj["metadata"]["name"] = name
+    obj["metadata"]["uid"] = f"uid-{name}"
+    return obj
+
+
+@pytest.fixture()
+def env(monkeypatch):
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+
+
+def simulate_kubelet(client):
+    for ds in client.list("apps/v1", "DaemonSet", NS):
+        ds["status"] = {
+            "desiredNumberScheduled": 1,
+            "numberUnavailable": 0,
+            "updatedNumberScheduled": 1,
+        }
+        client.update_status(ds)
+        if ds["spec"].get("updateStrategy", {}).get("type") == "OnDelete":
+            app = ds["spec"]["selector"]["matchLabels"]["app"]
+            h = ds["spec"]["template"]["metadata"].get("annotations", {}).get(
+                consts.LAST_APPLIED_HASH_ANNOTATION
+            )
+            pod = {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": f"{app}-0",
+                    "namespace": NS,
+                    "labels": {"app": app},
+                    "annotations": {consts.LAST_APPLIED_HASH_ANNOTATION: h},
+                },
+                "spec": {"nodeName": "tpu-node-1"},
+                "status": {"phase": "Running"},
+            }
+            existing = client.get_or_none("v1", "Pod", pod["metadata"]["name"], NS)
+            if existing is None:
+                client.create(pod)
+
+
+def test_reconcile_to_ready(env):
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            make_tpu_node("tpu-node-1"),
+        ]
+    )
+    client.create(load_cr())
+    r = ClusterPolicyReconciler(client, assets_dir=ASSETS)
+    # first pass: DaemonSets created but not scheduled -> notReady, 5s requeue
+    result = r.reconcile()
+    assert result.requeue_after == REQUEUE_NOT_READY_S
+    cr = client.get(consts.API_VERSION, "ClusterPolicy", "cluster-policy")
+    assert cr["status"]["state"] == State.NOT_READY
+    assert cr["status"]["namespace"] == NS
+    # kubelet runs everything -> ready
+    simulate_kubelet(client)
+    result = r.reconcile()
+    assert result.ready
+    cr = client.get(consts.API_VERSION, "ClusterPolicy", "cluster-policy")
+    assert cr["status"]["state"] == State.READY
+
+
+def test_singleton_stable_across_reconciles(env):
+    """Primary selection must not flip-flop as status writes bump
+    resourceVersions (regression: sort by creationTimestamp, not rv)."""
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            make_tpu_node("tpu-node-1"),
+        ]
+    )
+    client.create(load_cr("a-policy"))
+    client.create(load_cr("b-policy"))
+    r = ClusterPolicyReconciler(client, assets_dir=ASSETS)
+    for _ in range(3):
+        r.reconcile()
+        primary = client.get(consts.API_VERSION, "ClusterPolicy", "a-policy")
+        extra = client.get(consts.API_VERSION, "ClusterPolicy", "b-policy")
+        assert primary["status"]["state"] != State.IGNORED
+        assert extra["status"]["state"] == State.IGNORED
+
+
+def test_singleton_extra_cr_ignored(env):
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            make_tpu_node("tpu-node-1"),
+        ]
+    )
+    client.create(load_cr("cluster-policy"))
+    client.create(load_cr("cluster-policy-2"))
+    r = ClusterPolicyReconciler(client, assets_dir=ASSETS)
+    r.reconcile()
+    extra = client.get(consts.API_VERSION, "ClusterPolicy", "cluster-policy-2")
+    assert extra["status"]["state"] == State.IGNORED
+    primary = client.get(consts.API_VERSION, "ClusterPolicy", "cluster-policy")
+    assert primary["status"]["state"] != State.IGNORED
+
+
+def test_no_tpu_labels_polls_45s(env):
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            make_cpu_node("cpu-1"),
+        ]
+    )
+    client.create(load_cr())
+    r = ClusterPolicyReconciler(client, assets_dir=ASSETS)
+    result = r.reconcile()
+    assert result.requeue_after == REQUEUE_NO_LABELS_S
+
+
+def test_no_cr_is_noop(env):
+    client = FakeClient()
+    r = ClusterPolicyReconciler(client, assets_dir=ASSETS)
+    result = r.reconcile()
+    assert result.requeue_after is None and not result.ready
+
+
+def test_node_event_predicates():
+    tpu = make_tpu_node("n1")
+    cpu = make_cpu_node("n2")
+    assert node_event_needs_reconcile("ADDED", None, tpu)
+    assert not node_event_needs_reconcile("ADDED", None, cpu)
+    assert node_event_needs_reconcile("DELETED", tpu, tpu)
+    # irrelevant label change -> no reconcile
+    new = make_tpu_node("n1")
+    new["metadata"]["labels"]["unrelated"] = "x"
+    assert not node_event_needs_reconcile("MODIFIED", tpu, new)
+    # deploy-label tamper -> reconcile (reference restores labels)
+    new2 = make_tpu_node("n1")
+    new2["metadata"]["labels"][consts.DEPLOY_LABEL_PREFIX + "libtpu"] = "false"
+    assert node_event_needs_reconcile("MODIFIED", tpu, new2)

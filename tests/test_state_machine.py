@@ -1,0 +1,362 @@
+"""State machine + object controls, driven against the real assets.
+
+Mirrors the reference's fake-client pattern
+(``controllers/object_controls_test.go:224-254,297-453``): build a mock
+cluster, load the sample ClusterPolicy, mimic ``init()``, then run states
+and assert on the transformed DaemonSets.
+"""
+
+import copy
+import os
+
+import pytest
+import yaml
+
+from tests.conftest import make_cpu_node, make_tpu_node
+from tpu_operator import consts
+from tpu_operator.api.v1.clusterpolicy_types import State
+from tpu_operator.controllers.object_controls import compute_hash
+from tpu_operator.controllers.state_manager import (
+    STATE_ORDER,
+    ClusterPolicyController,
+)
+from tpu_operator.kube import FakeClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ASSETS = os.path.join(REPO, "assets")
+SAMPLE_CR = os.path.join(REPO, "config", "samples", "v1_clusterpolicy.yaml")
+
+NS = "tpu-operator"
+
+
+def load_sample_cr():
+    with open(SAMPLE_CR) as f:
+        obj = yaml.safe_load(f)
+    obj["metadata"]["uid"] = "test-uid-1234"
+    return obj
+
+
+@pytest.fixture()
+def ctrl(monkeypatch):
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            make_tpu_node("tpu-node-1"),
+            make_tpu_node("tpu-node-2", accelerator="tpu-v5p-slice", topology="2x2x1"),
+            make_cpu_node("cpu-node-1"),
+        ]
+    )
+    cr = load_sample_cr()
+    client.create(cr)
+    c = ClusterPolicyController(client, assets_dir=ASSETS)
+    c.init(client.get("tpu.k8s.io/v1", "ClusterPolicy", "cluster-policy"))
+    return c
+
+
+def run_all_states(c):
+    statuses = {}
+    c.idx = 0
+    while not c.last():
+        name = c.state_names[c.idx]
+        statuses[name] = c.step()
+        # simulate kubelet: mark every DaemonSet fully scheduled & available,
+        # and (for OnDelete operands) run pods at the current revision hash
+        for ds in c.client.list("apps/v1", "DaemonSet", NS):
+            if "status" not in ds or not ds["status"]:
+                ds["status"] = {
+                    "desiredNumberScheduled": 2,
+                    "numberUnavailable": 0,
+                    "updatedNumberScheduled": 2,
+                }
+                c.client.update_status(ds)
+            if ds["spec"].get("updateStrategy", {}).get("type") == "OnDelete":
+                app = ds["spec"]["selector"]["matchLabels"]["app"]
+                h = ds["spec"]["template"]["metadata"].get("annotations", {}).get(
+                    consts.LAST_APPLIED_HASH_ANNOTATION
+                )
+                for i in range(2):
+                    pod = {
+                        "apiVersion": "v1",
+                        "kind": "Pod",
+                        "metadata": {
+                            "name": f"{app}-{i}",
+                            "namespace": NS,
+                            "labels": {"app": app},
+                            "annotations": {consts.LAST_APPLIED_HASH_ANNOTATION: h},
+                        },
+                        "status": {"phase": "Running"},
+                    }
+                    existing = c.client.get_or_none("v1", "Pod", pod["metadata"]["name"], NS)
+                    if existing is None:
+                        c.client.create(pod)
+                    elif (
+                        existing["metadata"].get("annotations", {}).get(
+                            consts.LAST_APPLIED_HASH_ANNOTATION
+                        )
+                        != h
+                    ):
+                        pod["metadata"]["resourceVersion"] = existing["metadata"][
+                            "resourceVersion"
+                        ]
+                        c.client.update(pod)
+    return statuses
+
+
+def test_init_labels_tpu_nodes(ctrl):
+    node = ctrl.client.get("v1", "Node", "tpu-node-1")
+    labels = node["metadata"]["labels"]
+    assert labels[consts.TPU_PRESENT_LABEL] == "true"
+    assert labels[consts.DEPLOY_LABEL_PREFIX + "libtpu"] == "true"
+    assert labels[consts.DEPLOY_LABEL_PREFIX + "device-plugin"] == "true"
+    assert labels[f"{consts.GROUP}/tpu.generation"] == "v5e"
+    # vm components not labeled (sandbox disabled)
+    assert consts.DEPLOY_LABEL_PREFIX + "vm-manager" not in labels
+    # cpu node untouched
+    cpu = ctrl.client.get("v1", "Node", "cpu-node-1")
+    assert consts.TPU_PRESENT_LABEL not in cpu["metadata"]["labels"]
+    assert ctrl.has_tpu_nodes
+    assert ctrl.tpu_generations == {"v5e", "v5p"}
+    assert ctrl.runtime == "containerd"
+
+
+def test_all_17_states_load(ctrl):
+    assert ctrl.state_names == STATE_ORDER
+    assert len(ctrl.state_names) == 17
+
+
+def test_full_step_through_all_states(ctrl):
+    statuses = run_all_states(ctrl)
+    # second pass: everything has status now -> all enabled states ready
+    statuses = run_all_states(ctrl)
+    for name, st in statuses.items():
+        assert st in (State.READY, State.DISABLED), f"{name}: {st}"
+    # operand DaemonSets exist with transformed images
+    ds = ctrl.client.get("apps/v1", "DaemonSet", "tpu-device-plugin-daemonset", NS)
+    ctr = ds["spec"]["template"]["spec"]["containers"][0]
+    assert ctr["image"] == "gcr.io/tpu-operator/tpu-device-plugin:0.9.0"
+    env = {e["name"]: e.get("value") for e in ctr["env"]}
+    assert env["SLICE_STRATEGY"] == "single"
+    assert env["CDI_ENABLED"] == "true"
+    assert env["TPU_RESOURCE"] == "google.com/tpu"
+    # validator initContainer got the validator image
+    init = ds["spec"]["template"]["spec"]["initContainers"][0]
+    assert init["image"] == "gcr.io/tpu-operator/tpu-operator-validator:0.9.0"
+    # namespace filled
+    assert ds["metadata"]["namespace"] == NS
+    # owner reference set to the ClusterPolicy
+    assert ds["metadata"]["ownerReferences"][0]["kind"] == "ClusterPolicy"
+
+
+def test_sandbox_states_disabled_by_default(ctrl):
+    run_all_states(ctrl)
+    assert (
+        ctrl.client.get_or_none("apps/v1", "DaemonSet", "tpu-vm-manager-daemonset", NS)
+        is None
+    )
+    assert (
+        ctrl.client.get_or_none(
+            "apps/v1", "DaemonSet", "tpu-vfio-manager-daemonset", NS
+        )
+        is None
+    )
+
+
+def test_hash_idempotency(ctrl):
+    """Re-running all states must not churn objects (reference hash
+    annotation pattern, controllers/object_controls.go:3890-3929)."""
+    run_all_states(ctrl)
+    before = {
+        (o["kind"], o["metadata"].get("namespace", ""), o["metadata"]["name"]): o[
+            "metadata"
+        ]["resourceVersion"]
+        for o in ctrl.client.all_objects()
+    }
+    run_all_states(ctrl)
+    after = {
+        (o["kind"], o["metadata"].get("namespace", ""), o["metadata"]["name"]): o[
+            "metadata"
+        ]["resourceVersion"]
+        for o in ctrl.client.all_objects()
+    }
+    churned = {
+        k: (before[k], after[k])
+        for k in before
+        if k in after and before[k] != after[k]
+    }
+    assert not churned, f"objects churned on idempotent reconcile: {churned}"
+
+
+def test_disable_operand_deletes_daemonset(ctrl):
+    run_all_states(ctrl)
+    assert ctrl.client.get_or_none("apps/v1", "DaemonSet", "tpu-metrics-exporter", NS)
+    # disable the exporter and re-reconcile (reference disable-operands e2e)
+    cr = ctrl.client.get("tpu.k8s.io/v1", "ClusterPolicy", "cluster-policy")
+    cr["spec"]["metricsExporter"]["enabled"] = False
+    ctrl.client.update(cr)
+    ctrl.init(ctrl.client.get("tpu.k8s.io/v1", "ClusterPolicy", "cluster-policy"))
+    run_all_states(ctrl)
+    assert (
+        ctrl.client.get_or_none("apps/v1", "DaemonSet", "tpu-metrics-exporter", NS)
+        is None
+    )
+
+
+def test_libtpu_generation_fanout(ctrl):
+    """Per-generation DaemonSet fan-out (reference precompiled-driver fan-out,
+    controllers/object_controls.go:3405-3441), incl. stale GC."""
+    cr = ctrl.client.get("tpu.k8s.io/v1", "ClusterPolicy", "cluster-policy")
+    cr["spec"]["libtpu"]["generationConfigs"] = {
+        "v5e": "2025.1.0-v5e",
+        "v5p": "2025.1.0-v5p",
+    }
+    ctrl.client.update(cr)
+    ctrl.init(ctrl.client.get("tpu.k8s.io/v1", "ClusterPolicy", "cluster-policy"))
+    run_all_states(ctrl)
+    ds_e = ctrl.client.get("apps/v1", "DaemonSet", "tpu-libtpu-daemonset-v5e", NS)
+    ds_p = ctrl.client.get("apps/v1", "DaemonSet", "tpu-libtpu-daemonset-v5p", NS)
+    img_e = [
+        c for c in ds_e["spec"]["template"]["spec"]["containers"]
+        if c["name"] == "libtpu-ctr"
+    ][0]["image"]
+    img_p = [
+        c for c in ds_p["spec"]["template"]["spec"]["containers"]
+        if c["name"] == "libtpu-ctr"
+    ][0]["image"]
+    assert img_e == "gcr.io/tpu-operator/libtpu-installer:2025.1.0-v5e"
+    assert img_p == "gcr.io/tpu-operator/libtpu-installer:2025.1.0-v5p"
+    # per-generation node selector
+    assert (
+        ds_e["spec"]["template"]["spec"]["nodeSelector"][
+            f"{consts.GROUP}/tpu.generation"
+        ]
+        == "v5e"
+    )
+    # each generation DS has its own selector/app identity (identical
+    # selectors across DaemonSets are invalid and break OnDelete readiness)
+    sel_e = ds_e["spec"]["selector"]["matchLabels"]["app"]
+    sel_p = ds_p["spec"]["selector"]["matchLabels"]["app"]
+    assert sel_e != sel_p
+    assert ds_e["spec"]["template"]["metadata"]["labels"]["app"] == sel_e
+    # un-suffixed base DS garbage-collected
+    assert (
+        ctrl.client.get_or_none("apps/v1", "DaemonSet", "tpu-libtpu-daemonset", NS)
+        is None
+    )
+    # now shrink to one generation -> stale DS GC'd
+    # (simulate the v5p pool being deleted)
+    ctrl.client.delete("v1", "Node", "tpu-node-2")
+    ctrl.init(ctrl.client.get("tpu.k8s.io/v1", "ClusterPolicy", "cluster-policy"))
+    run_all_states(ctrl)
+    assert (
+        ctrl.client.get_or_none("apps/v1", "DaemonSet", "tpu-libtpu-daemonset-v5p", NS)
+        is None
+    )
+    assert ctrl.client.get_or_none(
+        "apps/v1", "DaemonSet", "tpu-libtpu-daemonset-v5e", NS
+    )
+
+
+def test_ondelete_readiness_uses_pod_hash(ctrl):
+    """OnDelete readiness: pods must carry the current operand hash
+    (TPU redesign of reference per-pod revision-hash check :3107-3177)."""
+    from tpu_operator.controllers.object_controls import is_daemonset_ready
+
+    run_all_states(ctrl)
+    ds = ctrl.client.get("apps/v1", "DaemonSet", "tpu-libtpu-daemonset", NS)
+    want_hash = ds["spec"]["template"]["metadata"]["annotations"][
+        consts.LAST_APPLIED_HASH_ANNOTATION
+    ]
+    ds["status"] = {"desiredNumberScheduled": 2, "numberUnavailable": 0}
+    ctrl.client.update_status(ds)
+    ds = ctrl.client.get("apps/v1", "DaemonSet", "tpu-libtpu-daemonset", NS)
+
+    def mk_pod(name, h):
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "namespace": NS,
+                "labels": {"app": "tpu-libtpu-daemonset"},
+                "annotations": {consts.LAST_APPLIED_HASH_ANNOTATION: h},
+            },
+            "status": {"phase": "Running"},
+        }
+
+    ctrl.client.create(mk_pod("libtpu-1", want_hash))
+    ctrl.client.create(mk_pod("libtpu-2", "stale-hash"))
+    assert not is_daemonset_ready(ctrl, ds)
+    stale = ctrl.client.get("v1", "Pod", "libtpu-2", NS)
+    stale["metadata"]["annotations"][consts.LAST_APPLIED_HASH_ANNOTATION] = want_hash
+    ctrl.client.update(stale)
+    assert is_daemonset_ready(ctrl, ds)
+
+
+def test_compute_hash_deterministic():
+    obj = {
+        "kind": "DaemonSet",
+        "metadata": {"labels": {"a": "1"}, "annotations": {"x": "y"}},
+        "spec": {"template": {"spec": {"containers": [{"name": "c"}]}}},
+    }
+    h1 = compute_hash(copy.deepcopy(obj))
+    # key order must not matter
+    obj2 = {
+        "spec": {"template": {"spec": {"containers": [{"name": "c"}]}}},
+        "metadata": {"annotations": {"x": "y"}, "labels": {"a": "1"}},
+        "kind": "DaemonSet",
+    }
+    assert h1 == compute_hash(obj2)
+    # hash annotation itself is excluded
+    obj3 = copy.deepcopy(obj)
+    obj3["metadata"]["annotations"][consts.LAST_APPLIED_HASH_ANNOTATION] = "zzz"
+    assert h1 == compute_hash(obj3)
+
+
+def test_workload_config_vm_passthrough(monkeypatch):
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            make_tpu_node(
+                "vm-node",
+                extra_labels={consts.WORKLOAD_CONFIG_LABEL: "vm-passthrough"},
+            ),
+        ]
+    )
+    cr = load_sample_cr()
+    cr["spec"]["sandboxWorkloads"]["enabled"] = True
+    client.create(cr)
+    c = ClusterPolicyController(client, assets_dir=ASSETS)
+    c.init(client.get("tpu.k8s.io/v1", "ClusterPolicy", "cluster-policy"))
+    labels = client.get("v1", "Node", "vm-node")["metadata"]["labels"]
+    assert labels[consts.DEPLOY_LABEL_PREFIX + "vfio-manager"] == "true"
+    assert labels[consts.DEPLOY_LABEL_PREFIX + "vm-manager"] == "true"
+    assert consts.DEPLOY_LABEL_PREFIX + "libtpu" not in labels
+
+
+def test_no_tpu_nodes_all_ready(monkeypatch):
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            make_cpu_node("cpu-only"),
+        ]
+    )
+    client.create(load_sample_cr())
+    c = ClusterPolicyController(client, assets_dir=ASSETS)
+    c.init(client.get("tpu.k8s.io/v1", "ClusterPolicy", "cluster-policy"))
+    assert not c.has_tpu_nodes
+    while not c.last():
+        assert c.step() in (State.READY, State.DISABLED)
+    # no DaemonSets were created
+    assert client.list("apps/v1", "DaemonSet", NS) == []
+
+
+def test_missing_namespace_env_raises(monkeypatch):
+    monkeypatch.delenv(consts.OPERATOR_NAMESPACE_ENV, raising=False)
+    client = FakeClient()
+    client.create(load_sample_cr())
+    c = ClusterPolicyController(client, assets_dir=ASSETS)
+    with pytest.raises(RuntimeError, match="OPERATOR_NAMESPACE"):
+        c.init(client.get("tpu.k8s.io/v1", "ClusterPolicy", "cluster-policy"))

@@ -1,0 +1,251 @@
+"""Upgrade engine: per-node FSM, throttling, skip labels (reference
+vendored ``pkg/upgrade`` + ``controllers/upgrade_controller.go``)."""
+
+import pytest
+
+from tests.conftest import make_tpu_node
+from tpu_operator import consts
+from tpu_operator.api.v1.clusterpolicy_types import (
+    DrainSpec,
+    UpgradePolicySpec,
+)
+from tpu_operator.kube import FakeClient
+from tpu_operator.upgrade import upgrade_state as us
+
+NS = "tpu-operator"
+APP = "tpu-libtpu-daemonset"
+DESIRED_HASH = "new-hash"
+
+
+def driver_ds():
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {"name": APP, "namespace": NS},
+        "spec": {
+            "selector": {"matchLabels": {"app": APP}},
+            "template": {
+                "metadata": {
+                    "annotations": {consts.LAST_APPLIED_HASH_ANNOTATION: DESIRED_HASH}
+                },
+                "spec": {},
+            },
+            "updateStrategy": {"type": "OnDelete"},
+        },
+    }
+
+
+def driver_pod(node, h):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"libtpu-{node}",
+            "namespace": NS,
+            "labels": {"app": APP},
+            "annotations": {consts.LAST_APPLIED_HASH_ANNOTATION: h},
+        },
+        "spec": {"nodeName": node},
+        "status": {"phase": "Running"},
+    }
+
+
+def workload_pod(name, node):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            # managed pod: a controller will recreate it after eviction
+            "ownerReferences": [{"kind": "Job", "name": "train", "uid": "j1"}],
+        },
+        "spec": {
+            "nodeName": node,
+            "containers": [
+                {"name": "train", "resources": {"limits": {"google.com/tpu": "4"}}}
+            ],
+        },
+        "status": {"phase": "Running"},
+    }
+
+
+def validator_pod(node):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"validator-{node}",
+            "namespace": NS,
+            "labels": {"app": "tpu-operator-validator"},
+        },
+        "spec": {"nodeName": node},
+        "status": {"phase": "Running"},
+    }
+
+
+@pytest.fixture()
+def cluster():
+    client = FakeClient([{"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}])
+    for i in (1, 2, 3, 4):
+        node = make_tpu_node(f"node-{i}")
+        node["metadata"]["labels"][
+            consts.DEPLOY_LABEL_PREFIX + consts.COMPONENT_LIBTPU
+        ] = "true"
+        client.create(node)
+        client.create(driver_pod(f"node-{i}", "stale-hash"))
+    client.create(driver_ds())
+    return client
+
+
+def pump(mgr, policy, times=12):
+    for _ in range(times):
+        state = mgr.build_state()
+        mgr.apply_state(state, policy)
+    return mgr
+
+
+def node_state(client, name):
+    return client.get("v1", "Node", name)["metadata"]["labels"].get(
+        consts.UPGRADE_STATE_LABEL
+    )
+
+
+def test_detects_stale_nodes(cluster):
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    state = mgr.build_state()
+    assert state.count(us.STATE_UPGRADE_REQUIRED) == 4
+
+
+def test_fresh_nodes_marked_done(cluster):
+    # node-1's pod already runs the desired revision
+    pod = cluster.get("v1", "Pod", "libtpu-node-1", NS)
+    pod["metadata"]["annotations"][consts.LAST_APPLIED_HASH_ANNOTATION] = DESIRED_HASH
+    cluster.update(pod)
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    state = mgr.build_state()
+    assert state.count(us.STATE_UPGRADE_REQUIRED) == 3
+    assert state.count(us.STATE_DONE) == 1
+
+
+def test_full_fsm_walk_single_node(cluster):
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=1, max_unavailable="25%"
+    )
+    cluster.create(workload_pod("train-1", "node-1"))
+
+    # walk the FSM; simulate the DaemonSet controller restarting the operand
+    # pod with the new hash, and the validator coming up. With
+    # maxUnavailable=25% only one node is in flight at a time, so 4 nodes
+    # need ~4×7 steps.
+    for _ in range(36):
+        state = mgr.build_state()
+        mgr.apply_state(state, policy)
+        for i in (1, 2, 3, 4):
+            n = f"node-{i}"
+            if cluster.get_or_none("v1", "Pod", f"libtpu-{n}", NS) is None:
+                cluster.create(driver_pod(n, DESIRED_HASH))
+                cluster.create(validator_pod(n))
+
+    for i in (1, 2, 3, 4):
+        assert node_state(cluster, f"node-{i}") == us.STATE_DONE, f"node-{i}"
+    # workload pod was evicted along the way
+    assert cluster.get_or_none("v1", "Pod", "train-1", "default") is None
+    # nodes uncordoned at the end
+    for i in (1, 2, 3, 4):
+        node = cluster.get("v1", "Node", f"node-{i}")
+        assert not node.get("spec", {}).get("unschedulable", False)
+
+
+def test_max_parallel_throttling(cluster):
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=2, max_unavailable="100%"
+    )
+    state = mgr.build_state()
+    mgr.apply_state(state, policy)
+    active = sum(
+        1
+        for i in (1, 2, 3, 4)
+        if node_state(cluster, f"node-{i}") not in (us.STATE_UPGRADE_REQUIRED, None)
+    )
+    assert active == 2
+
+
+def test_skip_label(cluster):
+    node = cluster.get("v1", "Node", "node-1")
+    node["metadata"]["labels"][consts.UPGRADE_SKIP_LABEL] = "true"
+    cluster.update(node)
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    state = mgr.build_state()
+    assert state.count(us.STATE_UPGRADE_REQUIRED) == 3
+    pump(mgr, UpgradePolicySpec(auto_upgrade=True, max_unavailable="100%"), 2)
+    assert node_state(cluster, "node-1") is None
+
+
+def test_skip_drain_label(cluster):
+    node = cluster.get("v1", "Node", "node-2")
+    node["metadata"]["labels"][consts.UPGRADE_SKIP_DRAIN_LABEL] = "true"
+    cluster.update(node)
+    cluster.create(workload_pod("train-2", "node-2"))
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=4,
+        max_unavailable="100%",
+        drain=DrainSpec(enable=True),
+    )
+    pump(mgr, policy, 4)
+    # node-2 passed drain-required without evicting the workload...
+    assert cluster.get_or_none("v1", "Pod", "train-2", "default") is not None
+    # ...but pod-deletion-required still deleted TPU pods before that state.
+    # (drain skip only skips the drain step)
+
+
+def test_parse_max_unavailable():
+    assert us.parse_max_unavailable("25%", 4) == 1
+    assert us.parse_max_unavailable("50%", 4) == 2
+    assert us.parse_max_unavailable(2, 4) == 2
+    assert us.parse_max_unavailable("3", 4) == 3
+    assert us.parse_max_unavailable(None, 4) == 4
+    assert us.parse_max_unavailable("0%", 4) == 0
+
+
+def test_pod_requests_tpu():
+    assert us.pod_requests_tpu(workload_pod("x", "n"))
+    assert not us.pod_requests_tpu(
+        {"spec": {"containers": [{"resources": {"limits": {"cpu": "1"}}}]}}
+    )
+    sub = workload_pod("y", "n")
+    sub["spec"]["containers"][0]["resources"]["limits"] = {
+        "google.com/tpu-2x2": "1"
+    }
+    assert us.pod_requests_tpu(sub)
+
+
+def test_upgrade_reconciler_gates(cluster, monkeypatch):
+    import yaml, os
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+    from tpu_operator.upgrade.upgrade_controller import UpgradeReconciler
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "config", "samples", "v1_clusterpolicy.yaml")) as f:
+        cr = yaml.safe_load(f)
+    cr["spec"]["libtpu"]["upgradePolicy"] = {"autoUpgrade": False}
+    cluster.create(cr)
+    # seed a stale state label to prove cleanup
+    node = cluster.get("v1", "Node", "node-1")
+    node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] = us.STATE_DONE
+    cluster.update(node)
+
+    r = UpgradeReconciler(cluster, NS)
+    result = r.reconcile()
+    assert result.requeue_after is None
+    assert node_state(cluster, "node-1") is None
+
+    cr = cluster.get(consts.API_VERSION, "ClusterPolicy", "cluster-policy")
+    cr["spec"]["libtpu"]["upgradePolicy"] = {"autoUpgrade": True}
+    cluster.update(cr)
+    result = r.reconcile()
+    assert result.requeue_after == 120.0

@@ -1,0 +1,164 @@
+"""ClusterPolicy reconciler.
+
+TPU-native analogue of ``controllers/clusterpolicy_controller.go``:
+
+* singleton enforcement — extra CRs get status ``Ignored`` (``:104-109``);
+* every reconcile runs the full state machine (``:134-158``), relying on
+  hash idempotency to no-op;
+* 5 s requeue while NotReady (``:140,167``), 45 s poll when no TPU/NFD
+  labels are present yet (``:170-182``);
+* CR status + operator metrics updates (``:184-196``).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from tpu_operator import consts
+from tpu_operator.api.v1.clusterpolicy_types import State
+from tpu_operator.controllers.operator_metrics import OperatorMetrics
+from tpu_operator.controllers.state_manager import (
+    ClusterPolicyController,
+    has_tpu_labels,
+)
+from tpu_operator.kube.client import Client
+
+log = logging.getLogger("tpu-operator.reconcile")
+
+# requeue cadences (reference :140,167,173)
+REQUEUE_NOT_READY_S = 5.0
+REQUEUE_NO_LABELS_S = 45.0
+
+
+@dataclass
+class Result:
+    requeue_after: Optional[float] = None
+    ready: bool = False
+
+
+def select_primary(policies):
+    """Deterministic singleton selection shared by both reconcilers: oldest
+    creationTimestamp wins, name as tiebreak. resourceVersion is opaque and
+    bumped by our own status writes, so it must not participate."""
+    policies = sorted(
+        policies,
+        key=lambda o: (
+            o["metadata"].get("creationTimestamp", ""),
+            o["metadata"].get("name", ""),
+        ),
+    )
+    return policies[0], policies[1:]
+
+
+class ClusterPolicyReconciler:
+    def __init__(self, client: Client, assets_dir: Optional[str] = None):
+        self.client = client
+        self.ctrl = ClusterPolicyController(client, assets_dir=assets_dir)
+        self.metrics = OperatorMetrics()
+        self.ctrl.metrics = self.metrics
+
+    def reconcile(self, name: str = "") -> Result:
+        policies = self.client.list(consts.API_VERSION, consts.CLUSTER_POLICY_KIND)
+        if not policies:
+            self.metrics.observe_reconcile(-2)
+            return Result()
+        primary, extras = select_primary(policies)
+        for extra in extras:
+            self._set_status(extra, State.IGNORED)
+
+        try:
+            self.ctrl.init(primary)
+        except Exception:
+            log.exception("init failed")
+            self._set_status(primary, State.NOT_READY)
+            self.metrics.observe_reconcile(-1)
+            raise
+
+        # no TPU nodes and no hardware labels yet: keep polling NFD/GKE
+        # (reference :170-182); has_tpu_nodes was computed by init's
+        # label_tpu_nodes pass over the node list
+        if not self.ctrl.has_tpu_nodes:
+            self._set_status(primary, State.NOT_READY)
+            self.metrics.observe_reconcile(0)
+            self._update_fleet_metrics()
+            return Result(requeue_after=REQUEUE_NO_LABELS_S)
+
+        overall = State.READY
+        self.ctrl.idx = 0
+        while not self.ctrl.last():
+            state_name = self.ctrl.state_names[self.ctrl.idx]
+            status = self.ctrl.step()
+            self.metrics.set_state(
+                state_name,
+                {State.READY: 1, State.NOT_READY: 0}.get(status, -1),
+            )
+            if status == State.NOT_READY:
+                overall = State.NOT_READY
+                log.info("state %s not ready; will requeue", state_name)
+
+        self._set_status(primary, overall)
+        self._update_fleet_metrics()
+        if overall == State.NOT_READY:
+            self.metrics.observe_reconcile(0)
+            return Result(requeue_after=REQUEUE_NOT_READY_S)
+        self.metrics.observe_reconcile(1)
+        return Result(ready=True)
+
+    # ------------------------------------------------------------------
+    def _update_fleet_metrics(self) -> None:
+        if self.metrics and getattr(self.metrics, "tpu_nodes_total", None):
+            self.metrics.tpu_nodes_total.set(self.ctrl.tpu_node_count)
+            self.metrics.feature_labels_present.set(
+                1 if self.ctrl.has_tpu_nodes else 0
+            )
+            self.metrics.libtpu_generations_total.set(
+                len(self.ctrl.tpu_generations)
+            )
+
+    def _set_status(self, cp_obj, state: str) -> None:
+        """reference ``updateCRState`` (``:198``)."""
+        status = cp_obj.setdefault("status", {})
+        if status.get("state") == state and status.get("namespace") == (
+            self.ctrl.namespace or status.get("namespace")
+        ):
+            return
+        status["state"] = state
+        status["namespace"] = self.ctrl.namespace
+        try:
+            self.client.update_status(cp_obj)
+        except Exception:
+            log.exception("failed to update ClusterPolicy status")
+
+
+# ---------------------------------------------------------------------------
+# watch predicates (reference addWatchNewGPUNode, :220-314)
+# ---------------------------------------------------------------------------
+
+
+def node_event_needs_reconcile(event: str, old: Optional[dict], new: dict) -> bool:
+    """Label-diff predicate deciding whether a Node event triggers a
+    reconcile (reference ``:247-306``): new TPU node arrives, TPU labels
+    change, or operator labels were externally modified."""
+    if event == "ADDED":
+        return has_tpu_labels(new)
+    if event == "DELETED":
+        return True
+    if old is None:
+        return True
+    old_labels = old.get("metadata", {}).get("labels", {}) or {}
+    new_labels = new.get("metadata", {}).get("labels", {}) or {}
+    if old_labels == new_labels:
+        return False
+    watched_prefixes = (
+        "cloud.google.com/gke-tpu",
+        "feature.node.kubernetes.io/",
+        f"{consts.GROUP}/",
+    )
+    keys = set(old_labels) | set(new_labels)
+    return any(
+        old_labels.get(k) != new_labels.get(k)
+        for k in keys
+        if k.startswith(watched_prefixes)
+    )

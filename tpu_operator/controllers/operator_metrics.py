@@ -1,0 +1,100 @@
+"""Operator self-metrics.
+
+Same 17-series shape as the reference (``controllers/operator_metrics.go:13-185``),
+re-pointed at TPU concepts: reconciliation status/totals, TPU node gauge,
+feature-label presence, per-generation libtpu DaemonSet gauges (DTK slot),
+and six upgrade-FSM gauges.
+"""
+
+from __future__ import annotations
+
+import time
+
+try:
+    from prometheus_client import REGISTRY, Counter, Gauge
+
+    HAVE_PROM = True
+except Exception:  # pragma: no cover - prometheus always present in image
+    HAVE_PROM = False
+
+
+class OperatorMetrics:
+    """reference ``OperatorMetrics`` (``controllers/operator_metrics.go:13-34``)."""
+
+    _singleton = None
+
+    def __new__(cls, *a, **kw):
+        # prometheus_client registers collectors globally; keep one instance
+        if cls._singleton is None:
+            cls._singleton = super().__new__(cls)
+            cls._singleton._init_collectors()
+        return cls._singleton
+
+    def _init_collectors(self):
+        if not HAVE_PROM:
+            return
+        ns = "tpu_operator"
+        g = lambda name, doc, labels=(): Gauge(f"{ns}_{name}", doc, labels)  # noqa: E731
+        c = lambda name, doc: Counter(f"{ns}_{name}", doc)  # noqa: E731
+        # reconciliation (reference :64-100)
+        self.reconciliation_status = g(
+            "reconciliation_status",
+            "1 success / 0 not-ready / -1 failed / -2 no ClusterPolicy",
+        )
+        self.reconciliation_total = c(
+            "reconciliation_total", "Total reconciliation attempts"
+        )
+        self.reconciliation_failed = c(
+            "reconciliation_failed_total", "Failed reconciliations"
+        )
+        self.reconciliation_last_success = g(
+            "reconciliation_last_success_ts_seconds",
+            "Timestamp of last successful reconciliation",
+        )
+        # fleet (reference :52-57)
+        self.tpu_nodes_total = g("tpu_nodes_total", "Number of TPU nodes")
+        self.feature_labels_present = g(
+            "tpu_feature_labels",
+            "1 if TPU hardware labels (GKE/NFD) were found on any node",
+        )
+        # per-generation libtpu fan-out (DTK-gauge slot, reference :102-140)
+        self.libtpu_generations_total = g(
+            "libtpu_generations_total",
+            "Distinct TPU generations driving libtpu DaemonSet fan-out",
+        )
+        self.operand_states = g(
+            "operand_state",
+            "Per-state readiness: 1 ready / 0 not-ready / -1 disabled",
+            ("state",),
+        )
+        # upgrade FSM gauges (reference :142-185)
+        self.upgrades_in_progress = g(
+            "libtpu_upgrades_in_progress", "Nodes currently upgrading libtpu"
+        )
+        self.upgrades_done = g("libtpu_upgrades_done", "Nodes at upgrade-done")
+        self.upgrades_failed = g("libtpu_upgrades_failed", "Nodes at upgrade-failed")
+        self.upgrades_available = g(
+            "libtpu_upgrades_available", "Nodes allowed to start upgrading now"
+        )
+        self.upgrades_pending = g(
+            "libtpu_upgrades_pending", "Nodes with upgrade-required"
+        )
+        self.upgrades_unknown = g(
+            "libtpu_upgrades_unknown", "Nodes with unknown upgrade state"
+        )
+
+    # -- convenience ----------------------------------------------------
+    def observe_reconcile(self, status_value: int) -> None:
+        if not HAVE_PROM:
+            return
+        self.reconciliation_total.inc()
+        self.reconciliation_status.set(status_value)
+        if status_value == 1:
+            self.reconciliation_last_success.set(time.time())
+        elif status_value < 0:
+            self.reconciliation_failed.inc()
+
+    def set_state(self, state: str, value: int) -> None:
+        if not HAVE_PROM:
+            return
+        self.operand_states.labels(state=state).set(value)

@@ -1,0 +1,372 @@
+"""The ordered state machine.
+
+TPU-native analogue of ``controllers/state_manager.go``: a
+``ClusterPolicyController`` that ``init()``s cluster facts (k8s version,
+container runtime, TPU node labels, generation map), loads the ordered list
+of 17 states from asset directories (``controllers/state_manager.go:784-801``),
+and ``step()``s through them executing each state's controls and aggregating
+readiness (``:933-951``).
+
+Node labeling is the bus (``:473-572``): a node carrying GKE TPU labels (or
+the NFD PCI fallback) gets ``tpu.k8s.io/tpu.present=true`` plus per-component
+``tpu.k8s.io/tpu.deploy.*`` labels according to its workload configuration
+(container vs vm-passthrough, ``:354-414``), and a
+``tpu.k8s.io/tpu.generation`` label driving the per-generation libtpu
+fan-out (the reference's kernel-version map, ``object_controls.go:555-602``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from tpu_operator import consts
+from tpu_operator.api.v1.clusterpolicy_types import (
+    ClusterPolicy,
+    State,
+    clusterpolicy_from_obj,
+)
+from tpu_operator.controllers import object_controls
+from tpu_operator.controllers.resource_manager import (
+    Resources,
+    add_resources_controls,
+)
+from tpu_operator.kube.client import Client, Obj
+
+log = logging.getLogger("tpu-operator.state")
+
+DEFAULT_ASSETS_DIR = os.environ.get(
+    "TPU_OPERATOR_ASSETS", "/opt/tpu-operator"
+)
+
+# Ordered list of the 17 states (reference addState calls,
+# controllers/state_manager.go:784-801). Sandbox states run only when
+# sandboxWorkloads.enabled.
+STATE_ORDER: List[str] = [
+    "pre-requisites",
+    "state-operator-metrics",
+    "state-libtpu",
+    "state-runtime",
+    "state-operator-validation",
+    "state-device-plugin",
+    "state-metricsd",
+    "state-metrics-exporter",
+    "tpu-feature-discovery",
+    "state-slice-manager",
+    "state-node-status-exporter",
+    "state-vm-manager",
+    "state-vm-device-manager",
+    "state-sandbox-validation",
+    "state-vfio-manager",
+    "state-sandbox-device-plugin",
+    "state-kata-manager",
+]
+
+SANDBOX_STATES: Set[str] = {
+    "state-vm-manager",
+    "state-vm-device-manager",
+    "state-sandbox-validation",
+    "state-vfio-manager",
+    "state-sandbox-device-plugin",
+    "state-kata-manager",
+}
+
+
+def has_tpu_labels(node: Obj) -> bool:
+    """Hardware-fact check (reference ``hasGPULabels``,
+    ``controllers/state_manager.go:497-519``): GKE TPU labels or NFD PCI
+    vendor 1ae0."""
+    labels = node.get("metadata", {}).get("labels", {}) or {}
+    if labels.get(consts.GKE_TPU_ACCELERATOR_LABEL):
+        return True
+    if labels.get(consts.NFD_TPU_PCI_LABEL) == "true":
+        return True
+    return False
+
+
+def node_generation(node: Obj) -> Optional[str]:
+    """TPU generation from the GKE accelerator label (per-kernel analogue)."""
+    labels = node.get("metadata", {}).get("labels", {}) or {}
+    acc = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, "")
+    if acc in consts.GKE_ACCELERATOR_TO_GENERATION:
+        return consts.GKE_ACCELERATOR_TO_GENERATION[acc]
+    gen = labels.get(consts.TFD_CHIP_TYPE_LABEL)
+    if gen in consts.TPU_GENERATIONS:
+        return gen
+    return None
+
+
+def node_workload_config(node: Obj) -> str:
+    """Per-node workload override (reference ``gpuWorkloadConfiguration``,
+    ``controllers/state_manager.go:354-414``)."""
+    labels = node.get("metadata", {}).get("labels", {}) or {}
+    cfg = labels.get(consts.WORKLOAD_CONFIG_LABEL, consts.WORKLOAD_CONTAINER)
+    if cfg not in (consts.WORKLOAD_CONTAINER, consts.WORKLOAD_VM_PASSTHROUGH):
+        log.warning(
+            "node %s: invalid workload config %r; using %s",
+            node["metadata"]["name"],
+            cfg,
+            consts.WORKLOAD_CONTAINER,
+        )
+        cfg = consts.WORKLOAD_CONTAINER
+    return cfg
+
+
+class ClusterPolicyController:
+    """reference ``ClusterPolicyController`` (``controllers/state_manager.go:133-156``)."""
+
+    def __init__(self, client: Client, assets_dir: Optional[str] = None):
+        self.client = client
+        self.assets_dir = assets_dir or (
+            DEFAULT_ASSETS_DIR
+            if os.path.isdir(DEFAULT_ASSETS_DIR)
+            else os.path.join(os.path.dirname(__file__), "..", "..", "assets")
+        )
+        self.namespace = ""
+        self.cp: ClusterPolicy = ClusterPolicy()
+        self.cp_obj: Obj = {}
+        self.openshift = False
+        self.runtime = ""
+        self.k8s_version = ""
+        self.has_tpu_nodes = False
+        self.has_nfd_labels = False
+        self.tpu_node_count = 0
+        self.tpu_generations: Set[str] = set()
+        self._nodes_cache: List[Obj] = []
+        self.state_names: List[str] = []
+        self.controls: Dict[str, List[Tuple[str, Obj]]] = {}
+        self.resources: Dict[str, Resources] = {}
+        self.idx = 0
+        self.metrics = None  # wired by the reconciler
+
+    # ------------------------------------------------------------------
+    # init (reference controllers/state_manager.go:743-887)
+    # ------------------------------------------------------------------
+    def init(self, cp_obj: Obj) -> None:
+        self.cp_obj = cp_obj
+        self.cp = clusterpolicy_from_obj(cp_obj)
+        self.idx = 0
+
+        self.namespace = os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "")
+        if not self.namespace:
+            # reference exits the process so the pod CrashLoops by design
+            # (controllers/state_manager.go:750-758)
+            raise RuntimeError(
+                f"{consts.OPERATOR_NAMESPACE_ENV} environment variable not set"
+            )
+
+        self.k8s_version = self._get_kubernetes_version()
+
+        if not self.state_names:
+            self._add_states()
+
+        if self.cp.spec.psa.is_enabled():
+            self.set_pod_security_labels_for_namespace()
+
+        self.label_tpu_nodes()
+        self.apply_upgrade_auto_annotation()
+        self.runtime = self.get_runtime()
+        log.info(
+            "cluster init: k8s=%s runtime=%s tpuNodes=%s generations=%s",
+            self.k8s_version,
+            self.runtime,
+            self.has_tpu_nodes,
+            sorted(self.tpu_generations),
+        )
+
+    def _get_kubernetes_version(self) -> str:
+        # no /version endpoint in the Client interface; derive from nodes
+        for node in self.client.list("v1", "Node"):
+            v = node.get("status", {}).get("nodeInfo", {}).get("kubeletVersion")
+            if v:
+                return v
+        return ""
+
+    def _add_states(self) -> None:
+        """Load every state's assets (reference ``addState`` ×17,
+        ``controllers/state_manager.go:784-801``)."""
+        for state in STATE_ORDER:
+            path = os.path.join(self.assets_dir, state)
+            if not os.path.isdir(path):
+                raise FileNotFoundError(f"asset dir missing: {path}")
+            res, controls = add_resources_controls(path, self.openshift)
+            self.state_names.append(state)
+            self.resources[state] = res
+            self.controls[state] = controls
+
+    # ------------------------------------------------------------------
+    # node labeling (reference labelGPUNodes, :473-572)
+    # ------------------------------------------------------------------
+    def label_tpu_nodes(self) -> None:
+        self.has_tpu_nodes = False
+        self.has_nfd_labels = False
+        self.tpu_generations = set()
+        self.tpu_node_count = 0
+        self._nodes_cache = self.client.list("v1", "Node")
+        for node in self._nodes_cache:
+            labels = node["metadata"].setdefault("labels", {})
+            if any(k.startswith("feature.node.kubernetes.io/") for k in labels):
+                self.has_nfd_labels = True
+            changed = False
+            if has_tpu_labels(node):
+                self.has_tpu_nodes = True
+                self.tpu_node_count += 1
+                gen = node_generation(node)
+                if gen:
+                    self.tpu_generations.add(gen)
+                    if labels.get(f"{consts.GROUP}/tpu.generation") != gen:
+                        labels[f"{consts.GROUP}/tpu.generation"] = gen
+                        changed = True
+                if labels.get(consts.TPU_PRESENT_LABEL) != "true":
+                    labels[consts.TPU_PRESENT_LABEL] = "true"
+                    changed = True
+                changed |= self._update_state_labels(node)
+            elif labels.get(consts.TPU_PRESENT_LABEL):
+                # TPU removed from node: strip all operator labels
+                # (reference removeAllGPUStateLabels)
+                for key in list(labels):
+                    if key.startswith(f"{consts.GROUP}/"):
+                        del labels[key]
+                        changed = True
+            if changed:
+                self.client.update(node)
+
+    def _update_state_labels(self, node: Obj) -> bool:
+        """Per-workload-config deploy labels (reference
+        ``gpuWorkloadConfiguration.updateGPUStateLabels``, ``:354-414``)."""
+        cfg = node_workload_config(node)
+        if cfg == consts.WORKLOAD_VM_PASSTHROUGH and self.cp.spec.sandbox_enabled():
+            enable = consts.VM_WORKLOAD_COMPONENTS
+            disable = consts.CONTAINER_WORKLOAD_COMPONENTS
+        else:
+            enable = consts.CONTAINER_WORKLOAD_COMPONENTS
+            disable = consts.VM_WORKLOAD_COMPONENTS
+        labels = node["metadata"]["labels"]
+        changed = False
+        for comp in enable:
+            key = consts.DEPLOY_LABEL_PREFIX + comp
+            # don't fight a human override of "false"/"paused-*"
+            # (reference keeps existing explicit disables)
+            if labels.get(key) in ("false",) or str(
+                labels.get(key, "")
+            ).startswith("paused-"):
+                continue
+            if labels.get(key) != "true":
+                labels[key] = "true"
+                changed = True
+        for comp in disable:
+            key = consts.DEPLOY_LABEL_PREFIX + comp
+            if key in labels:
+                del labels[key]
+                changed = True
+        return changed
+
+    # ------------------------------------------------------------------
+    # PSA labeling (reference setPodSecurityLabelsForNamespace, :590-638)
+    # ------------------------------------------------------------------
+    def set_pod_security_labels_for_namespace(self) -> None:
+        ns = self.client.get_or_none("v1", "Namespace", self.namespace)
+        if ns is None:
+            return
+        labels = ns["metadata"].setdefault("labels", {})
+        desired = {
+            consts.PSA_LABEL_PREFIX + "enforce": "privileged",
+            consts.PSA_LABEL_PREFIX + "audit": "privileged",
+            consts.PSA_LABEL_PREFIX + "warn": "privileged",
+        }
+        if any(labels.get(k) != v for k, v in desired.items()):
+            labels.update(desired)
+            self.client.update(ns)
+
+    # ------------------------------------------------------------------
+    # upgrade annotation (reference applyDriverAutoUpgradeAnnotation, :416-469)
+    # ------------------------------------------------------------------
+    def apply_upgrade_auto_annotation(self) -> None:
+        pol = self.cp.spec.libtpu.upgrade_policy
+        enabled = bool(pol and pol.is_auto_upgrade_enabled())
+        obj = self.client.get_or_none(
+            consts.API_VERSION, consts.CLUSTER_POLICY_KIND, self.cp.name
+        )
+        if obj is None:
+            return
+        ann = obj["metadata"].setdefault("annotations", {})
+        want = "true" if enabled else None
+        if want is None and consts.UPGRADE_ENABLED_ANNOTATION in ann:
+            del ann[consts.UPGRADE_ENABLED_ANNOTATION]
+            self.client.update(obj)
+        elif want and ann.get(consts.UPGRADE_ENABLED_ANNOTATION) != want:
+            ann[consts.UPGRADE_ENABLED_ANNOTATION] = want
+            self.client.update(obj)
+
+    # ------------------------------------------------------------------
+    # runtime discovery (reference getRuntime, :704-741)
+    # ------------------------------------------------------------------
+    def get_runtime(self) -> str:
+        runtime = self.cp.spec.operator.default_runtime or "containerd"
+        for node in self._nodes_cache or self.client.list("v1", "Node"):
+            if not has_tpu_labels(node):
+                continue
+            info = (
+                node.get("status", {})
+                .get("nodeInfo", {})
+                .get("containerRuntimeVersion", "")
+            )
+            for name in ("containerd", "docker", "cri-o", "crio"):
+                if info.startswith(name):
+                    return "crio" if name in ("cri-o", "crio") else name
+        return runtime
+
+    # ------------------------------------------------------------------
+    # state gating (reference isStateEnabled, :964-1004)
+    # ------------------------------------------------------------------
+    def is_state_enabled(self, state_name: str) -> bool:
+        spec = self.cp.spec
+        mapping = {
+            "pre-requisites": True,
+            "state-operator-metrics": True,
+            "state-libtpu": spec.libtpu.is_enabled(),
+            "state-runtime": spec.runtime.is_enabled(),
+            # operator validation cannot be disabled (reference :996-997)
+            "state-operator-validation": True,
+            "state-device-plugin": spec.device_plugin.is_enabled(),
+            "state-metricsd": spec.metricsd.is_enabled(),
+            "state-metrics-exporter": spec.metrics_exporter.is_enabled(),
+            "tpu-feature-discovery": spec.tfd.is_enabled(),
+            "state-slice-manager": spec.slice_manager.is_enabled(),
+            "state-node-status-exporter": spec.node_status_exporter.is_enabled(),
+            "state-vm-manager": spec.sandbox_enabled()
+            and spec.vm_manager.is_enabled(),
+            "state-vm-device-manager": spec.sandbox_enabled()
+            and spec.vm_device_manager.is_enabled(),
+            "state-sandbox-validation": spec.sandbox_enabled(),
+            "state-vfio-manager": spec.sandbox_enabled()
+            and spec.vfio_manager.is_enabled(),
+            "state-sandbox-device-plugin": spec.sandbox_enabled()
+            and spec.sandbox_device_plugin.is_enabled(),
+            "state-kata-manager": spec.sandbox_enabled()
+            and spec.kata_manager.is_enabled(),
+        }
+        return bool(mapping.get(state_name, True))
+
+    # ------------------------------------------------------------------
+    # stepping (reference step()/last(), :933-964)
+    # ------------------------------------------------------------------
+    def step(self) -> str:
+        """Run all controls of the current state; aggregate readiness
+        (reference ``step``, ``controllers/state_manager.go:933-951``)."""
+        state = self.state_names[self.idx]
+        overall = State.READY
+        for control_name, obj in self.controls[state]:
+            fn = object_controls.CONTROLS[control_name]
+            status = fn(self, state, obj)
+            if status == State.NOT_READY:
+                overall = State.NOT_READY
+        self.idx += 1
+        return overall
+
+    def last(self) -> bool:
+        return self.idx == len(self.state_names)
+
+    def current_state(self) -> str:
+        return self.state_names[min(self.idx, len(self.state_names) - 1)]

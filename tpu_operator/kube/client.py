@@ -208,7 +208,12 @@ class FakeClient(Client):
     # -- writes ---------------------------------------------------------
     def _stamp(self, obj: Obj) -> None:
         self._rv += 1
-        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+        meta = obj.setdefault("metadata", {})
+        meta["resourceVersion"] = str(self._rv)
+        # creationTimestamp is set once; the monotonic counter keeps ordering
+        # deterministic even within one wall-clock second
+        if "creationTimestamp" not in meta:
+            meta["creationTimestamp"] = f"fake-{self._rv:012d}"
 
     def create(self, obj):
         with self._lock:
@@ -239,6 +244,10 @@ class FakeClient(Client):
             # status is a subresource: plain updates preserve existing status
             if "status" in existing and "status" not in stored:
                 stored["status"] = copy.deepcopy(existing["status"])
+            if "creationTimestamp" in existing["metadata"]:
+                stored["metadata"]["creationTimestamp"] = existing["metadata"][
+                    "creationTimestamp"
+                ]
             self._stamp(stored)
             self._store[key] = stored
             self._notify("MODIFIED", stored)

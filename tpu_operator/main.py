@@ -1,0 +1,206 @@
+"""Operator process entrypoint (reference ``main.go:60-159``).
+
+Builds the manager (metrics :8080, probes :8081, optional leader election),
+registers the ClusterPolicy and Upgrade reconcilers, wires watch events into
+the workqueue, and blocks on signals.
+
+``--fake`` runs against an in-memory API server seeded from the sample CR —
+the sandbox/dev drive path (no cluster required).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import threading
+import time
+
+import yaml
+
+from tpu_operator import consts
+from tpu_operator.controllers.clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+    node_event_needs_reconcile,
+)
+from tpu_operator.manager import Manager
+
+CP_KEY = "clusterpolicy"
+UPGRADE_KEY = "upgrade"
+
+
+def build_args(argv=None):
+    p = argparse.ArgumentParser("tpu-operator")
+    p.add_argument("--metrics-port", type=int, default=8080)
+    p.add_argument("--probe-port", type=int, default=8081)
+    p.add_argument("--leader-election", action="store_true")
+    p.add_argument("--assets", default=None, help="asset dir override")
+    p.add_argument(
+        "--fake",
+        action="store_true",
+        help="run against an in-memory API server seeded with the sample CR",
+    )
+    p.add_argument(
+        "--simulate-kubelet",
+        action="store_true",
+        help="(with --fake) mark DaemonSets scheduled/available and run "
+        "their pods, so the cluster converges to Ready",
+    )
+    p.add_argument("--log-level", default="INFO")
+    return p.parse_args(argv)
+
+
+def make_fake_client():
+    from tests.conftest import make_tpu_node  # dev-only dependency
+    from tpu_operator.kube import FakeClient
+
+    ns = os.environ.setdefault(consts.OPERATOR_NAMESPACE_ENV, consts.DEFAULT_NAMESPACE)
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": ns}},
+            make_tpu_node("fake-tpu-node-1"),
+        ]
+    )
+    sample = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "config",
+        "samples",
+        "v1_clusterpolicy.yaml",
+    )
+    with open(sample) as f:
+        cr = yaml.safe_load(f)
+    cr["metadata"]["uid"] = "fake-uid"
+    client.create(cr)
+    return client
+
+
+def _simulate_kubelet(client, namespace: str) -> None:
+    """Dev-mode kubelet: marks every DaemonSet fully scheduled/available and
+    keeps one Running pod per OnDelete operand at the current revision."""
+    from tpu_operator import consts as c
+
+    while True:
+        try:
+            for ds in client.list("apps/v1", "DaemonSet", namespace):
+                if not ds.get("status"):
+                    ds["status"] = {
+                        "desiredNumberScheduled": 1,
+                        "numberUnavailable": 0,
+                        "updatedNumberScheduled": 1,
+                    }
+                    client.update_status(ds)
+                if ds["spec"].get("updateStrategy", {}).get("type") != "OnDelete":
+                    continue
+                app = ds["spec"]["selector"]["matchLabels"]["app"]
+                h = (
+                    ds["spec"]["template"]["metadata"]
+                    .get("annotations", {})
+                    .get(c.LAST_APPLIED_HASH_ANNOTATION)
+                )
+                name = f"{app}-0"
+                existing = client.get_or_none("v1", "Pod", name, namespace)
+                if existing is None:
+                    client.create(
+                        {
+                            "apiVersion": "v1",
+                            "kind": "Pod",
+                            "metadata": {
+                                "name": name,
+                                "namespace": namespace,
+                                "labels": {"app": app},
+                                "annotations": {c.LAST_APPLIED_HASH_ANNOTATION: h},
+                            },
+                            "spec": {"nodeName": "fake-tpu-node-1"},
+                            "status": {"phase": "Running"},
+                        }
+                    )
+        except Exception:
+            logging.getLogger("tpu-operator").exception("kubelet sim error")
+        time.sleep(1)
+
+
+def main(argv=None) -> int:
+    args = build_args(argv)
+    logging.basicConfig(
+        level=args.log_level.upper(),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    log = logging.getLogger("tpu-operator")
+
+    if args.fake:
+        client = make_fake_client()
+    else:
+        from tpu_operator.kube.rest import RestClient
+
+        try:
+            client = RestClient()
+        except FileNotFoundError as e:
+            log.error("not running in a cluster (%s); use --fake for dev", e)
+            return 1
+
+    namespace = os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "")
+    if not namespace:
+        log.error("%s must be set", consts.OPERATOR_NAMESPACE_ENV)
+        return 1
+
+    mgr = Manager(
+        client,
+        namespace,
+        metrics_port=args.metrics_port,
+        probe_port=args.probe_port,
+        leader_election=args.leader_election,
+    )
+    reconciler = ClusterPolicyReconciler(client, assets_dir=args.assets)
+    mgr.add_reconciler(CP_KEY, lambda _key: reconciler.reconcile())
+
+    from tpu_operator.upgrade.upgrade_controller import UpgradeReconciler
+
+    upgrade = UpgradeReconciler(client, namespace)
+    mgr.add_reconciler(UPGRADE_KEY, lambda _key: upgrade.reconcile())
+
+    # watches: fake client pushes events; a real deployment would run watch
+    # loops against the API server here (list+watch with resourceVersion).
+    if hasattr(client, "add_watcher"):
+        node_cache = {}
+
+        def on_event(event, obj):
+            kind = obj.get("kind")
+            if kind == "ClusterPolicy":
+                mgr.enqueue(CP_KEY)
+                mgr.enqueue(UPGRADE_KEY)
+            elif kind == "Node":
+                name = obj["metadata"]["name"]
+                old = node_cache.get(name)
+                node_cache[name] = None if event == "DELETED" else obj
+                if node_event_needs_reconcile(event, old, obj):
+                    mgr.enqueue(CP_KEY)
+            elif kind == "DaemonSet":
+                # owned-operand drift (reference watch on owned DaemonSets)
+                mgr.enqueue(CP_KEY, delay=0.1)
+
+        client.add_watcher(on_event)
+    else:
+        def poll():
+            while True:
+                mgr.enqueue(CP_KEY)
+                mgr.enqueue(UPGRADE_KEY)
+                time.sleep(30)
+
+        threading.Thread(target=poll, daemon=True).start()
+
+    if args.fake and args.simulate_kubelet:
+        threading.Thread(
+            target=_simulate_kubelet, args=(client, namespace), daemon=True
+        ).start()
+
+    mgr.enqueue(CP_KEY)
+    mgr.enqueue(UPGRADE_KEY)
+    mgr.install_signal_handlers()
+    log.info("tpu-operator starting (namespace=%s fake=%s)", namespace, args.fake)
+    mgr.run_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,277 @@
+"""Controller manager runtime.
+
+The Python analogue of the reference's controller-runtime Manager
+(``main.go:88-159``): a rate-limited workqueue fed by watch events, health
+probes on :8081, Prometheus metrics on :8080, Lease-based leader election,
+and signal handling.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import socket
+import threading
+import time
+from datetime import datetime, timezone
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from tpu_operator import consts
+from tpu_operator.kube.client import Client
+
+log = logging.getLogger("tpu-operator.manager")
+
+
+class RateLimiter:
+    """Per-item exponential backoff, 100ms base to 3s cap (reference
+    ``controllers/clusterpolicy_controller.go:45-48``)."""
+
+    def __init__(self, base: float = 0.1, cap: float = 3.0):
+        self.base = base
+        self.cap = cap
+        self._failures = {}
+        self._lock = threading.Lock()
+
+    def when(self, item) -> float:
+        with self._lock:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+            return min(self.base * (2**n), self.cap)
+
+    def forget(self, item) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+
+class WorkQueue:
+    """Deduplicating delayed workqueue (client-go semantics: an item queued
+    while pending coalesces into one execution)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._ready = []  # (due_time, item)
+        self._pending = set()
+
+    def add(self, item, delay: float = 0.0) -> None:
+        due = time.monotonic() + delay
+        with self._cond:
+            if item in self._pending:
+                # an Add supersedes a pending AddAfter with a later due time
+                # (client-go semantics): a watch event must not wait out a
+                # long requeue timer
+                for i, (t, existing) in enumerate(self._ready):
+                    if existing == item and due < t:
+                        self._ready[i] = (due, item)
+                        self._cond.notify()
+                return
+            self._pending.add(item)
+            self._ready.append((due, item))
+            self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None):
+        deadline = time.monotonic() + timeout if timeout else None
+        with self._cond:
+            while True:
+                now = time.monotonic()
+                due = [e for e in self._ready if e[0] <= now]
+                if due:
+                    entry = min(due)
+                    self._ready.remove(entry)
+                    self._pending.discard(entry[1])
+                    return entry[1]
+                wait = None
+                if self._ready:
+                    wait = max(0.0, min(e[0] for e in self._ready) - now)
+                if deadline is not None:
+                    remaining = deadline - now
+                    if remaining <= 0:
+                        return None
+                    wait = min(wait, remaining) if wait is not None else remaining
+                self._cond.wait(wait)
+
+    def __len__(self):
+        with self._cond:
+            return len(self._ready)
+
+
+class LeaderElector:
+    """Lease-based leader election (reference ``main.go:97-107``)."""
+
+    def __init__(
+        self,
+        client: Client,
+        namespace: str,
+        name: str = "tpu-operator-leader",
+        identity: Optional[str] = None,
+        lease_seconds: int = 30,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.name = name
+        self.identity = identity or f"{socket.gethostname()}-{id(self)}"
+        self.lease_seconds = lease_seconds
+
+    def try_acquire(self) -> bool:
+        now = datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+        lease = self.client.get_or_none(
+            "coordination.k8s.io/v1", "Lease", self.name, self.namespace
+        )
+        if lease is None:
+            try:
+                self.client.create(
+                    {
+                        "apiVersion": "coordination.k8s.io/v1",
+                        "kind": "Lease",
+                        "metadata": {"name": self.name, "namespace": self.namespace},
+                        "spec": {
+                            "holderIdentity": self.identity,
+                            "leaseDurationSeconds": self.lease_seconds,
+                            "renewTime": now,
+                        },
+                    }
+                )
+                return True
+            except Exception:
+                return False
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity")
+        renew = spec.get("renewTime", "")
+        expired = True
+        if renew:
+            try:
+                then = datetime.strptime(renew, "%Y-%m-%dT%H:%M:%S.%fZ").replace(
+                    tzinfo=timezone.utc
+                )
+                expired = (
+                    datetime.now(timezone.utc) - then
+                ).total_seconds() > spec.get("leaseDurationSeconds", 30)
+            except ValueError:
+                pass
+        if holder == self.identity or expired or not holder:
+            spec.update({"holderIdentity": self.identity, "renewTime": now})
+            lease["spec"] = spec
+            try:
+                self.client.update(lease)
+                return True
+            except Exception:
+                return False
+        return False
+
+
+class _HealthHandler(BaseHTTPRequestHandler):
+    manager: "Manager" = None
+
+    def do_GET(self):  # noqa: N802
+        healthy = self.manager is None or self.manager.healthy()
+        code = 200 if healthy else 500
+        body = b"ok" if healthy else b"unhealthy"
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # silence
+        pass
+
+
+class Manager:
+    """Runs reconcilers off a shared watch-fed workqueue."""
+
+    def __init__(
+        self,
+        client: Client,
+        namespace: str,
+        metrics_port: int = 8080,
+        probe_port: int = 8081,
+        leader_election: bool = False,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.metrics_port = metrics_port
+        self.probe_port = probe_port
+        self.leader_election = leader_election
+        self.queue = WorkQueue()
+        self.rate_limiter = RateLimiter()
+        self._reconcilers = {}
+        self._stop = threading.Event()
+        self._last_reconcile_ok = True
+        self._threads = []
+
+    def add_reconciler(self, key: str, fn: Callable[[str], object]) -> None:
+        """``fn(name) -> Result`` (with optional ``requeue_after``)."""
+        self._reconcilers[key] = fn
+
+    def enqueue(self, key: str, delay: float = 0.0) -> None:
+        self.queue.add(key, delay)
+
+    def healthy(self) -> bool:
+        return not self._stop.is_set()
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if self.metrics_port:
+            try:
+                from prometheus_client import start_http_server
+
+                start_http_server(self.metrics_port)
+            except Exception:
+                log.exception("metrics server failed to start")
+        if self.probe_port:
+            handler = type("H", (_HealthHandler,), {"manager": self})
+            server = ThreadingHTTPServer(("0.0.0.0", self.probe_port), handler)
+            t = threading.Thread(target=server.serve_forever, daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self.leader_election:
+            elector = LeaderElector(self.client, self.namespace)
+            log.info("waiting for leader lease as %s", elector.identity)
+            while not self._stop.is_set() and not elector.try_acquire():
+                time.sleep(2)
+            # keep renewing in the background
+            def renew():
+                while not self._stop.is_set():
+                    elector.try_acquire()
+                    time.sleep(max(1, elector.lease_seconds // 3))
+
+            t = threading.Thread(target=renew, daemon=True)
+            t.start()
+            self._threads.append(t)
+        worker = threading.Thread(target=self._run_worker, daemon=True)
+        worker.start()
+        self._threads.append(worker)
+
+    def install_signal_handlers(self) -> None:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: self.stop())
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run_forever(self) -> None:
+        self.start()
+        while not self._stop.is_set():
+            time.sleep(0.5)
+
+    # ------------------------------------------------------------------
+    def _run_worker(self) -> None:
+        """MaxConcurrentReconciles=1 — one worker serializes everything
+        (reference ``controllers/clusterpolicy_controller.go:319``)."""
+        while not self._stop.is_set():
+            item = self.queue.get(timeout=0.5)
+            if item is None:
+                continue
+            fn = self._reconcilers.get(item)
+            if fn is None:
+                continue
+            try:
+                result = fn(item)
+                self.rate_limiter.forget(item)
+                requeue = getattr(result, "requeue_after", None)
+                if requeue:
+                    self.queue.add(item, requeue)
+                self._last_reconcile_ok = True
+            except Exception:
+                log.exception("reconcile %s failed", item)
+                self._last_reconcile_ok = False
+                self.queue.add(item, self.rate_limiter.when(item))

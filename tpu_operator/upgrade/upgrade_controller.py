@@ -1,0 +1,70 @@
+"""Upgrade reconciler (reference ``controllers/upgrade_controller.go``).
+
+Gated on ``libtpu.upgradePolicy.autoUpgrade`` and sandbox-off
+(``:93-111``); builds cluster state from libtpu operand pods, applies the
+FSM with maxUnavailable throttling (``:125-153``), re-queues every 2 min
+(``:153-163``); on disable, removes per-node state labels (``:168-194``).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from tpu_operator import consts
+from tpu_operator.api.v1.clusterpolicy_types import clusterpolicy_from_obj
+from tpu_operator.controllers.operator_metrics import OperatorMetrics
+from tpu_operator.kube.client import Client
+from tpu_operator.upgrade import upgrade_state as us
+
+log = logging.getLogger("tpu-operator.upgrade")
+
+REQUEUE_S = 120.0  # reference :53,163
+
+
+@dataclass
+class Result:
+    requeue_after: Optional[float] = None
+
+
+class UpgradeReconciler:
+    def __init__(self, client: Client, namespace: str):
+        self.client = client
+        self.namespace = namespace
+        self.manager = us.ClusterUpgradeStateManager(client, namespace)
+        self.metrics = OperatorMetrics()
+
+    def reconcile(self) -> Result:
+        policies = self.client.list(consts.API_VERSION, consts.CLUSTER_POLICY_KIND)
+        if not policies:
+            return Result()
+        from tpu_operator.controllers.clusterpolicy_controller import select_primary
+
+        primary, _ = select_primary(policies)
+        cp = clusterpolicy_from_obj(primary)
+        pol = cp.spec.libtpu.upgrade_policy
+        if (
+            cp.spec.sandbox_enabled()
+            or pol is None
+            or not pol.is_auto_upgrade_enabled()
+        ):
+            self.manager.cleanup_state_labels()
+            return Result()
+
+        state = self.manager.build_state()
+        self.manager.apply_state(state, pol)
+        self._update_metrics(state)
+        return Result(requeue_after=REQUEUE_S)
+
+    def _update_metrics(self, state: us.ClusterUpgradeState) -> None:
+        m = self.metrics
+        if not getattr(m, "upgrades_in_progress", None):
+            return
+        in_progress = sum(state.count(s) for s in us.ACTIVE_STATES)
+        m.upgrades_in_progress.set(in_progress)
+        m.upgrades_done.set(state.count(us.STATE_DONE))
+        m.upgrades_failed.set(state.count(us.STATE_FAILED))
+        m.upgrades_pending.set(state.count(us.STATE_UPGRADE_REQUIRED))
+        m.upgrades_unknown.set(state.count(us.STATE_UNKNOWN))
+        m.upgrades_available.set(max(0, state.count(us.STATE_UPGRADE_REQUIRED)))

@@ -1,0 +1,416 @@
+"""Rolling libtpu upgrade engine — per-node FSM.
+
+TPU-native analogue of the vendored upgrade library
+(``vendor/github.com/NVIDIA/k8s-operator-libs/pkg/upgrade/``): every node
+carries an upgrade-state label driven through
+
+    upgrade-required → cordon-required → wait-for-jobs-required →
+    pod-deletion-required → drain-required → pod-restart-required →
+    validation-required → uncordon-required → upgrade-done | upgrade-failed
+
+(``consts.go:33-58``), with cordon/drain/pod managers issuing the node-level
+disruption, ``maxParallelUpgrades``/``maxUnavailable`` throttling
+(``upgrade_state.go:59-110``), skip-labels as escape hatches
+(``consts.go:22-26``), and node labels as the durable store so the FSM
+survives operator restarts (``node_upgrade_state_provider.go``).
+
+State is recomputed level-triggered: ``build_state`` groups libtpu operand
+pods per node; ``apply_state`` advances each node at most one step per
+reconcile.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu_operator import consts
+from tpu_operator.kube.client import Client, Obj
+
+log = logging.getLogger("tpu-operator.upgrade")
+
+# FSM states (reference consts.go:33-58)
+STATE_UNKNOWN = ""
+STATE_UPGRADE_REQUIRED = "upgrade-required"
+STATE_CORDON_REQUIRED = "cordon-required"
+STATE_WAIT_FOR_JOBS_REQUIRED = "wait-for-jobs-required"
+STATE_POD_DELETION_REQUIRED = "pod-deletion-required"
+STATE_DRAIN_REQUIRED = "drain-required"
+STATE_POD_RESTART_REQUIRED = "pod-restart-required"
+STATE_VALIDATION_REQUIRED = "validation-required"
+STATE_UNCORDON_REQUIRED = "uncordon-required"
+STATE_DONE = "upgrade-done"
+STATE_FAILED = "upgrade-failed"
+
+ACTIVE_STATES = [
+    STATE_CORDON_REQUIRED,
+    STATE_WAIT_FOR_JOBS_REQUIRED,
+    STATE_POD_DELETION_REQUIRED,
+    STATE_DRAIN_REQUIRED,
+    STATE_POD_RESTART_REQUIRED,
+    STATE_VALIDATION_REQUIRED,
+    STATE_UNCORDON_REQUIRED,
+]
+
+
+@dataclass
+class NodeUpgradeState:
+    node: Obj
+    driver_pod: Optional[Obj] = None
+    state: str = STATE_UNKNOWN
+
+
+@dataclass
+class ClusterUpgradeState:
+    node_states: Dict[str, List[NodeUpgradeState]] = field(default_factory=dict)
+
+    def all(self) -> List[NodeUpgradeState]:
+        return [s for states in self.node_states.values() for s in states]
+
+    def count(self, state: str) -> int:
+        return len(self.node_states.get(state, []))
+
+
+class NodeStateProvider:
+    """Node labels are the durable FSM store (reference
+    ``node_upgrade_state_provider.go``)."""
+
+    def __init__(self, client: Client):
+        self.client = client
+
+    def get_state(self, node: Obj) -> str:
+        return (
+            node.get("metadata", {}).get("labels", {}) or {}
+        ).get(consts.UPGRADE_STATE_LABEL, STATE_UNKNOWN)
+
+    def set_state(self, node: Obj, state: str) -> None:
+        fresh = self.client.get("v1", "Node", node["metadata"]["name"])
+        labels = fresh["metadata"].setdefault("labels", {})
+        if labels.get(consts.UPGRADE_STATE_LABEL) == state:
+            return
+        labels[consts.UPGRADE_STATE_LABEL] = state
+        self.client.update(fresh)
+        log.info(
+            "node %s upgrade-state -> %s", node["metadata"]["name"], state
+        )
+
+    def clear_state(self, node: Obj) -> None:
+        fresh = self.client.get("v1", "Node", node["metadata"]["name"])
+        labels = fresh["metadata"].setdefault("labels", {})
+        if consts.UPGRADE_STATE_LABEL in labels:
+            del labels[consts.UPGRADE_STATE_LABEL]
+            self.client.update(fresh)
+
+
+class CordonManager:
+    """reference ``cordon_manager.go``."""
+
+    def __init__(self, client: Client):
+        self.client = client
+
+    def cordon(self, node_name: str) -> None:
+        self._set_unschedulable(node_name, True)
+
+    def uncordon(self, node_name: str) -> None:
+        self._set_unschedulable(node_name, False)
+
+    def _set_unschedulable(self, node_name: str, value: bool) -> None:
+        node = self.client.get("v1", "Node", node_name)
+        if node.get("spec", {}).get("unschedulable", False) == value:
+            return
+        node.setdefault("spec", {})["unschedulable"] = value
+        self.client.update(node)
+
+
+class PodManager:
+    """Deletes/evicts TPU workload pods ahead of a libtpu swap (reference
+    ``pod_manager.go``)."""
+
+    def __init__(self, client: Client, namespace: str):
+        self.client = client
+        self.namespace = namespace
+
+    def tpu_pods_on_node(self, node_name: str) -> List[Obj]:
+        pods = []
+        for pod in self.client.list("v1", "Pod"):
+            if pod.get("spec", {}).get("nodeName") != node_name:
+                continue
+            if pod_requests_tpu(pod):
+                pods.append(pod)
+        return pods
+
+    def delete_pods(self, pods: List[Obj], force: bool = False) -> None:
+        """Without ``force``, unmanaged (ownerless) pods are left alone —
+        deleting them loses work permanently since no controller recreates
+        them (kubectl-drain ``--force`` semantics)."""
+        for pod in pods:
+            meta = pod["metadata"]
+            if not force and not meta.get("ownerReferences"):
+                log.warning(
+                    "skipping unmanaged pod %s/%s (set drain.force/podDeletion.force to delete)",
+                    meta.get("namespace"),
+                    meta["name"],
+                )
+                continue
+            log.info(
+                "deleting TPU pod %s/%s for upgrade", meta.get("namespace"), meta["name"]
+            )
+            self.client.delete_if_exists(
+                "v1", "Pod", meta["name"], meta.get("namespace", "")
+            )
+
+    def operand_pods_on_node(self, node_name: str, app: str) -> List[Obj]:
+        return [
+            p
+            for p in self.client.list(
+                "v1", "Pod", self.namespace, label_selector={"app": app}
+            )
+            if p.get("spec", {}).get("nodeName") == node_name
+        ]
+
+
+class DrainManager:
+    """reference ``drain_manager.go`` — here a filtered evict of TPU pods
+    (full-node drains are rarely right for dedicated TPU node pools)."""
+
+    def __init__(self, client: Client, pod_manager: PodManager):
+        self.client = client
+        self.pods = pod_manager
+
+    def drain(self, node_name: str, spec) -> bool:
+        if spec is not None and spec.enable is False:
+            return True
+        pods = self.pods.tpu_pods_on_node(node_name)
+        if not pods:
+            return True
+        self.pods.delete_pods(pods, force=bool(spec and spec.force))
+        return not self.pods.tpu_pods_on_node(node_name)
+
+
+class ValidationManager:
+    """Waits for the operator validator pod on the node to be Running
+    (reference ``validation_manager.go``: pod selector
+    ``app=nvidia-operator-validator``, ``main.go:132``)."""
+
+    APP = "tpu-operator-validator"
+
+    def __init__(self, client: Client, namespace: str):
+        self.client = client
+        self.namespace = namespace
+
+    def validate(self, node_name: str) -> bool:
+        for pod in self.client.list(
+            "v1", "Pod", self.namespace, label_selector={"app": self.APP}
+        ):
+            if pod.get("spec", {}).get("nodeName") != node_name:
+                continue
+            return pod.get("status", {}).get("phase") == "Running"
+        return False
+
+
+def pod_requests_tpu(pod: Obj) -> bool:
+    """reference ``gpuPodSpecFilter`` (``main.go:161-183``) for
+    ``google.com/tpu*`` resources."""
+    for container in pod.get("spec", {}).get("containers", []) or []:
+        res = container.get("resources", {}) or {}
+        for bucket in ("limits", "requests"):
+            for key in (res.get(bucket) or {}):
+                if key == consts.TPU_RESOURCE or key.startswith(
+                    consts.TPU_SUBSLICE_RESOURCE_PREFIX
+                ):
+                    return True
+    return False
+
+
+def parse_max_unavailable(value, total: int) -> int:
+    """int-or-percent scaling (reference ``GetScaledValueFromIntOrPercent``,
+    ``controllers/upgrade_controller.go:134-142``)."""
+    if total <= 0:
+        return 0
+    if value is None:
+        return total
+    if isinstance(value, int):
+        return max(0, min(value, total))
+    s = str(value).strip()
+    if s.endswith("%"):
+        try:
+            pct = float(s[:-1])
+        except ValueError:
+            return total
+        return max(1, math.floor(total * pct / 100.0)) if pct > 0 else 0
+    try:
+        return max(0, min(int(s), total))
+    except ValueError:
+        return total
+
+
+class ClusterUpgradeStateManager:
+    """Orchestration (reference ``upgrade_state.go:59-110,160-212``)."""
+
+    DRIVER_APP = "tpu-libtpu-daemonset"
+
+    def __init__(self, client: Client, namespace: str):
+        self.client = client
+        self.namespace = namespace
+        self.provider = NodeStateProvider(client)
+        self.cordon = CordonManager(client)
+        self.pod_manager = PodManager(client, namespace)
+        self.drain = DrainManager(client, self.pod_manager)
+        self.validation = ValidationManager(client, namespace)
+
+    # ------------------------------------------------------------------
+    def build_state(self) -> ClusterUpgradeState:
+        """Group libtpu operand pods per node; nodes whose operand pod runs a
+        stale revision (hash mismatch vs the DaemonSet template) need an
+        upgrade (reference ``BuildState``, ``upgrade_state.go:160-212``)."""
+        state = ClusterUpgradeState()
+        desired_hashes = self._desired_hashes()
+        for node in self.client.list("v1", "Node"):
+            labels = node.get("metadata", {}).get("labels", {}) or {}
+            if labels.get(consts.DEPLOY_LABEL_PREFIX + consts.COMPONENT_LIBTPU) != "true":
+                continue
+            node_name = node["metadata"]["name"]
+            pod = self._driver_pod(node_name)
+            current = self.provider.get_state(node)
+            if current in (STATE_UNKNOWN, STATE_DONE):
+                # (re-)enter the FSM whenever the operand pod runs a stale
+                # revision — a completed node must go through the FSM again on
+                # the next version bump (reference moves Done->UpgradeRequired
+                # on hash mismatch, upgrade_state.go BuildState)
+                if labels.get(consts.UPGRADE_SKIP_LABEL) == "true":
+                    continue
+                if pod is not None and self._pod_is_stale(pod, desired_hashes):
+                    current = STATE_UPGRADE_REQUIRED
+                    self.provider.set_state(node, current)
+                elif pod is not None:
+                    current = STATE_DONE
+                else:
+                    current = STATE_UNKNOWN
+            entry = NodeUpgradeState(node=node, driver_pod=pod, state=current)
+            state.node_states.setdefault(current, []).append(entry)
+        return state
+
+    def _desired_hashes(self) -> Dict[str, str]:
+        hashes = {}
+        for ds in self.client.list("apps/v1", "DaemonSet", self.namespace):
+            app = (
+                ds.get("spec", {})
+                .get("selector", {})
+                .get("matchLabels", {})
+                .get("app", "")
+            )
+            if app.startswith(self.DRIVER_APP):
+                h = (
+                    ds["spec"]["template"]["metadata"]
+                    .get("annotations", {})
+                    .get(consts.LAST_APPLIED_HASH_ANNOTATION)
+                )
+                if h:
+                    hashes[ds["metadata"]["name"]] = h
+        return hashes
+
+    def _driver_pod(self, node_name: str) -> Optional[Obj]:
+        for pod in self.client.list(
+            "v1", "Pod", self.namespace, label_selector={"app": self.DRIVER_APP + "*"}
+        ):
+            if pod.get("spec", {}).get("nodeName") == node_name:
+                return pod
+        return None
+
+    def _pod_is_stale(self, pod: Obj, desired_hashes: Dict[str, str]) -> bool:
+        if not desired_hashes:
+            return False
+        got = (
+            pod["metadata"].get("annotations", {}) or {}
+        ).get(consts.LAST_APPLIED_HASH_ANNOTATION)
+        return got not in set(desired_hashes.values())
+
+    # ------------------------------------------------------------------
+    def apply_state(self, state: ClusterUpgradeState, policy) -> None:
+        """Advance each node's FSM one step, throttled by
+        maxParallelUpgrades/maxUnavailable (reference ``ApplyState``)."""
+        total = len(state.all())
+        if total == 0:
+            return
+        max_parallel = policy.max_parallel_upgrades or 1
+        max_unavailable = parse_max_unavailable(policy.max_unavailable, total)
+        in_progress = sum(state.count(s) for s in ACTIVE_STATES)
+        unavailable = in_progress + state.count(STATE_FAILED)
+
+        # promote upgrade-required -> cordon-required within budget
+        for ns in state.node_states.get(STATE_UPGRADE_REQUIRED, []):
+            if in_progress >= max_parallel or unavailable >= max_unavailable:
+                break
+            self.provider.set_state(ns.node, STATE_CORDON_REQUIRED)
+            in_progress += 1
+            unavailable += 1
+
+        for ns in state.node_states.get(STATE_CORDON_REQUIRED, []):
+            self.cordon.cordon(ns.node["metadata"]["name"])
+            self.provider.set_state(ns.node, STATE_WAIT_FOR_JOBS_REQUIRED)
+
+        for ns in state.node_states.get(STATE_WAIT_FOR_JOBS_REQUIRED, []):
+            node_name = ns.node["metadata"]["name"]
+            waiting = policy.wait_for_completion or {}
+            selector = waiting.get("podSelector", "")
+            if selector and self._jobs_running(node_name, selector):
+                continue  # stay; re-evaluated next reconcile
+            self.provider.set_state(ns.node, STATE_POD_DELETION_REQUIRED)
+
+        for ns in state.node_states.get(STATE_POD_DELETION_REQUIRED, []):
+            # pod deletion is opt-in via upgradePolicy.podDeletion (reference
+            # pod_manager.go); without it, eviction is the drain step's job
+            if policy.pod_deletion is not None:
+                node_name = ns.node["metadata"]["name"]
+                pods = self.pod_manager.tpu_pods_on_node(node_name)
+                self.pod_manager.delete_pods(
+                    pods, force=bool(policy.pod_deletion.force)
+                )
+            self.provider.set_state(ns.node, STATE_DRAIN_REQUIRED)
+
+        for ns in state.node_states.get(STATE_DRAIN_REQUIRED, []):
+            node_name = ns.node["metadata"]["name"]
+            labels = ns.node["metadata"].get("labels", {}) or {}
+            skip_drain = labels.get(consts.UPGRADE_SKIP_DRAIN_LABEL) == "true"
+            if skip_drain or self.drain.drain(node_name, policy.drain):
+                self.provider.set_state(ns.node, STATE_POD_RESTART_REQUIRED)
+
+        for ns in state.node_states.get(STATE_POD_RESTART_REQUIRED, []):
+            # delete the operand pod; the OnDelete DaemonSet restarts it with
+            # the new libtpu version
+            if ns.driver_pod is not None:
+                meta = ns.driver_pod["metadata"]
+                self.client.delete_if_exists(
+                    "v1", "Pod", meta["name"], meta.get("namespace", "")
+                )
+            self.provider.set_state(ns.node, STATE_VALIDATION_REQUIRED)
+
+        for ns in state.node_states.get(STATE_VALIDATION_REQUIRED, []):
+            node_name = ns.node["metadata"]["name"]
+            if self.validation.validate(node_name):
+                self.provider.set_state(ns.node, STATE_UNCORDON_REQUIRED)
+
+        for ns in state.node_states.get(STATE_UNCORDON_REQUIRED, []):
+            self.cordon.uncordon(ns.node["metadata"]["name"])
+            self.provider.set_state(ns.node, STATE_DONE)
+
+    def _jobs_running(self, node_name: str, selector: str) -> bool:
+        sel = {}
+        for part in selector.split(","):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                sel[k.strip()] = v.strip()
+        for pod in self.client.list("v1", "Pod", label_selector=sel or None):
+            if pod.get("spec", {}).get("nodeName") == node_name and pod.get(
+                "status", {}
+            ).get("phase") in ("Running", "Pending"):
+                return True
+        return False
+
+    def cleanup_state_labels(self) -> None:
+        """Strip per-node labels when auto-upgrade is disabled (reference
+        ``controllers/upgrade_controller.go:168-194``)."""
+        for node in self.client.list("v1", "Node"):
+            self.provider.clear_state(node)

@@ -8,7 +8,11 @@ envtest (SURVEY.md §4).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU unconditionally: the sandbox exports JAX_PLATFORMS pointing at
+# the real TPU tunnel, and unit tests must never grab the chip. The tunnel's
+# sitecustomize imports jax at interpreter startup, so env vars alone are
+# too late — jax.config must be updated as well.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,57 +20,19 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ.setdefault("UNIT_TEST", "true")
 
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # operator-core tests run fine without jax
+    pass
+
 import pytest  # noqa: E402
 
 from tpu_operator.kube import FakeClient  # noqa: E402
+from tpu_operator.kube.testing import make_cpu_node, make_tpu_node  # noqa: E402,F401
 
 
 @pytest.fixture()
 def fake_client():
     return FakeClient()
-
-
-def make_tpu_node(
-    name: str,
-    accelerator: str = "tpu-v5-lite-podslice",
-    topology: str = "2x4",
-    extra_labels: dict | None = None,
-) -> dict:
-    """A GKE-style TPU node (reference test nodes carry minimal NFD labels,
-    controllers/object_controls_test.go:60-65)."""
-    labels = {
-        "kubernetes.io/hostname": name,
-        "cloud.google.com/gke-tpu-accelerator": accelerator,
-        "cloud.google.com/gke-tpu-topology": topology,
-        "feature.node.kubernetes.io/kernel-version.full": "6.1.0-gke",
-        "feature.node.kubernetes.io/system-os_release.ID": "cos",
-        "feature.node.kubernetes.io/system-os_release.VERSION_ID": "117",
-    }
-    labels.update(extra_labels or {})
-    return {
-        "apiVersion": "v1",
-        "kind": "Node",
-        "metadata": {"name": name, "labels": labels, "annotations": {}},
-        "status": {
-            "capacity": {},
-            "allocatable": {},
-            "nodeInfo": {
-                "containerRuntimeVersion": "containerd://1.7.0",
-                "kernelVersion": "6.1.0-gke",
-                "osImage": "Container-Optimized OS",
-            },
-        },
-    }
-
-
-def make_cpu_node(name: str) -> dict:
-    return {
-        "apiVersion": "v1",
-        "kind": "Node",
-        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
-        "status": {
-            "capacity": {},
-            "allocatable": {},
-            "nodeInfo": {"containerRuntimeVersion": "containerd://1.7.0"},
-        },
-    }

@@ -1,0 +1,163 @@
+"""Device plugin: real gRPC over a unix socket — ListAndWatch, Allocate
+(CDI + legacy), topology-aware GetPreferredAllocation, kubelet registration."""
+
+import os
+import threading
+from concurrent import futures
+
+import grpc
+import pytest
+
+from tpu_operator.plugin import grpc_glue
+from tpu_operator.plugin.proto import pb2
+from tpu_operator.plugin.server import (
+    DevicePluginServer,
+    TPUDevicePluginServicer,
+    slice_env_from_node_labels,
+)
+
+
+@pytest.fixture()
+def dev_root(tmp_path):
+    d = tmp_path / "dev"
+    d.mkdir()
+    for i in range(8):
+        (d / f"accel{i}").touch()
+    return str(d)
+
+
+@pytest.fixture()
+def plugin(tmp_path, dev_root):
+    servicer = TPUDevicePluginServicer(
+        dev_root=dev_root,
+        generation="v5e",
+        host_topology="2x4",
+        cdi_enabled=True,
+        slice_env={"TPU_WORKER_ID": "0"},
+        poll_interval_s=0.2,
+    )
+    server = DevicePluginServer(
+        servicer, socket_dir=str(tmp_path / "kubelet"), socket_name="tpu.sock"
+    )
+    addr = server.start()
+    channel = grpc.insecure_channel(addr)
+    stub = grpc_glue.DevicePluginStub(channel)
+    yield servicer, server, stub
+    channel.close()
+    server.stop()
+
+
+def test_options(plugin):
+    _, _, stub = plugin
+    opts = stub.GetDevicePluginOptions(pb2.Empty())
+    assert opts.get_preferred_allocation_available
+    assert not opts.pre_start_required
+
+
+def test_list_and_watch_streams_devices(plugin, dev_root):
+    servicer, _, stub = plugin
+    stream = stub.ListAndWatch(pb2.Empty())
+    first = next(stream)
+    assert len(first.devices) == 8
+    assert all(d.health == "Healthy" for d in first.devices)
+    # a chip disappearing flips the stream
+    os.unlink(os.path.join(dev_root, "accel7"))
+    servicer.refresh_devices()
+    second = next(stream)
+    assert len(second.devices) == 7
+
+
+def test_allocate_cdi(plugin):
+    _, _, stub = plugin
+    req = pb2.AllocateRequest()
+    req.container_requests.add().devicesIDs.extend(["0", "1"])
+    resp = stub.Allocate(req)
+    cresp = resp.container_responses[0]
+    assert [c.name for c in cresp.cdi_devices] == [
+        "google.com/tpu=0",
+        "google.com/tpu=1",
+    ]
+    assert cresp.envs["TPU_CHIPS_VISIBLE"] == "0,1"
+    assert cresp.envs["TPU_HOST_TOPOLOGY"] == "2x4"
+    assert cresp.envs["TPU_WORKER_ID"] == "0"
+
+
+def test_allocate_legacy_device_specs(tmp_path, dev_root):
+    servicer = TPUDevicePluginServicer(
+        dev_root=dev_root, cdi_enabled=False, host_topology="2x4"
+    )
+    req = pb2.AllocateRequest()
+    req.container_requests.add().devicesIDs.extend(["3"])
+    resp = servicer.Allocate(req, None)
+    cresp = resp.container_responses[0]
+    assert not cresp.cdi_devices
+    assert cresp.devices[0].container_path == "/dev/accel3"
+    assert cresp.devices[0].permissions == "rw"
+    assert cresp.mounts[0].container_path == "/usr/lib/tpu"
+    assert cresp.mounts[0].read_only
+
+
+def test_preferred_allocation_is_ici_contiguous(plugin):
+    _, _, stub = plugin
+    from tpu_operator.workloads import topology as topo
+
+    req = pb2.GetPreferredAllocationRequest()
+    creq = req.container_requests.add()
+    creq.available_deviceIDs.extend([str(i) for i in range(8)])
+    creq.allocation_size = 4
+    resp = stub.GetPreferredAllocation(req)
+    ids = [int(i) for i in resp.container_responses[0].deviceIDs]
+    assert len(ids) == 4
+    coords = [topo.index_to_coord(i, (2, 4)) for i in ids]
+    assert topo.contiguous(coords, "2x4", "v5e")
+
+
+def test_kubelet_registration(tmp_path, dev_root):
+    """Fake kubelet Registration service receives our Register call."""
+    received = {}
+
+    class FakeKubelet:
+        def Register(self, request, context):
+            received["version"] = request.version
+            received["endpoint"] = request.endpoint
+            received["resource"] = request.resource_name
+            return pb2.Empty()
+
+    sock_dir = tmp_path / "kubelet"
+    sock_dir.mkdir()
+    kubelet_sock = str(sock_dir / "kubelet.sock")
+    kubelet = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    kubelet.add_generic_rpc_handlers(
+        (grpc_glue.registration_handler(FakeKubelet()),)
+    )
+    kubelet.add_insecure_port(f"unix://{kubelet_sock}")
+    kubelet.start()
+
+    servicer = TPUDevicePluginServicer(dev_root=dev_root)
+    server = DevicePluginServer(servicer, socket_dir=str(sock_dir))
+    server.start()
+    server.register_with_kubelet(kubelet_sock)
+    assert received == {
+        "version": "v1beta1",
+        "endpoint": "tpu.sock",
+        "resource": "google.com/tpu",
+    }
+    server.stop()
+    kubelet.stop(grace=None)
+
+
+def test_slice_env_from_labels():
+    env = slice_env_from_node_labels(
+        {
+            "cloud.google.com/gke-tpu-topology": "2x2x4",
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice",
+            "tpu.k8s.io/tpu.worker-id": "3",
+            "tpu.k8s.io/tpu.slice-hosts": "4",
+        }
+    )
+    assert env == {
+        "TPU_TOPOLOGY": "2x2x4",
+        "TPU_ACCELERATOR_TYPE": "tpu-v5p-slice",
+        "TPU_WORKER_ID": "3",
+        "TPU_SLICE_HOSTS": "4",
+    }

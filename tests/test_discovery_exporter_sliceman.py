@@ -1,0 +1,261 @@
+"""Feature discovery, metrics exporter, slice manager (GFD/DCGM/MIG slots)."""
+
+import json
+import os
+
+import pytest
+import yaml
+
+from tests.conftest import make_tpu_node
+from tpu_operator import consts
+from tpu_operator.discovery import tfd
+from tpu_operator.exporter.exporter import Exporter, parse_metrics_config
+from tpu_operator.kube import FakeClient
+from tpu_operator.plugin import cdi
+from tpu_operator.sliceman import slice_manager as sm
+
+
+# ---------------------------------------------------------------------------
+# feature discovery
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def dev_root(tmp_path):
+    d = tmp_path / "dev"
+    d.mkdir()
+    for i in range(4):
+        (d / f"accel{i}").touch()
+    return str(d)
+
+
+def test_gather_features(tmp_path, dev_root):
+    lib = tmp_path / "lib"
+    lib.mkdir()
+    (lib / "VERSION").write_text("2025.1.0\n")
+    node = make_tpu_node("n1", accelerator="tpu-v5p-slice", topology="2x2x4")
+    feats = tfd.gather_features(
+        node, dev_root=dev_root, libtpu_dir=str(lib), env={"TPU_WORKER_ID": "2"}
+    )
+    assert feats[consts.TFD_CHIP_TYPE_LABEL] == "v5p"
+    assert feats[consts.TFD_CHIP_COUNT_LABEL] == "4"
+    assert feats[consts.TFD_HBM_GB_LABEL] == "95"
+    assert feats[consts.TFD_TOPOLOGY_LABEL] == "2x2x4"
+    assert feats[consts.TFD_ICI_WRAP_LABEL] == "true"  # trailing dim 4 wraps
+    assert feats[consts.TFD_SLICE_HOSTS_LABEL] == "4"  # 16 chips / 4-per-host
+    assert feats[consts.TFD_WORKER_ID_LABEL] == "2"
+    assert feats[consts.TFD_LIBTPU_VERSION_LABEL] == "2025.1.0"
+
+
+def test_apply_features_prunes_stale(dev_root, tmp_path):
+    client = FakeClient([make_tpu_node("n1")])
+    node = client.get("v1", "Node", "n1")
+    feats = tfd.gather_features(node, dev_root=dev_root, libtpu_dir=str(tmp_path))
+    assert tfd.apply_features(client, "n1", feats)
+    labels = client.get("v1", "Node", "n1")["metadata"]["labels"]
+    assert labels[consts.TFD_CHIP_COUNT_LABEL] == "4"
+    # second apply is a no-op
+    assert not tfd.apply_features(client, "n1", feats)
+    # chip-count fact disappears -> label pruned
+    feats2 = dict(feats)
+    del feats2[consts.TFD_CHIP_COUNT_LABEL]
+    assert tfd.apply_features(client, "n1", feats2)
+    labels = client.get("v1", "Node", "n1")["metadata"]["labels"]
+    assert consts.TFD_CHIP_COUNT_LABEL not in labels
+
+
+def test_nfd_feature_file(tmp_path, dev_root):
+    node = make_tpu_node("n1")
+    feats = tfd.gather_features(node, dev_root=dev_root, libtpu_dir=str(tmp_path))
+    path = tmp_path / "features.d" / "tpu"
+    tfd.write_nfd_feature_file(feats, str(path))
+    lines = path.read_text().strip().splitlines()
+    assert f"{consts.TFD_CHIP_COUNT_LABEL}=4" in lines
+
+
+# ---------------------------------------------------------------------------
+# CDI generation
+# ---------------------------------------------------------------------------
+
+
+def test_cdi_spec(tmp_path, dev_root):
+    out = tmp_path / "cdi" / "google.com-tpu.yaml"
+    spec = cdi.write_spec(str(out), dev_root=dev_root, libtpu_dir="/lib/tpu")
+    assert spec["kind"] == "google.com/tpu"
+    names = [d["name"] for d in spec["devices"]]
+    assert names == ["0", "1", "2", "3", "all"]
+    on_disk = yaml.safe_load(out.read_text())
+    assert on_disk == spec
+    # per-chip device node paths
+    assert spec["devices"][0]["containerEdits"]["deviceNodes"][0]["path"].endswith(
+        "accel0"
+    )
+    # the validator's runtime component accepts this spec
+    from tpu_operator.validator.components import StatusFiles, validate_runtime
+
+    st = StatusFiles(str(tmp_path / "val"))
+    info = validate_runtime(st, cdi_spec_path=str(out))
+    assert len(info["devices"]) == 5
+
+
+# ---------------------------------------------------------------------------
+# exporter
+# ---------------------------------------------------------------------------
+
+
+def test_exporter_collect(dev_root):
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    reg = CollectorRegistry()
+    exp = Exporter(
+        node_name="n1",
+        dev_root=dev_root,
+        generation="v5e",
+        host_topology="2x4",
+        registry=reg,
+    )
+    data = exp.collect_once()
+    assert len(data) == 4
+    assert data["0"]["present"] == 1.0
+    assert data["0"]["hbm_total"] == 16 * 2**30
+    assert data["0"]["ici_links"] == 10.0  # 2x4 mesh links
+    text = generate_latest(reg).decode()
+    assert 'tpu_chip_present{chip="0",node="n1"} 1.0' in text
+    assert "tpu_hbm_total_bytes" in text
+
+
+def test_metrics_config_parsing():
+    assert parse_metrics_config("duty_cycle\n# comment\nhbm_used\n") == [
+        "duty_cycle",
+        "hbm_used",
+    ]
+    assert parse_metrics_config("bogus\n") == list(
+        __import__(
+            "tpu_operator.exporter.exporter", fromlist=["DEFAULT_METRICS"]
+        ).DEFAULT_METRICS
+    )
+
+
+# ---------------------------------------------------------------------------
+# slice manager
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def slice_env(tmp_path, dev_root):
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(
+        yaml.safe_dump(
+            {
+                "version": "v1",
+                "slice-configs": {
+                    "all-disabled": [{"devices": "all", "partitioned": False}],
+                    "all-1x1": [
+                        {
+                            "devices": "all",
+                            "partitioned": True,
+                            "layout": {"shape": "1x1"},
+                        }
+                    ],
+                    "all-2x2": [
+                        {
+                            "devices": "all",
+                            "partitioned": True,
+                            "layout": {"shape": "2x2"},
+                        }
+                    ],
+                    "bad-shape": [
+                        {
+                            "devices": "all",
+                            "partitioned": True,
+                            "layout": {"shape": "3x1"},
+                        }
+                    ],
+                },
+            }
+        )
+    )
+    clients = tmp_path / "clients.yaml"
+    clients.write_text(
+        yaml.safe_dump(
+            {
+                "version": "v1",
+                "kubernetes-labels": [
+                    consts.DEPLOY_LABEL_PREFIX + "device-plugin",
+                ],
+            }
+        )
+    )
+    node = make_tpu_node("n1", topology="2x4")
+    node["metadata"]["labels"][consts.DEPLOY_LABEL_PREFIX + "device-plugin"] = "true"
+    client = FakeClient([node])
+    mgr = sm.SliceManager(
+        client,
+        "n1",
+        config_file=str(cfg),
+        chip_clients_file=str(clients),
+        partition_file=str(tmp_path / "partitions.json"),
+        cdi_spec_path=str(tmp_path / "cdi.yaml"),
+        dev_root=dev_root,
+    )
+    return client, mgr, tmp_path
+
+
+def set_config(client, name):
+    node = client.get("v1", "Node", "n1")
+    node["metadata"]["labels"][consts.SLICE_CONFIG_LABEL] = name
+    client.update(node)
+
+
+def test_slice_partition_2x2(slice_env):
+    client, mgr, tmp = slice_env
+    set_config(client, "all-2x2")
+    assert mgr.reconcile_once() == sm.STATE_SUCCESS
+    state = json.loads((tmp / "partitions.json").read_text())
+    assert state["partitioned"] and state["shape"] == "2x2"
+    assert len(state["subslices"]) == 2
+    assert state["subslices"][0]["chips"] == [0, 1, 4, 5]
+    assert state["subslices"][0]["resource"] == "google.com/tpu-2x2"
+    # CDI spec gained subslice composite devices
+    spec = yaml.safe_load((tmp / "cdi.yaml").read_text())
+    names = [d["name"] for d in spec["devices"]]
+    assert "subslice-0-2x2" in names and "subslice-1-2x2" in names
+    # node state label
+    labels = client.get("v1", "Node", "n1")["metadata"]["labels"]
+    assert labels[consts.SLICE_CONFIG_STATE_LABEL] == sm.STATE_SUCCESS
+    # clients restored after apply
+    assert labels[consts.DEPLOY_LABEL_PREFIX + "device-plugin"] == "true"
+
+
+def test_slice_unpartitioned(slice_env):
+    client, mgr, tmp = slice_env
+    set_config(client, "all-disabled")
+    assert mgr.reconcile_once() == sm.STATE_SUCCESS
+    state = json.loads((tmp / "partitions.json").read_text())
+    assert state == {"partitioned": False, "subslices": [], "config": "all-disabled"}
+
+
+def test_slice_bad_shape_fails(slice_env):
+    client, mgr, tmp = slice_env
+    set_config(client, "bad-shape")  # 3x1 doesn't tile 2x4
+    assert mgr.reconcile_once() == sm.STATE_FAILED
+    labels = client.get("v1", "Node", "n1")["metadata"]["labels"]
+    assert labels[consts.SLICE_CONFIG_STATE_LABEL] == sm.STATE_FAILED
+    # clients restored even on failure
+    assert labels[consts.DEPLOY_LABEL_PREFIX + "device-plugin"] == "true"
+
+
+def test_slice_unknown_config_fails(slice_env):
+    client, mgr, _ = slice_env
+    set_config(client, "nope")
+    assert mgr.reconcile_once() == sm.STATE_FAILED
+
+
+def test_slice_idempotent(slice_env):
+    client, mgr, _ = slice_env
+    set_config(client, "all-1x1")
+    assert mgr.reconcile_once() == sm.STATE_SUCCESS
+    rv_before = client.get("v1", "Node", "n1")["metadata"]["resourceVersion"]
+    assert mgr.reconcile_once() == sm.STATE_SUCCESS
+    rv_after = client.get("v1", "Node", "n1")["metadata"]["resourceVersion"]
+    assert rv_before == rv_after  # no churn once applied

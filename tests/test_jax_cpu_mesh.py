@@ -1,0 +1,9 @@
+"""Guard: the test suite must run on the virtual CPU mesh, never the chip."""
+
+
+def test_cpu_devices():
+    import jax
+
+    devs = jax.devices()
+    assert all(d.platform == "cpu" for d in devs), devs
+    assert len(devs) == 8, devs

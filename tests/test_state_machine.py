@@ -313,6 +313,29 @@ def test_compute_hash_deterministic():
     assert h1 == compute_hash(obj3)
 
 
+def test_sandbox_enabled_container_nodes_still_ready(monkeypatch):
+    """Regression: sandbox states enabled but no vm-passthrough nodes must
+    not deadlock readiness — a DS no node wants counts as ready."""
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            make_tpu_node("tpu-node-1"),
+        ]
+    )
+    cr = load_sample_cr()
+    cr["spec"]["sandboxWorkloads"]["enabled"] = True
+    client.create(cr)
+    c = ClusterPolicyController(client, assets_dir=ASSETS)
+    c.init(client.get("tpu.k8s.io/v1", "ClusterPolicy", "cluster-policy"))
+    run_all_states(c)
+    statuses = run_all_states(c)
+    for name, st in statuses.items():
+        assert st in (State.READY, State.DISABLED), f"{name}: {st}"
+    # sandbox DS objects exist but are vacuously ready (no matching nodes)
+    assert client.get_or_none("apps/v1", "DaemonSet", "tpu-vm-manager-daemonset", NS)
+
+
 def test_workload_config_vm_passthrough(monkeypatch):
     monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
     client = FakeClient(

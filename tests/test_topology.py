@@ -1,0 +1,85 @@
+"""ICI topology math."""
+
+import pytest
+
+from tpu_operator.workloads import topology as topo
+
+
+def test_parse_and_count():
+    assert topo.parse_topology("2x4") == (2, 4)
+    assert topo.parse_topology("2x2x4") == (2, 2, 4)
+    assert topo.chip_count("2x2x4") == 16
+    assert topo.chip_count("1x1") == 1
+    with pytest.raises(ValueError):
+        topo.parse_topology("2xx4")
+    with pytest.raises(ValueError):
+        topo.parse_topology("")
+
+
+def test_host_count():
+    # v5e: 8 chips/host -> 2x4 topology is one host
+    assert topo.host_count("2x4", "v5e") == 1
+    # v5p: 4 chips/host -> 2x2x4 (16 chips) is 4 hosts
+    assert topo.host_count("2x2x4", "v5p") == 4
+
+
+def test_wraparound():
+    # 3-D tori wrap dims that are multiples of 4
+    assert topo.wraparound_dims("4x4x4", "v4") == (True, True, True)
+    assert topo.wraparound_dims("2x2x4", "v5p") == (False, False, True)
+    # 2-D meshes never wrap
+    assert topo.wraparound_dims("2x4", "v5e") == (False, False)
+
+
+def test_neighbors_mesh_vs_torus():
+    # interior chip in 4x4x4 torus has 6 neighbors
+    assert len(topo.neighbors((1, 1, 1), "4x4x4", "v4")) == 6
+    # corner chip in a torus still has 6 (wrap links)
+    assert len(topo.neighbors((0, 0, 0), "4x4x4", "v4")) == 6
+    # corner chip in a 2x4 mesh has 2
+    assert len(topo.neighbors((0, 0), "2x4", "v5e")) == 2
+
+
+def test_ici_link_count():
+    # 2x2 mesh: 4 links
+    assert topo.ici_link_count("2x2", "v5e") == 4
+    # 4-ring via wrap in one dim: 4x1x1 -> 4 links
+    assert topo.ici_link_count("4x1x1", "v4") == 4
+
+
+def test_enumerate_subslices():
+    tiles = topo.enumerate_subslices("2x4", (1, 1))
+    assert len(tiles) == 8
+    tiles = topo.enumerate_subslices("2x4", (2, 2))
+    assert len(tiles) == 2
+    assert all(t.chip_count() == 4 for t in tiles)
+    # shapes padded with trailing 1s
+    tiles = topo.enumerate_subslices("2x2x1", (2, 1))
+    assert len(tiles) == 2
+    with pytest.raises(ValueError):
+        topo.enumerate_subslices("2x4", (3, 1))  # doesn't tile
+
+
+def test_contiguity():
+    assert topo.contiguous([(0, 0), (0, 1), (1, 1)], "2x4", "v5e")
+    assert not topo.contiguous([(0, 0), (0, 2)], "2x4", "v5e")
+
+
+def test_pick_chips_prefers_contiguous_blocks():
+    # 2x4 topology, all 8 available: picking 4 must give an aligned block
+    got = topo.pick_chips("2x4", "v5e", 4, list(range(8)))
+    assert got is not None and len(got) == 4
+    coords = [topo.index_to_coord(i, (2, 4)) for i in got]
+    assert topo.contiguous(coords, "2x4", "v5e")
+    # fragmented availability: contiguous pair still found
+    got = topo.pick_chips("2x4", "v5e", 2, [0, 1, 5, 7])
+    coords = [topo.index_to_coord(i, (2, 4)) for i in got]
+    assert topo.contiguous(coords, "2x4", "v5e")
+    # impossible count
+    assert topo.pick_chips("2x4", "v5e", 9, list(range(8))) is None
+
+
+def test_coord_round_trip():
+    dims = (2, 2, 4)
+    for i in range(16):
+        assert topo.coord_to_index(topo.index_to_coord(i, dims), dims) == i

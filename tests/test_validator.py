@@ -1,0 +1,240 @@
+"""Validator components: status-file barriers, libtpu/runtime/plugin checks,
+workload pods (reference ``validator/main.go`` behaviours)."""
+
+import json
+import os
+import threading
+
+import pytest
+import yaml
+
+from tpu_operator import consts
+from tpu_operator.kube import FakeClient
+from tpu_operator.validator import components as comp
+from tpu_operator.validator.components import StatusFiles, ValidationError
+
+NS = "tpu-operator"
+
+
+@pytest.fixture()
+def status(tmp_path):
+    return StatusFiles(str(tmp_path / "validations"))
+
+
+def test_status_file_lifecycle(status):
+    assert not status.exists("libtpu-ready")
+    status.write("libtpu-ready", {"x": 1})
+    assert status.exists("libtpu-ready")
+    with open(status.path("libtpu-ready")) as f:
+        assert json.load(f) == {"x": 1}
+    status.remove("libtpu-ready")
+    assert not status.exists("libtpu-ready")
+    status.remove("libtpu-ready")  # idempotent
+
+
+def test_validate_libtpu(tmp_path, status):
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    lib = tmp_path / "libdir"
+    lib.mkdir()
+    # no devices
+    with pytest.raises(ValidationError, match="no TPU devices"):
+        comp.validate_libtpu(status, install_dir=str(lib), dev_root=str(dev))
+    (dev / "accel0").touch()
+    (dev / "accel1").touch()
+    # devices but no libtpu.so
+    with pytest.raises(ValidationError, match="libtpu.so not found"):
+        comp.validate_libtpu(status, install_dir=str(lib), dev_root=str(dev))
+    (lib / "libtpu.so").touch()
+    info = comp.validate_libtpu(status, install_dir=str(lib), dev_root=str(dev))
+    assert len(info["devices"]) == 2
+    assert status.exists(consts.STATUS_FILE_LIBTPU)
+
+
+def test_validate_libtpu_vfio_devices(tmp_path, status):
+    dev = tmp_path / "dev"
+    (dev / "vfio").mkdir(parents=True)
+    (dev / "vfio" / "0").touch()
+    (dev / "vfio" / "vfio").touch()  # the control node doesn't count
+    lib = tmp_path / "libdir"
+    lib.mkdir()
+    (lib / "libtpu-2025.1.0.so").touch()  # versioned name accepted
+    info = comp.validate_libtpu(status, install_dir=str(lib), dev_root=str(dev))
+    assert info["devices"] == [str(dev / "vfio" / "0")]
+
+
+def test_validate_runtime(tmp_path, status):
+    cdi = tmp_path / "google.com-tpu.yaml"
+    with pytest.raises(ValidationError, match="CDI spec missing"):
+        comp.validate_runtime(status, cdi_spec_path=str(cdi))
+    cdi.write_text(yaml.safe_dump({"cdiVersion": "0.6.0", "devices": []}))
+    with pytest.raises(ValidationError, match="lists no devices"):
+        comp.validate_runtime(status, cdi_spec_path=str(cdi))
+    cdi.write_text(
+        yaml.safe_dump(
+            {
+                "cdiVersion": "0.6.0",
+                "kind": "google.com/tpu",
+                "devices": [{"name": "0"}, {"name": "1"}],
+            }
+        )
+    )
+    info = comp.validate_runtime(status, cdi_spec_path=str(cdi))
+    assert info["devices"] == ["0", "1"]
+    assert status.exists(consts.STATUS_FILE_RUNTIME)
+
+
+def test_wait_for_barrier(status, monkeypatch):
+    monkeypatch.setattr(comp, "WAIT_SLEEP_S", 0.01)
+    # barrier satisfied by another thread mid-wait
+    t = threading.Timer(0.05, lambda: status.write("libtpu-ready"))
+    t.start()
+    status.wait_for("libtpu-ready", retries=50)
+    # timeout path
+    with pytest.raises(ValidationError, match="timed out"):
+        status.wait_for("never-appears", retries=2)
+
+
+def make_node(name, capacity=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {}},
+        "status": {"capacity": capacity or {}},
+    }
+
+
+def test_validate_plugin_capacity(status):
+    client = FakeClient([make_node("n1", {consts.TPU_RESOURCE: "8"})])
+    info = comp.validate_plugin(status, client, "n1", retries=1, sleep_s=0)
+    assert info["capacity"] == 8
+    assert status.exists(consts.STATUS_FILE_PLUGIN)
+
+
+def test_validate_plugin_subslice_resources(status):
+    client = FakeClient(
+        [make_node("n1", {consts.TPU_SUBSLICE_RESOURCE_PREFIX + "2x2": "2"})]
+    )
+    info = comp.validate_plugin(status, client, "n1", retries=1, sleep_s=0)
+    assert info["capacity"] == 2
+
+
+def test_validate_plugin_no_capacity_fails(status):
+    client = FakeClient([make_node("n1")])
+    with pytest.raises(ValidationError, match="never advertised"):
+        comp.validate_plugin(status, client, "n1", retries=2, sleep_s=0)
+
+
+def test_validate_plugin_with_workload(status, monkeypatch):
+    from tpu_operator.validator import workload_pods
+
+    client = FakeClient([make_node("n1", {consts.TPU_RESOURCE: "4"})])
+    monkeypatch.setattr(workload_pods, "POLL_SLEEP_S", 0.01)
+
+    # simulate kubelet: mark the workload pod Succeeded shortly after create
+    def kubelet(event, obj):
+        if event == "ADDED" and obj["kind"] == "Pod":
+            def finish():
+                pod = client.get("v1", "Pod", obj["metadata"]["name"], NS)
+                pod["status"] = {"phase": "Succeeded"}
+                client.update_status(pod)
+
+            threading.Timer(0.05, finish).start()
+
+    client.add_watcher(kubelet)
+    info = comp.validate_plugin(
+        status, client, "n1", with_workload=True, namespace=NS, retries=1, sleep_s=0
+    )
+    assert info["workload"] == "tpu-plugin-validator"
+    # pod resources request exactly one chip (reference plugin-workload pod)
+    pod = client.get("v1", "Pod", "tpu-plugin-validator", NS)
+    assert pod["spec"]["containers"][0]["resources"]["limits"] == {
+        consts.TPU_RESOURCE: "1"
+    }
+
+
+def test_workload_pod_failure_raises(status, monkeypatch):
+    from tpu_operator.validator import workload_pods
+
+    client = FakeClient([make_node("n1", {consts.TPU_RESOURCE: "4"})])
+    monkeypatch.setattr(workload_pods, "POLL_SLEEP_S", 0.01)
+
+    def kubelet(event, obj):
+        if event == "ADDED" and obj["kind"] == "Pod":
+            def finish():
+                pod = client.get("v1", "Pod", obj["metadata"]["name"], NS)
+                pod["status"] = {"phase": "Failed"}
+                client.update_status(pod)
+
+            threading.Timer(0.05, finish).start()
+
+    client.add_watcher(kubelet)
+    with pytest.raises(RuntimeError, match="failed"):
+        comp.validate_plugin(
+            status, client, "n1", with_workload=True, namespace=NS, retries=1, sleep_s=0
+        )
+
+
+def test_validate_jax_in_process_cpu(status):
+    info = comp.validate_jax(status, expect_tpu=False, size=256)
+    assert info["ok"] and info["tflops"] > 0
+    assert status.exists(consts.STATUS_FILE_JAX)
+    # the status file carries the benchmark payload
+    with open(status.path(consts.STATUS_FILE_JAX)) as f:
+        assert json.load(f)["tflops"] > 0
+
+
+def test_validate_slice_burnin(status):
+    info = comp.validate_slice(status, steps=5, expect_devices=8)
+    assert info["ok"]
+    assert status.exists(consts.STATUS_FILE_SLICE)
+
+
+def test_validate_vfio_pci(tmp_path, status):
+    sysfs = tmp_path / "pci"
+    dev_a = sysfs / "0000:00:04.0"
+    dev_a.mkdir(parents=True)
+    (dev_a / "vendor").write_text("0x1ae0\n")
+    os.symlink("/sys/bus/pci/drivers/vfio-pci", dev_a / "driver")
+    other = sysfs / "0000:00:05.0"
+    other.mkdir()
+    (other / "vendor").write_text("0x8086\n")
+    info = comp.validate_vfio_pci(status, sysfs=str(sysfs))
+    assert info["bound"] == ["0000:00:04.0"]
+    # unbound TPU function fails
+    dev_b = sysfs / "0000:00:06.0"
+    dev_b.mkdir()
+    (dev_b / "vendor").write_text("0x1ae0\n")
+    with pytest.raises(ValidationError, match="not bound"):
+        comp.validate_vfio_pci(status, sysfs=str(sysfs))
+
+
+def test_cli_component_libtpu(tmp_path, monkeypatch):
+    from tpu_operator.validator.main import main
+
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    (dev / "accel0").touch()
+    lib = tmp_path / "lib"
+    lib.mkdir()
+    (lib / "libtpu.so").touch()
+    out = tmp_path / "validations"
+    rc = main(
+        [
+            "--component", "libtpu",
+            "--output-dir", str(out),
+            "--libtpu-install-dir", str(lib),
+            "--dev-root", str(dev),
+        ]
+    )
+    assert rc == 0
+    assert (out / "libtpu-ready").exists()
+    # failure exit code
+    rc = main(
+        [
+            "--component", "runtime",
+            "--output-dir", str(out),
+            "--cdi-spec", str(tmp_path / "missing.yaml"),
+        ]
+    )
+    assert rc == 1

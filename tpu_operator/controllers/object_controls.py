@@ -706,13 +706,32 @@ def transform_kata_manager(n, ds: Obj, generation: Optional[str] = None) -> None
 # ---------------------------------------------------------------------------
 
 
+def _nodes_wanting(n, ds: Obj) -> int:
+    """How many nodes match the DaemonSet's nodeSelector."""
+    selector = (
+        ds.get("spec", {})
+        .get("template", {})
+        .get("spec", {})
+        .get("nodeSelector", {})
+        or {}
+    )
+    count = 0
+    for node in n.client.list("v1", "Node"):
+        labels = node.get("metadata", {}).get("labels", {}) or {}
+        if all(labels.get(k) == v for k, v in selector.items()):
+            count += 1
+    return count
+
+
 def is_daemonset_ready(n, ds: Obj) -> bool:
     status = ds.get("status", {}) or {}
     desired = status.get("desiredNumberScheduled", 0)
     if desired == 0:
-        # kubelet hasn't scheduled anything (or no matching nodes): treat as
-        # ready only if no TPU node wants it — mirrors reference skip logic
-        return not n.has_tpu_nodes
+        # nothing scheduled yet: ready iff no node actually wants this
+        # operand (e.g. sandbox states enabled but every node is
+        # container-workload). A node that matches the selector but has no
+        # pod yet means the DS controller is still catching up -> NotReady.
+        return _nodes_wanting(n, ds) == 0
     if status.get("numberUnavailable", 0) != 0:
         return False
     strategy = ds.get("spec", {}).get("updateStrategy", {}).get("type")

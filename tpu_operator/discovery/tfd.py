@@ -1,0 +1,188 @@
+"""TPU feature discovery (TFD) — the GFD slot.
+
+The reference's gpu-feature-discovery (external Go+NVML image) publishes
+``nvidia.com/gpu.product``/memory/CUDA labels. TFD publishes the TPU facts
+that drive scheduling and the operator's fan-out:
+
+* chip type (generation) and per-host chip count,
+* HBM per chip,
+* ICI topology string + wraparound flag (the fabric facts, SURVEY.md §2.4),
+* slice host count and this host's worker id (multi-host coordination),
+* installed libtpu version.
+
+Facts come from (in priority order) native libtpuinfo, GKE-provided node
+labels, and the environment; they are applied as ``tpu.k8s.io/tpu.*`` node
+labels and optionally as an NFD feature file.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, Optional
+
+from tpu_operator import consts
+from tpu_operator.native import tpuinfo
+from tpu_operator.workloads import topology as topo
+
+log = logging.getLogger("tpu-feature-discovery")
+
+
+def gather_features(
+    node: dict,
+    dev_root: str = "/dev",
+    libtpu_dir: str = consts.LIBTPU_HOST_DIR,
+    env: Optional[Dict[str, str]] = None,
+) -> Dict[str, str]:
+    """Compute the label set for a node (pure; no API writes)."""
+    env = env if env is not None else dict(os.environ)
+    labels = node.get("metadata", {}).get("labels", {}) or {}
+    features: Dict[str, str] = {}
+
+    accelerator = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, "")
+    generation = consts.GKE_ACCELERATOR_TO_GENERATION.get(accelerator, "")
+    if not generation:
+        generation = env.get("TPU_GENERATION", "")
+    if generation:
+        features[consts.TFD_CHIP_TYPE_LABEL] = generation
+
+    chips = tpuinfo.chip_count(dev_root)
+    if chips:
+        features[consts.TFD_CHIP_COUNT_LABEL] = str(chips)
+
+    if generation in topo.HBM_GB:
+        features[consts.TFD_HBM_GB_LABEL] = str(topo.HBM_GB[generation])
+
+    topology = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, "") or env.get(
+        "TPU_TOPOLOGY", ""
+    )
+    if topology:
+        features[consts.TFD_TOPOLOGY_LABEL] = topology
+        if generation:
+            wraps = topo.wraparound_dims(topology, generation)
+            features[consts.TFD_ICI_WRAP_LABEL] = (
+                "true" if any(wraps) else "false"
+            )
+            features[consts.TFD_SLICE_HOSTS_LABEL] = str(
+                topo.host_count(topology, generation)
+            )
+
+    worker_id = env.get("TPU_WORKER_ID", "")
+    if worker_id != "":
+        features[consts.TFD_WORKER_ID_LABEL] = worker_id
+
+    libtpu_version = _libtpu_version(libtpu_dir)
+    if libtpu_version:
+        features[consts.TFD_LIBTPU_VERSION_LABEL] = libtpu_version
+
+    return features
+
+
+def _libtpu_version(libtpu_dir: str) -> str:
+    """Version from the installer's marker file or a versioned .so name."""
+    marker = os.path.join(libtpu_dir, "VERSION")
+    try:
+        with open(marker) as f:
+            return f.read().strip()
+    except OSError:
+        pass
+    import glob
+    import re
+
+    for so in glob.glob(os.path.join(libtpu_dir, "libtpu-*.so")):
+        m = re.search(r"libtpu-(.+)\.so$", os.path.basename(so))
+        if m:
+            return m.group(1)
+    return ""
+
+
+def apply_features(client, node_name: str, features: Dict[str, str]) -> bool:
+    """Write labels to the node; prunes stale ``tpu.k8s.io/tpu.*`` TFD labels
+    we no longer assert. Returns True when anything changed."""
+    node = client.get("v1", "Node", node_name)
+    labels = node["metadata"].setdefault("labels", {})
+    managed_prefixes = (
+        consts.TFD_CHIP_TYPE_LABEL,
+        consts.TFD_CHIP_COUNT_LABEL,
+        consts.TFD_HBM_GB_LABEL,
+        consts.TFD_TOPOLOGY_LABEL,
+        consts.TFD_SLICE_HOSTS_LABEL,
+        consts.TFD_WORKER_ID_LABEL,
+        consts.TFD_ICI_WRAP_LABEL,
+        consts.TFD_LIBTPU_VERSION_LABEL,
+    )
+    changed = False
+    for key in managed_prefixes:
+        want = features.get(key)
+        if want is None and key in labels:
+            del labels[key]
+            changed = True
+        elif want is not None and labels.get(key) != want:
+            labels[key] = want
+            changed = True
+    if changed:
+        client.update(node)
+    return changed
+
+
+def write_nfd_feature_file(
+    features: Dict[str, str],
+    path: str = "/etc/kubernetes/node-feature-discovery/features.d/tpu",
+) -> None:
+    """NFD sidecar-style feature file (label=value lines)."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for k, v in sorted(features.items()):
+            f.write(f"{k}={v}\n")
+
+
+def run_loop(
+    client,
+    node_name: str,
+    interval_s: float = 60.0,
+    once: bool = False,
+    dev_root: str = "/dev",
+    libtpu_dir: str = consts.LIBTPU_HOST_DIR,
+) -> None:
+    while True:
+        try:
+            node = client.get("v1", "Node", node_name)
+            features = gather_features(
+                node, dev_root=dev_root, libtpu_dir=libtpu_dir
+            )
+            if apply_features(client, node_name, features):
+                log.info("updated %d TFD labels on %s", len(features), node_name)
+        except Exception:
+            log.exception("feature discovery pass failed")
+        if once:
+            return
+        time.sleep(interval_s)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    logging.basicConfig(level="INFO")
+    p = argparse.ArgumentParser("tpu-feature-discovery")
+    p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
+    p.add_argument("--interval", type=float, default=60.0)
+    p.add_argument("--once", action="store_true")
+    p.add_argument("--dev-root", default="/dev")
+    args = p.parse_args(argv)
+    if not args.node_name:
+        log.error("NODE_NAME required")
+        return 1
+    from tpu_operator.kube.rest import RestClient
+
+    run_loop(
+        RestClient(), args.node_name, interval_s=args.interval, once=args.once,
+        dev_root=args.dev_root,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,173 @@
+"""libtpu metrics exporter — the dcgm-exporter slot.
+
+Exports per-chip hardware telemetry as Prometheus series (reference:
+dcgm-exporter external image, transform at
+``controllers/object_controls.go:1302-1439``): duty cycle, HBM usage,
+tensorcore utilization, temperature and ICI link state, read from native
+``libtpuinfo`` (or presence-only fallback values when only devfs is
+available). A custom-metrics config (the reference's CSV ConfigMap slot,
+``:103-106``) selects which series are emitted.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tpu_operator.native import tpuinfo
+from tpu_operator.workloads import topology as topo
+
+log = logging.getLogger("tpu-metrics-exporter")
+
+# metric key -> (prometheus name, help)
+ALL_METRICS = {
+    "duty_cycle": ("tpu_duty_cycle_percent", "TensorCore duty cycle %"),
+    "hbm_used": ("tpu_hbm_used_bytes", "HBM bytes in use"),
+    "hbm_total": ("tpu_hbm_total_bytes", "HBM capacity bytes"),
+    "tensorcore_util": (
+        "tpu_tensorcore_utilization_percent",
+        "TensorCore utilization %",
+    ),
+    "temperature": ("tpu_temperature_celsius", "Chip temperature"),
+    "present": ("tpu_chip_present", "Chip device node visible"),
+    "ici_links": ("tpu_ici_links_total", "Expected ICI links on this host"),
+}
+DEFAULT_METRICS = list(ALL_METRICS)
+
+
+def parse_metrics_config(text: str) -> List[str]:
+    """Custom metrics selection: one key per line, '#' comments
+    (the reference's CSV ConfigMap shape)."""
+    keys = []
+    for line in text.splitlines():
+        line = line.split("#", 1)[0].strip()
+        if line and line in ALL_METRICS:
+            keys.append(line)
+    return keys or list(DEFAULT_METRICS)
+
+
+class Exporter:
+    def __init__(
+        self,
+        node_name: str = "",
+        dev_root: str = "/dev",
+        generation: str = "",
+        host_topology: str = "",
+        enabled_metrics: Optional[List[str]] = None,
+        interval_s: float = 10.0,
+        registry=None,
+    ):
+        from prometheus_client import CollectorRegistry, Gauge
+
+        self.node_name = node_name
+        self.dev_root = dev_root
+        self.generation = generation
+        self.host_topology = host_topology
+        self.enabled = enabled_metrics or list(DEFAULT_METRICS)
+        self.interval_s = interval_s
+        self.registry = registry  # None -> default global registry
+        self._stop = threading.Event()
+        self.gauges: Dict[str, object] = {}
+        kw = {"registry": registry} if registry is not None else {}
+        for key in self.enabled:
+            name, doc = ALL_METRICS[key]
+            self.gauges[key] = Gauge(name, doc, ["node", "chip"], **kw)
+
+    def collect_once(self) -> Dict[str, Dict[str, float]]:
+        """One scrape of libtpuinfo -> gauge updates. Returns {chip: {key: v}}
+        for tests."""
+        data = tpuinfo.metrics(self.dev_root)
+        out: Dict[str, Dict[str, float]] = {}
+        chips = data.get("chips", [])
+        for chip in chips:
+            cid = str(chip.get("index", 0))
+            values = {}
+            for key in self.enabled:
+                if key == "present":
+                    values[key] = float(chip.get("present", 1))
+                elif key == "hbm_total" and self.generation:
+                    values[key] = topo.HBM_GB.get(self.generation, 0) * 2**30
+                elif key == "ici_links" and self.host_topology:
+                    values[key] = float(
+                        topo.ici_link_count(
+                            self.host_topology, self.generation or "v5e"
+                        )
+                    )
+                elif key in chip:
+                    values[key] = float(chip[key])
+                else:
+                    continue
+                self.gauges[key].labels(node=self.node_name, chip=cid).set(
+                    values[key]
+                )
+            out[cid] = values
+        return out
+
+    def run(self, port: int = 9400, block: bool = True):
+        from prometheus_client import start_http_server
+
+        if self.registry is not None:
+            start_http_server(port, registry=self.registry)
+        else:
+            start_http_server(port)
+        log.info("tpu-metrics-exporter serving :%d/metrics", port)
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.collect_once()
+                except Exception:
+                    log.exception("collection failed")
+                self._stop.wait(self.interval_s)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        if block:
+            while not self._stop.is_set():
+                time.sleep(1)
+
+    def stop(self):
+        self._stop.set()
+
+
+def main(argv=None) -> int:
+    import argparse
+    import os
+
+    logging.basicConfig(level="INFO")
+    p = argparse.ArgumentParser("tpu-metrics-exporter")
+    p.add_argument("--port", type=int, default=9400)
+    p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
+    p.add_argument("--dev-root", default="/dev")
+    p.add_argument("--interval", type=float, default=10.0)
+    p.add_argument(
+        "--metrics-config",
+        default=os.environ.get("METRICS_CONFIG_FILE", ""),
+        help="file selecting which metrics to emit",
+    )
+    args = p.parse_args(argv)
+
+    enabled = None
+    if args.metrics_config and os.path.exists(args.metrics_config):
+        with open(args.metrics_config) as f:
+            enabled = parse_metrics_config(f.read())
+
+    generation = os.environ.get("TPU_GENERATION", "")
+    topology = os.environ.get("TPU_TOPOLOGY", "")
+    Exporter(
+        node_name=args.node_name,
+        dev_root=args.dev_root,
+        generation=generation,
+        host_topology=topology,
+        enabled_metrics=enabled,
+        interval_s=args.interval,
+    ).run(port=args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,112 @@
+"""Shared test/dev doubles: node builders and a kubelet simulator.
+
+Used by both the pytest suite and the operator's ``--fake`` dev mode, so
+the two can't drift (the kubelet simulation must handle hash-revision
+updates identically in both).
+"""
+
+from __future__ import annotations
+
+from tpu_operator import consts
+from tpu_operator.kube.client import Client, Obj
+
+
+def make_tpu_node(
+    name: str,
+    accelerator: str = "tpu-v5-lite-podslice",
+    topology: str = "2x4",
+    extra_labels: dict | None = None,
+) -> Obj:
+    """A GKE-style TPU node (reference test nodes carry minimal NFD labels,
+    ``controllers/object_controls_test.go:60-65``)."""
+    labels = {
+        "kubernetes.io/hostname": name,
+        consts.GKE_TPU_ACCELERATOR_LABEL: accelerator,
+        consts.GKE_TPU_TOPOLOGY_LABEL: topology,
+        consts.NFD_KERNEL_LABEL: "6.1.0-gke",
+        consts.NFD_OS_LABEL: "cos",
+        consts.NFD_OS_VERSION_LABEL: "117",
+    }
+    labels.update(extra_labels or {})
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": labels, "annotations": {}},
+        "status": {
+            "capacity": {},
+            "allocatable": {},
+            "nodeInfo": {
+                "containerRuntimeVersion": "containerd://1.7.0",
+                "kernelVersion": "6.1.0-gke",
+                "osImage": "Container-Optimized OS",
+            },
+        },
+    }
+
+
+def make_cpu_node(name: str) -> Obj:
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
+        "status": {
+            "capacity": {},
+            "allocatable": {},
+            "nodeInfo": {"containerRuntimeVersion": "containerd://1.7.0"},
+        },
+    }
+
+
+def simulate_kubelet_once(
+    client: Client,
+    namespace: str,
+    node_name: str = "fake-tpu-node-1",
+    pods_per_ds: int = 1,
+) -> None:
+    """One kubelet pass: mark every DaemonSet fully scheduled/available and
+    keep Running pods per OnDelete operand at the *current* revision hash —
+    including refreshing a stale pod after a template change (the case an
+    earlier diverged copy of this helper missed)."""
+    for ds in client.list("apps/v1", "DaemonSet", namespace):
+        if not ds.get("status"):
+            ds["status"] = {
+                "desiredNumberScheduled": pods_per_ds,
+                "numberUnavailable": 0,
+                "updatedNumberScheduled": pods_per_ds,
+            }
+            client.update_status(ds)
+        if ds["spec"].get("updateStrategy", {}).get("type") != "OnDelete":
+            continue
+        app = ds["spec"]["selector"]["matchLabels"]["app"]
+        h = (
+            ds["spec"]["template"]["metadata"]
+            .get("annotations", {})
+            .get(consts.LAST_APPLIED_HASH_ANNOTATION)
+        )
+        for i in range(pods_per_ds):
+            name = f"{app}-{i}"
+            pod = {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": name,
+                    "namespace": namespace,
+                    "labels": {"app": app},
+                    "annotations": {consts.LAST_APPLIED_HASH_ANNOTATION: h},
+                },
+                "spec": {"nodeName": node_name},
+                "status": {"phase": "Running"},
+            }
+            existing = client.get_or_none("v1", "Pod", name, namespace)
+            if existing is None:
+                client.create(pod)
+            elif (
+                existing["metadata"].get("annotations", {}).get(
+                    consts.LAST_APPLIED_HASH_ANNOTATION
+                )
+                != h
+            ):
+                pod["metadata"]["resourceVersion"] = existing["metadata"][
+                    "resourceVersion"
+                ]
+                client.update(pod)

@@ -52,8 +52,8 @@ def build_args(argv=None):
 
 
 def make_fake_client():
-    from tests.conftest import make_tpu_node  # dev-only dependency
     from tpu_operator.kube import FakeClient
+    from tpu_operator.kube.testing import make_tpu_node
 
     ns = os.environ.setdefault(consts.OPERATOR_NAMESPACE_ENV, consts.DEFAULT_NAMESPACE)
     client = FakeClient(
@@ -76,45 +76,13 @@ def make_fake_client():
 
 
 def _simulate_kubelet(client, namespace: str) -> None:
-    """Dev-mode kubelet: marks every DaemonSet fully scheduled/available and
-    keeps one Running pod per OnDelete operand at the current revision."""
-    from tpu_operator import consts as c
+    """Dev-mode kubelet loop (shared single-pass helper keeps this in sync
+    with the test suite's simulation)."""
+    from tpu_operator.kube.testing import simulate_kubelet_once
 
     while True:
         try:
-            for ds in client.list("apps/v1", "DaemonSet", namespace):
-                if not ds.get("status"):
-                    ds["status"] = {
-                        "desiredNumberScheduled": 1,
-                        "numberUnavailable": 0,
-                        "updatedNumberScheduled": 1,
-                    }
-                    client.update_status(ds)
-                if ds["spec"].get("updateStrategy", {}).get("type") != "OnDelete":
-                    continue
-                app = ds["spec"]["selector"]["matchLabels"]["app"]
-                h = (
-                    ds["spec"]["template"]["metadata"]
-                    .get("annotations", {})
-                    .get(c.LAST_APPLIED_HASH_ANNOTATION)
-                )
-                name = f"{app}-0"
-                existing = client.get_or_none("v1", "Pod", name, namespace)
-                if existing is None:
-                    client.create(
-                        {
-                            "apiVersion": "v1",
-                            "kind": "Pod",
-                            "metadata": {
-                                "name": name,
-                                "namespace": namespace,
-                                "labels": {"app": app},
-                                "annotations": {c.LAST_APPLIED_HASH_ANNOTATION: h},
-                            },
-                            "spec": {"nodeName": "fake-tpu-node-1"},
-                            "status": {"phase": "Running"},
-                        }
-                    )
+            simulate_kubelet_once(client, namespace)
         except Exception:
             logging.getLogger("tpu-operator").exception("kubelet sim error")
         time.sleep(1)

@@ -1,0 +1,147 @@
+"""ctypes bindings for ``libtpuinfo.so`` — the native chip probe.
+
+The NVML/DCGM slot (SURVEY.md §2.3): device enumeration, PCI topology and
+utilization counters are native C++ (``native/libtpuinfo.cpp``), loaded here
+via ctypes. Every call degrades gracefully: when the library is missing
+(pure-Python deployments, CI) a Python sysfs/devfs fallback provides the
+same data shape, so callers never branch.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import glob
+import json
+import os
+from typing import List, Optional
+
+_LIB_NAMES = ("libtpuinfo.so",)
+_SEARCH_DIRS = (
+    os.path.join(os.path.dirname(__file__), "..", "..", "native", "out"),
+    "/usr/local/lib",
+    "/usr/lib",
+)
+
+_lib = None
+_loaded = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _loaded
+    if _loaded:
+        return _lib
+    _loaded = True
+    candidates = [os.environ.get("LIBTPUINFO_PATH", "")]
+    for d in _SEARCH_DIRS:
+        for n in _LIB_NAMES:
+            candidates.append(os.path.join(d, n))
+    for path in candidates:
+        if path and os.path.exists(path):
+            try:
+                lib = ctypes.CDLL(path)
+                lib.tpuinfo_chip_count.restype = ctypes.c_int
+                lib.tpuinfo_chip_count.argtypes = [ctypes.c_char_p]
+                lib.tpuinfo_summary_json.restype = ctypes.c_int
+                lib.tpuinfo_summary_json.argtypes = [
+                    ctypes.c_char_p,
+                    ctypes.c_char_p,
+                    ctypes.c_int,
+                ]
+                lib.tpuinfo_metrics_json.restype = ctypes.c_int
+                lib.tpuinfo_metrics_json.argtypes = [
+                    ctypes.c_char_p,
+                    ctypes.c_char_p,
+                    ctypes.c_int,
+                ]
+                _lib = lib
+                return _lib
+            except OSError:
+                continue
+    return None
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def chip_count(dev_root: str = "/dev") -> int:
+    lib = _load()
+    if lib is not None:
+        n = lib.tpuinfo_chip_count(dev_root.encode())
+        if n >= 0:
+            return n
+    return len(_py_devices(dev_root))
+
+
+def chip_summary(dev_root: str = "/dev") -> List[dict]:
+    """Per-chip dicts: {index, path, pci_address?, numa_node?, vendor?}."""
+    lib = _load()
+    if lib is not None:
+        buf = ctypes.create_string_buffer(16384)
+        rc = lib.tpuinfo_summary_json(dev_root.encode(), buf, len(buf))
+        if rc == 0:
+            try:
+                return json.loads(buf.value.decode())
+            except json.JSONDecodeError:
+                pass
+    return [
+        {"index": i, "path": p, **_py_pci_info(p)}
+        for i, p in enumerate(_py_devices(dev_root))
+    ]
+
+
+def metrics(dev_root: str = "/dev") -> dict:
+    """Utilization counters; native gives real values, fallback gives
+    presence-only (the exporter labels the source)."""
+    lib = _load()
+    if lib is not None:
+        buf = ctypes.create_string_buffer(16384)
+        rc = lib.tpuinfo_metrics_json(dev_root.encode(), buf, len(buf))
+        if rc == 0:
+            try:
+                return json.loads(buf.value.decode())
+            except json.JSONDecodeError:
+                pass
+    devs = _py_devices(dev_root)
+    return {
+        "source": "fallback",
+        "chips": [{"index": i, "present": 1} for i in range(len(devs))],
+    }
+
+
+# ---------------------------------------------------------------------------
+# pure-Python fallbacks
+# ---------------------------------------------------------------------------
+
+
+def _py_devices(dev_root: str) -> List[str]:
+    accel = sorted(glob.glob(os.path.join(dev_root, "accel*")))
+    if accel:
+        return accel
+    return [
+        p
+        for p in sorted(glob.glob(os.path.join(dev_root, "vfio", "*")))
+        if os.path.basename(p) != "vfio"
+    ]
+
+
+def _py_pci_info(dev_path: str) -> dict:
+    name = os.path.basename(dev_path)
+    sys_dev = f"/sys/class/accel/{name}/device"
+    out = {}
+    try:
+        target = os.readlink(sys_dev)
+        out["pci_address"] = os.path.basename(target)
+    except OSError:
+        return out
+    try:
+        with open(os.path.join(sys_dev, "numa_node")) as f:
+            out["numa_node"] = int(f.read().strip())
+    except OSError:
+        pass
+    try:
+        with open(os.path.join(sys_dev, "vendor")) as f:
+            out["vendor"] = f.read().strip()
+    except OSError:
+        pass
+    return out

@@ -1,0 +1,83 @@
+"""CDI spec generation — the TPU runtime-wiring core.
+
+The reference's container-toolkit rewrites containerd/docker/crio configs
+and installs a runtime hook (``controllers/object_controls.go:1052-1184``).
+TPU-native collapses that to generating a Container Device Interface spec:
+every chip becomes a named CDI device carrying its device nodes, the libtpu
+mount and base env; runtimes with native CDI support inject them with no
+custom hook binary.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import yaml
+
+from tpu_operator import consts
+from tpu_operator.native import tpuinfo
+
+CDI_VERSION = "0.6.0"
+CDI_KIND = "google.com/tpu"
+DEFAULT_SPEC_PATH = "/var/run/cdi/google.com-tpu.yaml"
+
+
+def build_spec(
+    dev_root: str = "/dev",
+    libtpu_dir: str = consts.LIBTPU_HOST_DIR,
+    chips: Optional[List[dict]] = None,
+) -> dict:
+    chips = chips if chips is not None else tpuinfo.chip_summary(dev_root)
+    devices = []
+    all_nodes = []
+    for chip in chips:
+        path = chip.get("path", os.path.join(dev_root, f"accel{chip['index']}"))
+        node = {"path": path, "permissions": "rw"}
+        all_nodes.append(node)
+        devices.append(
+            {
+                "name": str(chip["index"]),
+                "containerEdits": {
+                    "deviceNodes": [dict(node)],
+                    "env": [f"TPU_CHIP_{chip['index']}=present"],
+                },
+            }
+        )
+    # the "all" composite device mirrors nvidia.com/gpu=all
+    devices.append(
+        {
+            "name": "all",
+            "containerEdits": {"deviceNodes": [dict(n) for n in all_nodes]},
+        }
+    )
+    return {
+        "cdiVersion": CDI_VERSION,
+        "kind": CDI_KIND,
+        "containerEdits": {
+            "mounts": [
+                {
+                    "hostPath": libtpu_dir,
+                    "containerPath": "/usr/lib/tpu",
+                    "options": ["ro", "rbind"],
+                }
+            ],
+            "env": ["TPU_LIBRARY_PATH=/usr/lib/tpu/libtpu.so"],
+        },
+        "devices": devices,
+    }
+
+
+def write_spec(
+    output_path: str = DEFAULT_SPEC_PATH,
+    dev_root: str = "/dev",
+    libtpu_dir: str = consts.LIBTPU_HOST_DIR,
+    chips: Optional[List[dict]] = None,
+) -> dict:
+    spec = build_spec(dev_root=dev_root, libtpu_dir=libtpu_dir, chips=chips)
+    os.makedirs(os.path.dirname(output_path), exist_ok=True)
+    tmp = output_path + ".tmp"
+    with open(tmp, "w") as f:
+        yaml.safe_dump(spec, f, sort_keys=False)
+    os.replace(tmp, output_path)  # atomic: runtimes watch this directory
+    return spec

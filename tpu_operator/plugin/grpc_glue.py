@@ -1,0 +1,105 @@
+"""gRPC service/stub glue for the DevicePlugin API.
+
+grpcio-tools isn't in the image, so the servicer/stub wiring that
+``protoc-gen-grpc`` would emit is written here by hand against the
+protoc-generated ``deviceplugin_pb2`` messages.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from tpu_operator.plugin.proto import pb2
+
+API_VERSION = "v1beta1"
+SERVICE_DEVICE_PLUGIN = "v1beta1.DevicePlugin"
+SERVICE_REGISTRATION = "v1beta1.Registration"
+
+
+def device_plugin_handler(servicer) -> grpc.GenericRpcHandler:
+    """Build the generic handler for a DevicePlugin servicer exposing
+    GetDevicePluginOptions / ListAndWatch / GetPreferredAllocation /
+    Allocate / PreStartContainer methods."""
+    rpcs = {
+        "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
+            servicer.GetDevicePluginOptions,
+            request_deserializer=pb2.Empty.FromString,
+            response_serializer=pb2.DevicePluginOptions.SerializeToString,
+        ),
+        "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+            servicer.ListAndWatch,
+            request_deserializer=pb2.Empty.FromString,
+            response_serializer=pb2.ListAndWatchResponse.SerializeToString,
+        ),
+        "GetPreferredAllocation": grpc.unary_unary_rpc_method_handler(
+            servicer.GetPreferredAllocation,
+            request_deserializer=pb2.GetPreferredAllocationRequest.FromString,
+            response_serializer=pb2.GetPreferredAllocationResponse.SerializeToString,
+        ),
+        "Allocate": grpc.unary_unary_rpc_method_handler(
+            servicer.Allocate,
+            request_deserializer=pb2.AllocateRequest.FromString,
+            response_serializer=pb2.AllocateResponse.SerializeToString,
+        ),
+        "PreStartContainer": grpc.unary_unary_rpc_method_handler(
+            servicer.PreStartContainer,
+            request_deserializer=pb2.PreStartContainerRequest.FromString,
+            response_serializer=pb2.PreStartContainerResponse.SerializeToString,
+        ),
+    }
+    return grpc.method_handlers_generic_handler(SERVICE_DEVICE_PLUGIN, rpcs)
+
+
+def registration_handler(servicer) -> grpc.GenericRpcHandler:
+    """Generic handler for a Registration servicer (used by the fake kubelet
+    in tests; the real kubelet implements this side)."""
+    rpcs = {
+        "Register": grpc.unary_unary_rpc_method_handler(
+            servicer.Register,
+            request_deserializer=pb2.RegisterRequest.FromString,
+            response_serializer=pb2.Empty.SerializeToString,
+        ),
+    }
+    return grpc.method_handlers_generic_handler(SERVICE_REGISTRATION, rpcs)
+
+
+class DevicePluginStub:
+    """Client stub (what the kubelet uses against our server; tests use it
+    to drive the plugin end-to-end)."""
+
+    def __init__(self, channel: grpc.Channel):
+        base = f"/{SERVICE_DEVICE_PLUGIN}/"
+        self.GetDevicePluginOptions = channel.unary_unary(
+            base + "GetDevicePluginOptions",
+            request_serializer=pb2.Empty.SerializeToString,
+            response_deserializer=pb2.DevicePluginOptions.FromString,
+        )
+        self.ListAndWatch = channel.unary_stream(
+            base + "ListAndWatch",
+            request_serializer=pb2.Empty.SerializeToString,
+            response_deserializer=pb2.ListAndWatchResponse.FromString,
+        )
+        self.GetPreferredAllocation = channel.unary_unary(
+            base + "GetPreferredAllocation",
+            request_serializer=pb2.GetPreferredAllocationRequest.SerializeToString,
+            response_deserializer=pb2.GetPreferredAllocationResponse.FromString,
+        )
+        self.Allocate = channel.unary_unary(
+            base + "Allocate",
+            request_serializer=pb2.AllocateRequest.SerializeToString,
+            response_deserializer=pb2.AllocateResponse.FromString,
+        )
+        self.PreStartContainer = channel.unary_unary(
+            base + "PreStartContainer",
+            request_serializer=pb2.PreStartContainerRequest.SerializeToString,
+            response_deserializer=pb2.PreStartContainerResponse.FromString,
+        )
+
+
+class RegistrationStub:
+    def __init__(self, channel: grpc.Channel):
+        self.Register = channel.unary_unary(
+            f"/{SERVICE_REGISTRATION}/Register",
+            request_serializer=pb2.RegisterRequest.SerializeToString,
+            response_deserializer=pb2.Empty.FromString,
+        )

@@ -1,0 +1,10 @@
+import os
+import sys
+
+# protoc --python_out generates a flat import-style module; expose it as a
+# package member regardless of how the process was launched.
+_here = os.path.dirname(__file__)
+if _here not in sys.path:
+    sys.path.insert(0, _here)
+
+import deviceplugin_pb2 as pb2  # noqa: E402,F401

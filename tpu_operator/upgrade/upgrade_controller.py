@@ -54,10 +54,10 @@ class UpgradeReconciler:
 
         state = self.manager.build_state()
         self.manager.apply_state(state, pol)
-        self._update_metrics(state)
+        self._update_metrics(state, pol)
         return Result(requeue_after=REQUEUE_S)
 
-    def _update_metrics(self, state: us.ClusterUpgradeState) -> None:
+    def _update_metrics(self, state: us.ClusterUpgradeState, pol) -> None:
         m = self.metrics
         if not getattr(m, "upgrades_in_progress", None):
             return
@@ -67,4 +67,18 @@ class UpgradeReconciler:
         m.upgrades_failed.set(state.count(us.STATE_FAILED))
         m.upgrades_pending.set(state.count(us.STATE_UPGRADE_REQUIRED))
         m.upgrades_unknown.set(state.count(us.STATE_UNKNOWN))
-        m.upgrades_available.set(max(0, state.count(us.STATE_UPGRADE_REQUIRED)))
+        # "available" = how many pending nodes the budget would admit NOW —
+        # the same arithmetic apply_state uses, not the raw pending count
+        total = len(state.all())
+        max_unavail = us.parse_max_unavailable(pol.max_unavailable, total)
+        unavailable = in_progress + state.count(us.STATE_FAILED)
+        budget = max(
+            0,
+            min(
+                (pol.max_parallel_upgrades or 1) - in_progress,
+                max_unavail - unavailable,
+            ),
+        )
+        m.upgrades_available.set(
+            min(budget, state.count(us.STATE_UPGRADE_REQUIRED))
+        )

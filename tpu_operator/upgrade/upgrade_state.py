@@ -411,6 +411,11 @@ class ClusterUpgradeStateManager:
 
     def cleanup_state_labels(self) -> None:
         """Strip per-node labels when auto-upgrade is disabled (reference
-        ``controllers/upgrade_controller.go:168-194``)."""
+        ``controllers/upgrade_controller.go:168-194``). Skips nodes the
+        listing already shows unlabeled — the common no-op path costs one
+        LIST, not one GET per node."""
         for node in self.client.list("v1", "Node"):
-            self.provider.clear_state(node)
+            if consts.UPGRADE_STATE_LABEL in (
+                node.get("metadata", {}).get("labels", {}) or {}
+            ):
+                self.provider.clear_state(node)

@@ -1,0 +1,5 @@
+import sys
+
+from tpu_operator.validator.main import main
+
+sys.exit(main())

@@ -1,0 +1,306 @@
+"""Validator components.
+
+TPU-native analogue of ``validator/main.go``'s component switch
+(``:439-545``): each component checks one layer of the stack and drops a
+status file into ``/run/tpu/validations`` — the host-local barrier that
+sequences the operand DaemonSets (``validator/main.go:123-157``).
+
+Component map (reference → TPU):
+  driver  → libtpu   (/dev/accel* or vfio devices + libtpu.so present)
+  toolkit → runtime  (CDI spec generated / device wiring present)
+  plugin  → plugin   (node capacity advertises google.com/tpu; optional
+                      1-chip workload pod)
+  cuda    → jax      (JAX matmul pod / in-process matmul with TFLOPS)
+  mofed   → (absent: no NIC fabric module on TPU; ICI needs no host driver)
+  vfio-pci→ vfio-pci (TPU PCI functions bound to vfio-pci)
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+from tpu_operator import consts
+
+log = logging.getLogger("tpu-validator")
+
+WAIT_RETRIES = 60  # reference validator/main.go:158-161 (60x5s)
+WAIT_SLEEP_S = 5
+PLUGIN_RETRIES = 30  # reference :162-165 (30x5s)
+
+
+class ValidationError(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# status files (reference validator/main.go:123-157,710-741)
+# ---------------------------------------------------------------------------
+
+
+class StatusFiles:
+    def __init__(self, output_dir: str = consts.VALIDATION_DIR):
+        self.dir = output_dir
+
+    def path(self, name: str) -> str:
+        return os.path.join(self.dir, name)
+
+    def write(self, name: str, payload: Optional[dict] = None) -> None:
+        os.makedirs(self.dir, exist_ok=True)
+        with open(self.path(name), "w") as f:
+            if payload is not None:
+                json.dump(payload, f)
+
+    def remove(self, name: str) -> None:
+        try:
+            os.unlink(self.path(name))
+        except FileNotFoundError:
+            pass
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(self.path(name))
+
+    def wait_for(self, name: str, retries: int = WAIT_RETRIES) -> None:
+        for _ in range(retries):
+            if self.exists(name):
+                return
+            log.info("waiting for %s", self.path(name))
+            time.sleep(WAIT_SLEEP_S)
+        raise ValidationError(f"timed out waiting for {self.path(name)}")
+
+
+# ---------------------------------------------------------------------------
+# libtpu component (driver slot: reference validator/main.go:607-679)
+# ---------------------------------------------------------------------------
+
+
+def find_tpu_devices(dev_root: str = "/dev") -> list:
+    """TPU chips appear as /dev/accel* (PCIe DMA path) or as /dev/vfio/*
+    groups on VM-passthrough hosts."""
+    accel = sorted(glob.glob(os.path.join(dev_root, "accel*")))
+    if accel:
+        return accel
+    vfio = [
+        p
+        for p in sorted(glob.glob(os.path.join(dev_root, "vfio", "*")))
+        if os.path.basename(p) != "vfio"
+    ]
+    return vfio
+
+
+def validate_libtpu(
+    status: StatusFiles,
+    install_dir: str = consts.LIBTPU_HOST_DIR,
+    dev_root: str = "/dev",
+    with_wait: bool = False,
+) -> dict:
+    """Devices visible + libtpu.so installed (chroot-nvidia-smi analogue).
+
+    Falls back to the native probe (``tpu-smoke`` via libtpuinfo) when
+    available for a richer chip table.
+    """
+    if with_wait:
+        status.wait_for(consts.STATUS_FILE_LIBTPU_CTR)
+    devices = find_tpu_devices(dev_root)
+    if not devices:
+        raise ValidationError(f"no TPU devices under {dev_root} (accel*/vfio)")
+    lib = os.path.join(install_dir, "libtpu.so")
+    versioned = sorted(glob.glob(os.path.join(install_dir, "libtpu*.so")))
+    if not os.path.exists(lib) and not versioned:
+        raise ValidationError(f"libtpu.so not found under {install_dir}")
+    info = {"devices": devices, "libtpu": lib if os.path.exists(lib) else versioned}
+    try:
+        from tpu_operator.native import tpuinfo
+
+        chips = tpuinfo.chip_summary()
+        if chips:
+            info["chips"] = chips
+    except Exception:
+        pass
+    status.write(consts.STATUS_FILE_LIBTPU, info)
+    return info
+
+
+# ---------------------------------------------------------------------------
+# runtime component (toolkit slot: reference validator/main.go:775-801)
+# ---------------------------------------------------------------------------
+
+
+def validate_runtime(
+    status: StatusFiles,
+    cdi_spec_path: str = "/var/run/cdi/google.com-tpu.yaml",
+    with_wait: bool = False,
+) -> dict:
+    """Device wiring present: the CDI spec exists and names every chip."""
+    if with_wait:
+        status.wait_for(consts.STATUS_FILE_LIBTPU)
+    if not os.path.exists(cdi_spec_path):
+        raise ValidationError(f"CDI spec missing at {cdi_spec_path}")
+    import yaml
+
+    with open(cdi_spec_path) as f:
+        spec = yaml.safe_load(f) or {}
+    devices = spec.get("devices", [])
+    if not devices:
+        raise ValidationError(f"CDI spec at {cdi_spec_path} lists no devices")
+    info = {"cdiSpec": cdi_spec_path, "devices": [d.get("name") for d in devices]}
+    status.write(consts.STATUS_FILE_RUNTIME, info)
+    return info
+
+
+# ---------------------------------------------------------------------------
+# plugin component (reference validator/main.go:931-1161)
+# ---------------------------------------------------------------------------
+
+
+def node_tpu_capacity(node: dict) -> int:
+    cap = node.get("status", {}).get("capacity", {}) or {}
+    total = 0
+    for key, val in cap.items():
+        if key == consts.TPU_RESOURCE or key.startswith(
+            consts.TPU_SUBSLICE_RESOURCE_PREFIX
+        ):
+            try:
+                total += int(val)
+            except (TypeError, ValueError):
+                pass
+    return total
+
+
+def validate_plugin(
+    status: StatusFiles,
+    client,
+    node_name: str,
+    with_wait: bool = False,
+    with_workload: bool = False,
+    namespace: str = "",
+    retries: int = PLUGIN_RETRIES,
+    sleep_s: float = WAIT_SLEEP_S,
+) -> dict:
+    """Node capacity advertises TPU chips (reference ``:1083-1161``), then
+    optionally proves schedulability with a 1-chip pod (``:931-1015``)."""
+    if with_wait:
+        status.wait_for(consts.STATUS_FILE_RUNTIME)
+    count = 0
+    for attempt in range(retries):
+        node = client.get("v1", "Node", node_name)
+        count = node_tpu_capacity(node)
+        if count > 0:
+            break
+        log.info(
+            "node %s reports no %s capacity yet (attempt %d)",
+            node_name,
+            consts.TPU_RESOURCE,
+            attempt,
+        )
+        time.sleep(sleep_s)
+    if count <= 0:
+        raise ValidationError(
+            f"node {node_name} never advertised {consts.TPU_RESOURCE}"
+        )
+    info = {"node": node_name, "capacity": count}
+    if with_workload:
+        from tpu_operator.validator import workload_pods
+
+        pod = workload_pods.plugin_workload_pod(node_name, namespace)
+        workload_pods.run_to_completion(client, pod)
+        info["workload"] = pod["metadata"]["name"]
+    status.write(consts.STATUS_FILE_PLUGIN, info)
+    return info
+
+
+# ---------------------------------------------------------------------------
+# jax component (cuda slot: reference validator/main.go:1217-1293)
+# ---------------------------------------------------------------------------
+
+
+def validate_jax(
+    status: StatusFiles,
+    client=None,
+    node_name: str = "",
+    namespace: str = "",
+    with_workload: bool = False,
+    expect_tpu: bool = True,
+    size: int = 4096,
+) -> dict:
+    """End-to-end chip proof.
+
+    ``with_workload`` spawns the JAX matmul pod (the vectorAdd-pod path,
+    crossing the API server); otherwise the matmul runs in-process (the
+    validator pod already has the chip mounted). Either way the status file
+    records achieved TFLOPS — the operator's benchmark surface.
+    """
+    if with_workload:
+        if client is None:
+            raise ValidationError("jax workload validation needs a k8s client")
+        from tpu_operator.validator import workload_pods
+
+        pod = workload_pods.jax_workload_pod(node_name, namespace)
+        result = workload_pods.run_to_completion(client, pod)
+        info = {"workload": pod["metadata"]["name"], "result": result}
+    else:
+        from tpu_operator.workloads.matmul import run_matmul_validation
+
+        res = run_matmul_validation(size=size, expect_tpu=expect_tpu)
+        if not res.ok:
+            raise ValidationError(f"jax matmul failed: {res.error}")
+        info = res.to_dict()
+    status.write(consts.STATUS_FILE_JAX, info)
+    return info
+
+
+# ---------------------------------------------------------------------------
+# slice component (burn-in across all local chips)
+# ---------------------------------------------------------------------------
+
+
+def validate_slice(
+    status: StatusFiles, steps: int = 10, expect_devices: Optional[int] = None
+) -> dict:
+    """Multi-chip burn-in: sharded train step exercising every ICI axis."""
+    from tpu_operator.workloads.burnin import run_burnin
+
+    res = run_burnin(n_devices=expect_devices, steps=steps)
+    if not res.ok:
+        raise ValidationError(f"slice burn-in failed: {res.error or 'loss did not decrease'}")
+    status.write(consts.STATUS_FILE_SLICE, res.to_dict())
+    return res.to_dict()
+
+
+# ---------------------------------------------------------------------------
+# vfio-pci component (reference validator/main.go:1301-1501, go-nvlib PCI)
+# ---------------------------------------------------------------------------
+
+GOOGLE_PCI_VENDOR = "0x1ae0"
+
+
+def validate_vfio_pci(
+    status: StatusFiles, sysfs: str = "/sys/bus/pci/devices"
+) -> dict:
+    """Every Google PCI accelerator function must be bound to vfio-pci."""
+    bound, unbound = [], []
+    if not os.path.isdir(sysfs):
+        raise ValidationError(f"no sysfs PCI tree at {sysfs}")
+    for addr in sorted(os.listdir(sysfs)):
+        vendor_path = os.path.join(sysfs, addr, "vendor")
+        try:
+            with open(vendor_path) as f:
+                vendor = f.read().strip()
+        except OSError:
+            continue
+        if vendor != GOOGLE_PCI_VENDOR:
+            continue
+        driver = os.path.join(sysfs, addr, "driver")
+        target = os.path.basename(os.readlink(driver)) if os.path.islink(driver) else ""
+        (bound if target == "vfio-pci" else unbound).append(addr)
+    if unbound:
+        raise ValidationError(f"TPU functions not bound to vfio-pci: {unbound}")
+    if not bound:
+        raise ValidationError("no Google PCI accelerator functions found")
+    info = {"bound": bound}
+    status.write("vfio-pci-ready", info)
+    return info

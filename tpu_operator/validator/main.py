@@ -1,0 +1,165 @@
+"""``tpu-validator`` CLI (reference ``validator/main.go`` urfave/cli binary).
+
+Run as initContainers inside the operand DaemonSets (``--component X``) and
+as the long-running node-status exporter (``--component nodestatus``).
+Flags mirror env vars like the reference (``validator/main.go:212-315``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+from tpu_operator import consts
+from tpu_operator.validator import components as comp
+from tpu_operator.validator.components import StatusFiles, ValidationError
+
+COMPONENTS = (
+    "libtpu",
+    "runtime",
+    "plugin",
+    "jax",
+    "slice",
+    "vfio-pci",
+    "nodestatus",
+)
+
+
+def build_parser():
+    p = argparse.ArgumentParser("tpu-validator")
+    p.add_argument(
+        "--component",
+        "-c",
+        required=True,
+        choices=COMPONENTS,
+        help="which layer to validate",
+    )
+    p.add_argument(
+        "--output-dir",
+        default=os.environ.get("VALIDATION_OUTPUT_DIR", consts.VALIDATION_DIR),
+    )
+    p.add_argument(
+        "--with-wait",
+        action="store_true",
+        default=os.environ.get("WITH_WAIT", "") == "true",
+        help="block on the previous barrier's status file first",
+    )
+    p.add_argument(
+        "--with-workload",
+        action="store_true",
+        default=os.environ.get("WITH_WORKLOAD", "") == "true",
+        help="spawn a workload pod instead of validating in-process",
+    )
+    p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
+    p.add_argument(
+        "--namespace",
+        default=os.environ.get(consts.OPERATOR_NAMESPACE_ENV, ""),
+    )
+    p.add_argument(
+        "--libtpu-install-dir",
+        default=os.environ.get("LIBTPU_INSTALL_DIR", consts.LIBTPU_HOST_DIR),
+    )
+    p.add_argument(
+        "--cdi-spec",
+        default=os.environ.get("CDI_SPEC_PATH", "/var/run/cdi/google.com-tpu.yaml"),
+    )
+    p.add_argument("--dev-root", default="/dev")
+    p.add_argument("--sysfs", default="/sys/bus/pci/devices")
+    p.add_argument("--metrics-port", type=int, default=8000)
+    p.add_argument("--matmul-size", type=int, default=4096)
+    p.add_argument(
+        "--expect-devices",
+        type=int,
+        default=int(os.environ.get("EXPECT_TPU_DEVICES", "0")) or None,
+    )
+    p.add_argument(
+        "--allow-cpu",
+        action="store_true",
+        help="dev mode: accept a non-TPU JAX platform for --component jax",
+    )
+    return p
+
+
+def make_client():
+    from tpu_operator.kube.rest import RestClient
+
+    return RestClient()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level="INFO", format="%(asctime)s %(name)s %(levelname)s %(message)s"
+    )
+    log = logging.getLogger("tpu-validator")
+    args = build_parser().parse_args(argv)
+    status = StatusFiles(args.output_dir)
+
+    try:
+        if args.component == "libtpu":
+            info = comp.validate_libtpu(
+                status,
+                install_dir=args.libtpu_install_dir,
+                dev_root=args.dev_root,
+                with_wait=args.with_wait,
+            )
+        elif args.component == "runtime":
+            info = comp.validate_runtime(
+                status, cdi_spec_path=args.cdi_spec, with_wait=args.with_wait
+            )
+        elif args.component == "plugin":
+            info = comp.validate_plugin(
+                status,
+                make_client(),
+                args.node_name,
+                with_wait=args.with_wait,
+                with_workload=args.with_workload,
+                namespace=args.namespace,
+            )
+        elif args.component == "jax":
+            client = make_client() if args.with_workload else None
+            info = comp.validate_jax(
+                status,
+                client=client,
+                node_name=args.node_name,
+                namespace=args.namespace,
+                with_workload=args.with_workload,
+                expect_tpu=not args.allow_cpu,
+                size=args.matmul_size,
+            )
+        elif args.component == "slice":
+            info = comp.validate_slice(
+                status, expect_devices=args.expect_devices
+            )
+        elif args.component == "vfio-pci":
+            info = comp.validate_vfio_pci(status, sysfs=args.sysfs)
+        elif args.component == "nodestatus":
+            from tpu_operator.validator.metrics import NodeMetrics
+
+            client = None
+            try:
+                client = make_client()
+            except Exception:
+                log.warning("no in-cluster client; capacity gauge disabled")
+            NodeMetrics(
+                client=client,
+                node_name=args.node_name,
+                status=status,
+                port=args.metrics_port,
+                install_dir=args.libtpu_install_dir,
+                dev_root=args.dev_root,
+            ).run()
+            return 0
+        else:  # pragma: no cover
+            raise ValidationError(f"unknown component {args.component}")
+        log.info("%s validation OK: %s", args.component, json.dumps(info)[:400])
+        return 0
+    except ValidationError as e:
+        log.error("%s validation FAILED: %s", args.component, e)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,144 @@
+"""Validator metrics mode — the node-status exporter.
+
+Analogue of ``validator/metrics.go``: a Prometheus endpoint exporting
+per-node readiness gauges by watching the status files (30 s), re-running
+the libtpu validation (60 s), counting device-plugin resources (30 s) and
+counting TPU PCI devices (60 s) (``validator/metrics.go:159-301``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from tpu_operator import consts
+from tpu_operator.validator.components import (
+    StatusFiles,
+    find_tpu_devices,
+    node_tpu_capacity,
+)
+
+log = logging.getLogger("tpu-validator.metrics")
+
+
+class NodeMetrics:
+    """reference ``NodeMetrics`` (``validator/metrics.go:52-70``)."""
+
+    WATCH_STATUS_S = 30
+    WATCH_PLUGIN_S = 30
+    WATCH_LIBTPU_S = 60
+    WATCH_PCI_S = 60
+
+    def __init__(
+        self,
+        client=None,
+        node_name: str = "",
+        status: StatusFiles = None,
+        port: int = 8000,
+        install_dir: str = consts.LIBTPU_HOST_DIR,
+        dev_root: str = "/dev",
+    ):
+        from prometheus_client import Gauge
+
+        self.client = client
+        self.node_name = node_name
+        self.status = status or StatusFiles()
+        self.port = port
+        self.install_dir = install_dir
+        self.dev_root = dev_root
+        self._stop = threading.Event()
+
+        ns = "tpu_validator"
+        mk = lambda name, doc: Gauge(f"{ns}_{name}", doc, ["node"])  # noqa: E731
+        # per-status-file readiness (reference metric defs :73-157)
+        self.g_libtpu = mk("libtpu_ready", "libtpu validation status file present")
+        self.g_runtime = mk("runtime_ready", "runtime validation status file present")
+        self.g_plugin = mk("plugin_ready", "plugin validation status file present")
+        self.g_jax = mk("jax_ready", "jax validation status file present")
+        self.g_libtpu_valid = mk(
+            "libtpu_validation", "live libtpu re-validation result"
+        )
+        self.g_capacity = mk("tpu_capacity", "google.com/tpu in node capacity")
+        self.g_devices = mk("tpu_devices", "TPU device files visible on host")
+        self.g_jax_tflops = mk(
+            "jax_matmul_tflops", "TFLOPS recorded by the last jax validation"
+        )
+
+    # ------------------------------------------------------------------
+    def _watch_status_files(self):
+        files = {
+            consts.STATUS_FILE_LIBTPU: self.g_libtpu,
+            consts.STATUS_FILE_RUNTIME: self.g_runtime,
+            consts.STATUS_FILE_PLUGIN: self.g_plugin,
+            consts.STATUS_FILE_JAX: self.g_jax,
+        }
+        while not self._stop.is_set():
+            for name, gauge in files.items():
+                gauge.labels(node=self.node_name).set(
+                    1 if self.status.exists(name) else 0
+                )
+            # surface the recorded TFLOPS from the jax status payload
+            try:
+                import json
+
+                with open(self.status.path(consts.STATUS_FILE_JAX)) as f:
+                    payload = json.load(f)
+                tflops = payload.get("tflops") or payload.get("result", {}).get("tflops")
+                if tflops:
+                    self.g_jax_tflops.labels(node=self.node_name).set(float(tflops))
+            except Exception:
+                pass
+            self._stop.wait(self.WATCH_STATUS_S)
+
+    def _watch_libtpu(self):
+        import glob
+        import os
+
+        while not self._stop.is_set():
+            ok = bool(find_tpu_devices(self.dev_root)) and bool(
+                glob.glob(os.path.join(self.install_dir, "libtpu*.so"))
+            )
+            self.g_libtpu_valid.labels(node=self.node_name).set(1 if ok else 0)
+            self._stop.wait(self.WATCH_LIBTPU_S)
+
+    def _watch_plugin_capacity(self):
+        while not self._stop.is_set():
+            if self.client is not None and self.node_name:
+                try:
+                    node = self.client.get("v1", "Node", self.node_name)
+                    self.g_capacity.labels(node=self.node_name).set(
+                        node_tpu_capacity(node)
+                    )
+                except Exception:
+                    log.exception("capacity watch failed")
+            self._stop.wait(self.WATCH_PLUGIN_S)
+
+    def _watch_devices(self):
+        while not self._stop.is_set():
+            self.g_devices.labels(node=self.node_name).set(
+                len(find_tpu_devices(self.dev_root))
+            )
+            self._stop.wait(self.WATCH_PCI_S)
+
+    # ------------------------------------------------------------------
+    def run(self, block: bool = True):
+        """reference ``Run`` (``validator/metrics.go:304-320``)."""
+        from prometheus_client import start_http_server
+
+        start_http_server(self.port)
+        threads = [
+            threading.Thread(target=self._watch_status_files, daemon=True),
+            threading.Thread(target=self._watch_libtpu, daemon=True),
+            threading.Thread(target=self._watch_plugin_capacity, daemon=True),
+            threading.Thread(target=self._watch_devices, daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        log.info("node-status exporter serving :%d/metrics", self.port)
+        if block:
+            while not self._stop.is_set():
+                time.sleep(1)
+
+    def stop(self):
+        self._stop.set()

@@ -1,0 +1,143 @@
+"""Validation workload pods.
+
+The reference spawns a ``cuda-vectoradd`` pod and polls it to Succeeded
+(``validator/main.go:931-1015,1217-1293``; pod specs in
+``validator/cuda-workload-validation.yaml`` /
+``plugin-workload-validation.yaml``). TPU equivalents: a JAX matmul pod
+(jax-validation) and a 1-chip ``jax.devices()`` smoke pod
+(plugin-validation), owner-ref'd to the validator DaemonSet so cluster GC
+reaps them (``validator/main.go:1017-1059``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from tpu_operator import consts
+
+log = logging.getLogger("tpu-validator")
+
+POLL_RETRIES = 60  # reference validator/main.go:158-161
+POLL_SLEEP_S = 5
+
+JAX_MATMUL_SCRIPT = (
+    "import jax, jax.numpy as jnp; "
+    "devs = jax.devices(); assert devs and devs[0].platform == 'tpu', devs; "
+    "a = jnp.ones((1024, 1024), jnp.bfloat16); "
+    "out = jnp.dot(a, a, preferred_element_type=jnp.float32); "
+    "out.block_until_ready(); "
+    "assert float(out[0, 0]) == 1024.0, float(out[0, 0]); "
+    "print('TPU matmul OK on', devs[0].device_kind)"
+)
+
+PLUGIN_SMOKE_SCRIPT = (
+    "import jax; devs = jax.devices(); "
+    "assert devs and devs[0].platform == 'tpu', devs; "
+    "print(len(devs), 'TPU device(s) visible')"
+)
+
+
+def _workload_pod(
+    name: str, node_name: str, namespace: str, script: str, image: str
+) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": {"app": name},
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "nodeName": node_name,
+            "runtimeClassName": None,  # filled by operator policy if needed
+            "tolerations": [
+                {
+                    "key": consts.TPU_RESOURCE,
+                    "operator": "Exists",
+                    "effect": "NoSchedule",
+                }
+            ],
+            "containers": [
+                {
+                    "name": name,
+                    "image": image,
+                    "command": ["python3", "-c", script],
+                    "resources": {
+                        "limits": {consts.TPU_RESOURCE: "1"},
+                        "requests": {consts.TPU_RESOURCE: "1"},
+                    },
+                }
+            ],
+        },
+    }
+
+
+def jax_workload_pod(
+    node_name: str, namespace: str, image: str = ""
+) -> dict:
+    import os
+
+    image = image or os.environ.get(
+        "JAX_WORKLOAD_IMAGE", "gcr.io/tpu-operator/jax-validator:latest"
+    )
+    return _workload_pod(
+        "tpu-jax-validator", node_name, namespace, JAX_MATMUL_SCRIPT, image
+    )
+
+
+def plugin_workload_pod(
+    node_name: str, namespace: str, image: str = ""
+) -> dict:
+    import os
+
+    image = image or os.environ.get(
+        "JAX_WORKLOAD_IMAGE", "gcr.io/tpu-operator/jax-validator:latest"
+    )
+    return _workload_pod(
+        "tpu-plugin-validator", node_name, namespace, PLUGIN_SMOKE_SCRIPT, image
+    )
+
+
+def set_owner_daemonset(client, pod: dict, namespace: str, app: str) -> None:
+    """Owner the workload pod to the validator DaemonSet so it's GC'd with
+    it (reference ``:1017-1035``)."""
+    ds = client.get_or_none("apps/v1", "DaemonSet", app, namespace)
+    if ds is None:
+        return
+    meta = ds["metadata"]
+    pod["metadata"]["ownerReferences"] = [
+        {
+            "apiVersion": "apps/v1",
+            "kind": "DaemonSet",
+            "name": meta["name"],
+            "uid": meta.get("uid", ""),
+            "controller": True,
+        }
+    ]
+
+
+def run_to_completion(
+    client,
+    pod: dict,
+    retries: int = POLL_RETRIES,
+    sleep_s: float = POLL_SLEEP_S,
+) -> str:
+    """Create (recreating any stale instance) and poll to Succeeded
+    (reference ``:1042-1059``)."""
+    meta = pod["metadata"]
+    ns, name = meta["namespace"], meta["name"]
+    client.delete_if_exists("v1", "Pod", name, ns)
+    set_owner_daemonset(client, pod, ns, "tpu-operator-validator")
+    client.create(pod)
+    for _ in range(retries):
+        live = client.get_or_none("v1", "Pod", name, ns)
+        phase = (live or {}).get("status", {}).get("phase", "")
+        if phase == "Succeeded":
+            return phase
+        if phase == "Failed":
+            raise RuntimeError(f"workload pod {ns}/{name} failed")
+        time.sleep(sleep_s)
+    raise RuntimeError(f"workload pod {ns}/{name} did not complete")

@@ -1,0 +1,148 @@
+"""Multi-chip slice burn-in: a sharded training step over a device Mesh.
+
+The multi-host validation workload (SURVEY.md §7 "readiness semantics on
+multi-host slices"): a pod-slice is only healthy if every chip computes AND
+every ICI link carries collectives. A plain per-chip matmul proves the
+former; this burn-in proves the latter by jitting a real train step whose
+gradient sync (``psum`` over ``dp``) and tensor-parallel matmuls
+(``all_gather``/``reduce_scatter`` over ``tp``) ride every mesh axis.
+
+TPU-first: the model is sharded with ``jax.sharding.NamedSharding`` +
+``jit`` so XLA inserts the collectives; no hand-written per-device code.
+The same function runs on a virtual CPU mesh (tests, dryrun) and a real
+multi-chip slice (the validator's ``--component slice`` burn-in).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+
+@dataclass
+class BurninResult:
+    ok: bool
+    n_devices: int
+    mesh_shape: Tuple[int, int]
+    steps: int
+    final_loss: float
+    loss_decreased: bool
+    error: str = ""
+
+    def to_dict(self):
+        return {
+            "ok": self.ok,
+            "n_devices": self.n_devices,
+            "mesh_shape": list(self.mesh_shape),
+            "steps": self.steps,
+            "final_loss": round(self.final_loss, 6),
+            "loss_decreased": self.loss_decreased,
+            "error": self.error,
+        }
+
+
+def _mesh_shape(n: int) -> Tuple[int, int]:
+    """Factor n into (dp, tp), as square as possible with tp a power of two."""
+    tp = 1
+    while tp * 2 <= n and n % (tp * 2) == 0 and tp * 2 <= int(n**0.5) + 1:
+        tp *= 2
+    return n // tp, tp
+
+
+def build_burnin(
+    n_devices: Optional[int] = None,
+    batch: int = 32,
+    d_model: int = 256,
+    d_hidden: int = 512,
+):
+    """Construct (mesh, jitted train step, params, opt_state, data).
+
+    Layout: batch sharded over ``dp``; the two MLP weight matrices sharded
+    over ``tp`` on their contracting/output dims, forcing XLA to insert
+    all-gather/reduce-scatter on ``tp`` and psum on ``dp`` for the gradient
+    mean — every ICI axis carries traffic each step.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} devices, have {len(devices)} "
+                f"(platform={devices[0].platform})"
+            )
+        devices = devices[:n_devices]
+    n = len(devices)
+    dp, tp = _mesh_shape(n)
+    import numpy as np
+
+    mesh = Mesh(np.asarray(devices).reshape(dp, tp), axis_names=("dp", "tp"))
+
+    key = jax.random.PRNGKey(42)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    params = {
+        "w1": jax.random.normal(k1, (d_model, d_hidden), jnp.float32)
+        * (1.0 / d_model**0.5),
+        "w2": jax.random.normal(k2, (d_hidden, d_model), jnp.float32)
+        * (1.0 / d_hidden**0.5),
+    }
+    x = jax.random.normal(k3, (batch, d_model), jnp.float32)
+    # a fixed random target makes the loss strictly decreasing under SGD
+    y = jax.random.normal(k4, (batch, d_model), jnp.float32)
+
+    param_sharding = {
+        "w1": NamedSharding(mesh, P(None, "tp")),  # column-parallel
+        "w2": NamedSharding(mesh, P("tp", None)),  # row-parallel
+    }
+    data_sharding = NamedSharding(mesh, P("dp", None))
+    params = jax.device_put(params, param_sharding)
+    x = jax.device_put(x, data_sharding)
+    y = jax.device_put(y, data_sharding)
+
+    def loss_fn(p, xb, yb):
+        h = jnp.dot(xb, p["w1"], preferred_element_type=jnp.float32)
+        h = jax.nn.gelu(h)
+        out = jnp.dot(h, p["w2"], preferred_element_type=jnp.float32)
+        return jnp.mean((out - yb) ** 2)
+
+    @jax.jit
+    def train_step(p, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
+        # SGD; XLA emits the dp psum for the grad mean and tp collectives
+        # for the sharded matmuls
+        new_p = jax.tree_util.tree_map(lambda w, g: w - 0.05 * g, p, grads)
+        return new_p, loss
+
+    return mesh, train_step, params, (x, y)
+
+
+def run_burnin(
+    n_devices: Optional[int] = None, steps: int = 20, **kw
+) -> BurninResult:
+    try:
+        mesh, train_step, params, (x, y) = build_burnin(n_devices=n_devices, **kw)
+        losses = []
+        for _ in range(steps):
+            params, loss = train_step(params, x, y)
+            losses.append(float(loss))
+        dp, tp = mesh.devices.shape
+        return BurninResult(
+            ok=losses[-1] < losses[0],
+            n_devices=mesh.devices.size,
+            mesh_shape=(dp, tp),
+            steps=steps,
+            final_loss=losses[-1],
+            loss_decreased=losses[-1] < losses[0],
+        )
+    except Exception as e:
+        return BurninResult(
+            ok=False,
+            n_devices=0,
+            mesh_shape=(0, 0),
+            steps=steps,
+            final_loss=float("nan"),
+            loss_decreased=False,
+            error=str(e),
+        )

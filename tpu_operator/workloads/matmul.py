@@ -1,0 +1,172 @@
+"""JAX matmul validation workload — the CUDA ``vectorAdd`` slot.
+
+The reference proves end-to-end GPU access by running a tiny CUDA binary in
+a pod (``validator/cuda-workload-validation.yaml:20``,
+``validator/main.go:1217-1293``). The TPU equivalent both *proves* chip
+access (``jax.devices()`` + a correctness-checked matmul) and *measures* it:
+the validation emits achieved bf16 TFLOPS/chip, which is the operator's
+headline benchmark (BASELINE.md).
+
+TPU-first design notes:
+* bf16 inputs, f32 accumulation (``preferred_element_type``) — the MXU's
+  native contract;
+* sizes are multiples of 256 so XLA tiles cleanly onto the 128×128 MXU;
+* a K-chained matmul loop under one ``jit`` keeps the benchmark
+  compute-bound instead of HBM-bound, measuring the systolic array rather
+  than input streaming;
+* everything is statically shaped; timing uses ``block_until_ready``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from tpu_operator.workloads.topology import PEAK_BF16_TFLOPS
+
+
+@dataclass
+class MatmulResult:
+    ok: bool
+    device_kind: str
+    platform: str
+    n_devices: int
+    size: int
+    iters: int
+    elapsed_s: float
+    tflops: float
+    peak_tflops: Optional[float]
+    utilization: Optional[float]
+    error: str = ""
+
+    def to_dict(self):
+        return {
+            "ok": self.ok,
+            "device_kind": self.device_kind,
+            "platform": self.platform,
+            "n_devices": self.n_devices,
+            "size": self.size,
+            "iters": self.iters,
+            "elapsed_s": round(self.elapsed_s, 6),
+            "tflops": round(self.tflops, 3),
+            "peak_tflops": self.peak_tflops,
+            "utilization": round(self.utilization, 4)
+            if self.utilization is not None
+            else None,
+            "error": self.error,
+        }
+
+
+def device_generation(device_kind: str) -> Optional[str]:
+    """Map ``jax.devices()[0].device_kind`` to a TPU generation tag."""
+    kind = device_kind.lower()
+    if "v6" in kind:
+        return "v6e"
+    if "v5p" in kind or ("v5" in kind and "lite" not in kind and "e" not in kind):
+        return "v5p"
+    if "v5" in kind:
+        return "v5e"
+    if "v4" in kind:
+        return "v4"
+    return None
+
+
+def make_matmul_step(size: int = 4096, depth: int = 8, dtype=None):
+    """Build the jitted validation step: a chain of ``depth`` matmuls with a
+    cheap nonlinearity, so one dispatch amortizes launch overhead and the
+    MXU stays hot. Returns ``(fn, example_args)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.bfloat16
+
+    def step(a, b):
+        x = a
+        for _ in range(depth):
+            x = jnp.dot(x, b, preferred_element_type=jnp.float32)
+            # cheap VPU op fused by XLA into the matmul epilogue; keeps
+            # magnitudes bounded without extra HBM traffic
+            x = (x * (1.0 / size)).astype(dtype)
+        return x
+
+    fn = jax.jit(step)
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (size, size), dtype=dtype)
+    b = jax.random.normal(k2, (size, size), dtype=dtype)
+    return fn, (a, b)
+
+
+def run_matmul_validation(
+    size: int = 4096,
+    depth: int = 8,
+    iters: int = 10,
+    expect_tpu: bool = False,
+) -> MatmulResult:
+    """Validate chip access and measure achieved TFLOPS on one device."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+    except Exception as e:  # pragma: no cover
+        return MatmulResult(
+            False, "", "", 0, size, iters, 0.0, 0.0, None, None, error=str(e)
+        )
+
+    try:
+        devices = jax.devices()
+        if not devices:
+            raise RuntimeError("jax.devices() is empty")
+        dev = devices[0]
+        platform = dev.platform
+        if expect_tpu and platform != "tpu":
+            raise RuntimeError(f"expected TPU, found platform={platform}")
+
+        fn, (a, b) = make_matmul_step(size=size, depth=depth)
+        # correctness probe on a small slice (f32 reference)
+        small = 256
+        sa = a[:small, :small].astype(jnp.float32)
+        sb = b[:small, :small].astype(jnp.float32)
+        want = np.asarray(jnp.dot(sa, sb))
+        got = np.asarray(
+            jnp.dot(
+                a[:small, :small], b[:small, :small],
+                preferred_element_type=jnp.float32,
+            )
+        )
+        rel = np.abs(got - want) / (np.abs(want) + 1.0)
+        if float(rel.mean()) > 0.02:
+            raise RuntimeError(f"matmul numerics off: mean rel err {rel.mean():.4f}")
+
+        # warmup/compile
+        fn(a, b).block_until_ready()
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(a, b)
+        out.block_until_ready()
+        elapsed = time.perf_counter() - t0
+
+        flops = 2.0 * size * size * size * depth * iters
+        tflops = flops / elapsed / 1e12
+        gen = device_generation(dev.device_kind)
+        peak = PEAK_BF16_TFLOPS.get(gen) if gen else None
+        util = tflops / peak if peak else None
+        return MatmulResult(
+            ok=True,
+            device_kind=dev.device_kind,
+            platform=platform,
+            n_devices=len(devices),
+            size=size,
+            iters=iters,
+            elapsed_s=elapsed,
+            tflops=tflops,
+            peak_tflops=peak,
+            utilization=util,
+        )
+    except Exception as e:
+        return MatmulResult(
+            False, "", "", 0, size, iters, 0.0, 0.0, None, None, error=str(e)
+        )

@@ -1,0 +1,265 @@
+"""ICI topology math.
+
+The TPU-fabric story at the operator's altitude (SURVEY.md §2.4): pure
+functions describing chip meshes so that
+
+* feature discovery can publish topology/wrap labels,
+* the device plugin can do ICI-contiguity-aware allocation,
+* the slice manager can enumerate valid subslice partitions.
+
+A topology string is GKE's ``cloud.google.com/gke-tpu-topology`` form:
+``"2x4"`` (v5e/v6e 2-D meshes) or ``"2x2x4"`` (v4/v5p 3-D tori). Wraparound
+(torus) links exist on a dimension when its extent is a multiple of 4 on 3-D
+generations — the rule used by libtpu for v4/v5p slices.
+
+No k8s, no JAX here: this module is also consumed by the native tooling
+tests and must stay dependency-free.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+Coord = Tuple[int, ...]
+
+# chips per host by generation (how kubelet-visible devices map onto hosts)
+CHIPS_PER_HOST = {"v4": 4, "v5e": 8, "v5p": 4, "v6e": 8}
+
+# single-chip peak bf16 TFLOPS (public numbers) — used for bench reporting
+PEAK_BF16_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+
+# HBM GiB per chip
+HBM_GB = {"v4": 32, "v5e": 16, "v5p": 95, "v6e": 32}
+
+
+def parse_topology(topology: str) -> Tuple[int, ...]:
+    """``"2x2x4"`` -> ``(2, 2, 4)``."""
+    if not topology:
+        raise ValueError("empty topology")
+    try:
+        dims = tuple(int(p) for p in topology.lower().split("x"))
+    except ValueError as e:
+        raise ValueError(f"bad topology {topology!r}") from e
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"bad topology {topology!r}")
+    return dims
+
+
+def format_topology(dims: Sequence[int]) -> str:
+    return "x".join(str(d) for d in dims)
+
+
+def chip_count(topology: str) -> int:
+    n = 1
+    for d in parse_topology(topology):
+        n *= d
+    return n
+
+
+def host_count(topology: str, generation: str) -> int:
+    per_host = CHIPS_PER_HOST.get(generation, 4)
+    chips = chip_count(topology)
+    return max(1, chips // per_host)
+
+
+def wraparound_dims(topology: str, generation: str) -> Tuple[bool, ...]:
+    """Which dimensions have torus wrap links.
+
+    3-D generations (v4/v5p) wrap a dimension when its extent is a multiple
+    of 4; 2-D mesh generations (v5e/v6e) have no wrap.
+    """
+    dims = parse_topology(topology)
+    if len(dims) < 3:
+        return tuple(False for _ in dims)
+    return tuple(d >= 4 and d % 4 == 0 for d in dims)
+
+
+def chip_coords(topology: str) -> List[Coord]:
+    """All chip coordinates in row-major order."""
+    dims = parse_topology(topology)
+    return [c for c in itertools.product(*(range(d) for d in dims))]
+
+
+def coord_to_index(coord: Coord, dims: Sequence[int]) -> int:
+    idx = 0
+    for c, d in zip(coord, dims):
+        idx = idx * d + c
+    return idx
+
+
+def index_to_coord(index: int, dims: Sequence[int]) -> Coord:
+    coord = []
+    for d in reversed(dims):
+        coord.append(index % d)
+        index //= d
+    return tuple(reversed(coord))
+
+
+def neighbors(coord: Coord, topology: str, generation: str) -> List[Coord]:
+    """ICI neighbors of a chip (±1 per dimension, wrap where torus)."""
+    dims = parse_topology(topology)
+    wraps = wraparound_dims(topology, generation)
+    out = []
+    for axis, extent in enumerate(dims):
+        if extent == 1:
+            continue
+        for delta in (-1, 1):
+            c = list(coord)
+            nxt = c[axis] + delta
+            if 0 <= nxt < extent:
+                c[axis] = nxt
+            elif wraps[axis]:
+                c[axis] = nxt % extent
+            else:
+                continue
+            cand = tuple(c)
+            if cand != coord and cand not in out:
+                out.append(cand)
+    return out
+
+
+def ici_link_count(topology: str, generation: str) -> int:
+    """Total bidirectional ICI links in the slice (for metrics/labels)."""
+    total = 0
+    for coord in chip_coords(topology):
+        total += len(neighbors(coord, topology, generation))
+    return total // 2
+
+
+@dataclass(frozen=True)
+class Subslice:
+    """An ICI-contiguous block of chips (origin + shape)."""
+
+    origin: Coord
+    shape: Tuple[int, ...]
+
+    def coords(self) -> List[Coord]:
+        return [
+            tuple(o + d for o, d in zip(self.origin, offset))
+            for offset in itertools.product(*(range(s) for s in self.shape))
+        ]
+
+    def chip_count(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def name(self) -> str:
+        return format_topology(self.shape)
+
+
+def enumerate_subslices(
+    topology: str, shape: Sequence[int]
+) -> List[Subslice]:
+    """Tile the host topology with non-overlapping subslices of ``shape``.
+
+    The MIG-analogue partition: every tile is ICI-contiguous by
+    construction. Raises if the shape doesn't tile the topology exactly
+    (ragged partitions would strand chips).
+    """
+    dims = parse_topology(topology)
+    shape = tuple(shape)
+    if len(shape) != len(dims):
+        # pad trailing dims with 1 (e.g. shape 2x1 in topology 2x2x1)
+        if len(shape) < len(dims):
+            shape = shape + tuple(1 for _ in range(len(dims) - len(shape)))
+        else:
+            raise ValueError(f"shape {shape} has more dims than topology {dims}")
+    for s, d in zip(shape, dims):
+        if s > d or d % s != 0:
+            raise ValueError(
+                f"shape {format_topology(shape)} does not tile topology "
+                f"{format_topology(dims)}"
+            )
+    tiles = []
+    steps = [range(0, d, s) for d, s in zip(dims, shape)]
+    for origin in itertools.product(*steps):
+        tiles.append(Subslice(origin=origin, shape=shape))
+    return tiles
+
+
+def contiguous(coords: Sequence[Coord], topology: str, generation: str) -> bool:
+    """Whether a chip set is ICI-connected (BFS over neighbor links)."""
+    if not coords:
+        return False
+    want = set(coords)
+    seen = {coords[0]}
+    frontier = [coords[0]]
+    while frontier:
+        cur = frontier.pop()
+        for nb in neighbors(cur, topology, generation):
+            if nb in want and nb not in seen:
+                seen.add(nb)
+                frontier.append(nb)
+    return seen == want
+
+
+def pick_chips(
+    topology: str,
+    generation: str,
+    count: int,
+    available: Sequence[int],
+) -> Optional[List[int]]:
+    """Topology-aware allocation for the device plugin: choose ``count``
+    chips from ``available`` (linear device ids) preferring an
+    ICI-contiguous block; falls back to any chips if none is contiguous.
+
+    This is the TPU analogue of NVML topology-aware allocation in the
+    reference's device plugin (external image; SURVEY.md §2.3).
+    """
+    dims = parse_topology(topology)
+    avail = set(available)
+    if count <= 0 or len(avail) < count:
+        return None
+    coords_by_idx: Dict[int, Coord] = {
+        i: index_to_coord(i, dims) for i in avail
+    }
+    # try axis-aligned blocks of exactly `count` chips first
+    for shape in _blocks_of(count, dims):
+        for sub in enumerate_subslices(format_topology(dims), shape):
+            idxs = [coord_to_index(c, dims) for c in sub.coords()]
+            if all(i in avail for i in idxs):
+                return sorted(idxs)
+    # greedy BFS fallback: grow a connected set from each available chip
+    for seed in sorted(avail):
+        chosen = [seed]
+        frontier = [seed]
+        while frontier and len(chosen) < count:
+            cur = frontier.pop(0)
+            for nb in neighbors(coords_by_idx[cur], format_topology(dims), generation):
+                nb_idx = coord_to_index(nb, dims)
+                if nb_idx in avail and nb_idx not in chosen:
+                    chosen.append(nb_idx)
+                    frontier.append(nb_idx)
+                    if len(chosen) == count:
+                        break
+        if len(chosen) == count:
+            return sorted(chosen)
+    # disconnected last resort
+    return sorted(avail)[:count]
+
+
+def _blocks_of(count: int, dims: Sequence[int]) -> Iterator[Tuple[int, ...]]:
+    """Axis-aligned shapes with exactly ``count`` chips that fit in dims,
+    most compact (cube-like) first."""
+    n = len(dims)
+    shapes = set()
+
+    def rec(remaining: int, axis: int, shape: List[int]):
+        if axis == n:
+            if remaining == 1:
+                shapes.add(tuple(shape))
+            return
+        d = 1
+        while d <= dims[axis]:
+            if remaining % d == 0:
+                rec(remaining // d, axis + 1, shape + [d])
+            d += 1
+
+    rec(count, 0, [])
+    return iter(
+        sorted(shapes, key=lambda s: (max(s) - min(s), sorted(s, reverse=True)))
+    )

@@ -1,0 +1,30 @@
+# Top-level targets (reference Makefile shape: build/test/validate).
+
+.PHONY: all native test crd validate lint clean dev-run
+
+all: native crd
+
+native:
+	$(MAKE) -C native
+
+test:
+	python -m pytest tests/ -x -q
+
+# regenerate the chart CRD from the dataclasses (single source of truth)
+crd:
+	python -c "from tpu_operator.cfg.crdgen import render_crd_yaml; \
+	  open('deployments/tpu-operator/crds/tpu.k8s.io_clusterpolicies.yaml','w').write(render_crd_yaml())"
+
+validate:
+	python -m tpu_operator.cfg.main validate clusterpolicy --input config/samples/v1_clusterpolicy.yaml
+	python -m tpu_operator.cfg.main validate chart --dir deployments/tpu-operator
+
+bench:
+	python bench.py
+
+# run the operator against the in-memory cluster and converge to Ready
+dev-run:
+	python -m tpu_operator.main --fake --simulate-kubelet
+
+clean:
+	$(MAKE) -C native clean

@@ -1,0 +1,7 @@
+{{- define "tpu-operator.name" -}}
+tpu-operator
+{{- end -}}
+
+{{- define "tpu-operator.operator-image" -}}
+{{ .Values.operatorDeployment.repository }}/{{ .Values.operatorDeployment.image }}:{{ .Values.operatorDeployment.version }}
+{{- end -}}

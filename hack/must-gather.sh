@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Cluster state dump for support bundles (reference hack/must-gather.sh:16-30
+# pattern: runs as an oc/kubectl must-gather plugin or standalone).
+set -o pipefail
+K=${KUBECTL:-kubectl}
+NS=${OPERATOR_NAMESPACE:-tpu-operator}
+OUT=${ARTIFACT_DIR:-/tmp/tpu-operator-must-gather}
+mkdir -p "$OUT"
+
+echo "collecting into $OUT"
+$K version -o yaml > "$OUT/version.yaml" 2>&1
+$K get clusterpolicies.tpu.k8s.io -o yaml > "$OUT/clusterpolicy.yaml" 2>&1
+$K get nodes -o yaml > "$OUT/nodes.yaml" 2>&1
+$K get nodes -o custom-columns='NAME:.metadata.name,TPU:.metadata.labels.tpu\.k8s\.io/tpu\.present,GEN:.metadata.labels.tpu\.k8s\.io/tpu\.generation,SLICE:.metadata.labels.tpu\.k8s\.io/tpu\.slice\.config\.state,UPGRADE:.metadata.labels.tpu\.k8s\.io/libtpu-upgrade-state' > "$OUT/node-labels.txt" 2>&1
+$K -n "$NS" get all -o wide > "$OUT/workloads.txt" 2>&1
+$K -n "$NS" get daemonsets -o yaml > "$OUT/daemonsets.yaml" 2>&1
+$K -n "$NS" get configmaps -o yaml > "$OUT/configmaps.yaml" 2>&1
+$K -n "$NS" get events --sort-by=.lastTimestamp > "$OUT/events.txt" 2>&1
+mkdir -p "$OUT/pod-logs"
+for pod in $($K -n "$NS" get pods -o name 2>/dev/null); do
+  name=${pod#pod/}
+  $K -n "$NS" logs --all-containers --tail=2000 "$name" > "$OUT/pod-logs/$name.log" 2>&1
+done
+echo "done"

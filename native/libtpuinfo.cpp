@@ -1,0 +1,193 @@
+// libtpuinfo — native TPU chip probe (the NVML slot).
+//
+// The reference operator leans on NVML/DCGM (C/C++) inside its operand
+// images for device enumeration and telemetry (SURVEY.md §2.3). This
+// library is the TPU-native equivalent consumed via ctypes by the device
+// plugin, feature discovery, metrics exporter and validator:
+//
+//   * chip enumeration from devfs (/dev/accel*, /dev/vfio/*),
+//   * PCI identity + NUMA affinity from sysfs (/sys/class/accel),
+//   * telemetry merge: the metrics daemon (which owns the chip through
+//     libtpu) drops counters at /run/tpu/metricsd.json; this library joins
+//     them with device presence — the DCGM hostengine/reader split.
+//
+// C ABI only; no exceptions across the boundary; caller provides buffers.
+
+#include <dirent.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Chip {
+  int index;
+  std::string path;       // /dev/accelN or /dev/vfio/G
+  std::string pci;        // 0000:00:04.0 ("" when unknown)
+  std::string vendor;     // 0x1ae0 ("" when unknown)
+  int numa = -1;
+};
+
+bool starts_with(const char* s, const char* prefix) {
+  return std::strncmp(s, prefix, std::strlen(prefix)) == 0;
+}
+
+std::string read_trimmed(const std::string& path) {
+  FILE* f = std::fopen(path.c_str(), "r");
+  if (!f) return "";
+  char buf[256];
+  size_t n = std::fread(buf, 1, sizeof(buf) - 1, f);
+  std::fclose(f);
+  buf[n] = '\0';
+  std::string out(buf);
+  while (!out.empty() && (out.back() == '\n' || out.back() == ' ')) out.pop_back();
+  return out;
+}
+
+std::string resolve_pci(const std::string& dev_name) {
+  // /sys/class/accel/accelN/device -> ../../../0000:00:04.0
+  std::string link = "/sys/class/accel/" + dev_name + "/device";
+  char target[512];
+  ssize_t n = ::readlink(link.c_str(), target, sizeof(target) - 1);
+  if (n <= 0) return "";
+  target[n] = '\0';
+  std::string t(target);
+  size_t pos = t.find_last_of('/');
+  return pos == std::string::npos ? t : t.substr(pos + 1);
+}
+
+std::vector<Chip> enumerate_chips(const char* dev_root) {
+  std::vector<Chip> chips;
+  std::string root = dev_root && *dev_root ? dev_root : "/dev";
+
+  DIR* d = ::opendir(root.c_str());
+  if (d) {
+    std::vector<std::string> names;
+    while (dirent* e = ::readdir(d)) {
+      if (starts_with(e->d_name, "accel") && std::strcmp(e->d_name, "accel") != 0)
+        names.push_back(e->d_name);
+    }
+    ::closedir(d);
+    std::sort(names.begin(), names.end());
+    int idx = 0;
+    for (const auto& name : names) {
+      Chip c;
+      c.index = idx++;
+      c.path = root + "/" + name;
+      c.pci = resolve_pci(name);
+      if (!c.pci.empty()) {
+        std::string sys = "/sys/class/accel/" + name + "/device/";
+        c.vendor = read_trimmed(sys + "vendor");
+        std::string numa = read_trimmed(sys + "numa_node");
+        if (!numa.empty()) c.numa = std::atoi(numa.c_str());
+      }
+      chips.push_back(std::move(c));
+    }
+  }
+  if (!chips.empty()) return chips;
+
+  // VM-passthrough hosts expose vfio groups instead of accel nodes.
+  std::string vfio = root + "/vfio";
+  d = ::opendir(vfio.c_str());
+  if (d) {
+    std::vector<std::string> names;
+    while (dirent* e = ::readdir(d)) {
+      if (std::strcmp(e->d_name, ".") == 0 || std::strcmp(e->d_name, "..") == 0 ||
+          std::strcmp(e->d_name, "vfio") == 0)
+        continue;
+      names.push_back(e->d_name);
+    }
+    ::closedir(d);
+    std::sort(names.begin(), names.end());
+    int idx = 0;
+    for (const auto& name : names) {
+      Chip c;
+      c.index = idx++;
+      c.path = vfio + "/" + name;
+      chips.push_back(std::move(c));
+    }
+  }
+  return chips;
+}
+
+void json_escape_into(std::string& out, const std::string& s) {
+  for (char ch : s) {
+    if (ch == '"' || ch == '\\') { out += '\\'; out += ch; }
+    else if (ch == '\n') out += "\\n";
+    else out += ch;
+  }
+}
+
+int emit(const std::string& json, char* buf, int buf_len) {
+  if (!buf || buf_len <= 0) return -2;
+  if ((int)json.size() + 1 > buf_len) return -3;
+  std::memcpy(buf, json.c_str(), json.size() + 1);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Number of visible TPU chips; -1 on error.
+int tpuinfo_chip_count(const char* dev_root) {
+  return (int)enumerate_chips(dev_root).size();
+}
+
+// Per-chip JSON array: [{"index":0,"path":"/dev/accel0","pci_address":...,
+// "vendor":...,"numa_node":...}, ...]. Returns 0, or <0 on buffer error.
+int tpuinfo_summary_json(const char* dev_root, char* buf, int buf_len) {
+  auto chips = enumerate_chips(dev_root);
+  std::string out = "[";
+  for (size_t i = 0; i < chips.size(); ++i) {
+    const Chip& c = chips[i];
+    if (i) out += ",";
+    out += "{\"index\":" + std::to_string(c.index) + ",\"path\":\"";
+    json_escape_into(out, c.path);
+    out += "\"";
+    if (!c.pci.empty()) {
+      out += ",\"pci_address\":\"";
+      json_escape_into(out, c.pci);
+      out += "\"";
+    }
+    if (!c.vendor.empty()) {
+      out += ",\"vendor\":\"";
+      json_escape_into(out, c.vendor);
+      out += "\"";
+    }
+    if (c.numa >= 0) out += ",\"numa_node\":" + std::to_string(c.numa);
+    out += "}";
+  }
+  out += "]";
+  return emit(out, buf, buf_len);
+}
+
+// Telemetry JSON: {"source":...,"chips":[{"index":N,"present":1,...}]}.
+// Joins devfs presence with the metrics daemon's drop-file when present
+// (the daemon owns the chip through libtpu; we never open it here).
+int tpuinfo_metrics_json(const char* dev_root, char* buf, int buf_len) {
+  auto chips = enumerate_chips(dev_root);
+
+  std::string dropfile = read_trimmed("/run/tpu/metricsd.json");
+  if (!dropfile.empty() && dropfile.front() == '{') {
+    return emit(dropfile, buf, buf_len);
+  }
+
+  std::string out = "{\"source\":\"libtpuinfo\",\"chips\":[";
+  for (size_t i = 0; i < chips.size(); ++i) {
+    if (i) out += ",";
+    out += "{\"index\":" + std::to_string(chips[i].index) + ",\"present\":1";
+    if (chips[i].numa >= 0)
+      out += ",\"numa_node\":" + std::to_string(chips[i].numa);
+    out += "}";
+  }
+  out += "]}";
+  return emit(out, buf, buf_len);
+}
+
+}  // extern "C"

@@ -1,0 +1,43 @@
+// tpu-smoke — the nvidia-smi-shaped probe used by the libtpu DaemonSet's
+// startupProbe (assets/state-libtpu/0500_daemonset.yaml) and by hand on a
+// node: prints the chip table and exits 0 when chips are visible, 2 when
+// none are (the reference gates .driver-ctr-ready on `nvidia-smi`,
+// assets/state-driver/0500_daemonset.yaml:132-140).
+
+#include <cstdio>
+#include <cstring>
+
+extern "C" {
+int tpuinfo_chip_count(const char* dev_root);
+int tpuinfo_summary_json(const char* dev_root, char* buf, int buf_len);
+int tpuinfo_metrics_json(const char* dev_root, char* buf, int buf_len);
+}
+
+int main(int argc, char** argv) {
+  const char* dev_root = "/dev";
+  bool json = false;
+  for (int i = 1; i < argc; ++i) {
+    if (std::strcmp(argv[i], "--dev-root") == 0 && i + 1 < argc) {
+      dev_root = argv[++i];
+    } else if (std::strcmp(argv[i], "--json") == 0) {
+      json = true;
+    } else if (std::strcmp(argv[i], "--help") == 0) {
+      std::printf("usage: tpu-smoke [--dev-root DIR] [--json]\n");
+      return 0;
+    }
+  }
+
+  static char buf[16384];
+  if (tpuinfo_summary_json(dev_root, buf, sizeof(buf)) != 0) {
+    std::fprintf(stderr, "tpu-smoke: probe failed\n");
+    return 1;
+  }
+  int n = tpuinfo_chip_count(dev_root);
+  if (json) {
+    std::printf("%s\n", buf);
+  } else {
+    std::printf("TPU chips visible: %d\n", n);
+    std::printf("%s\n", buf);
+  }
+  return n > 0 ? 0 : 2;
+}

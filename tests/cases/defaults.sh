@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+# Default case (reference tests/cases/defaults.sh): stock values end-to-end.
+set -euo pipefail
+exec "$(dirname "$0")/../scripts/end-to-end.sh"

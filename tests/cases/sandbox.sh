@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Sandbox case (reference second e2e case, end-to-end.sh:33-40):
+# sandboxWorkloads.enabled=true with vm-passthrough nodes.
+set -euo pipefail
+export CHART_EXTRA_ARGS="--set sandboxWorkloads.enabled=true"
+exec "$(dirname "$0")/../scripts/end-to-end.sh"

@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Polling helpers (reference tests/scripts/checks.sh:3-37 shape).
+
+: "${TEST_NAMESPACE:=tpu-operator}"
+: "${POLL_S:=10}"
+: "${TIMEOUT_S:=2700}"   # 45min ceiling, same as the reference
+
+check_pod_ready() {
+  local label=$1 deadline=$((SECONDS + TIMEOUT_S))
+  while [ $SECONDS -lt $deadline ]; do
+    if kubectl -n "$TEST_NAMESPACE" get pods -l "app=$label" \
+        -o jsonpath='{.items[*].status.conditions[?(@.type=="Ready")].status}' \
+        | grep -qv False | grep -q True; then
+      echo "pods for $label Ready"
+      return 0
+    fi
+    echo "waiting for $label pods..."
+    sleep "$POLL_S"
+  done
+  echo "TIMEOUT waiting for $label" >&2
+  return 1
+}
+
+check_clusterpolicy_ready() {
+  local deadline=$((SECONDS + TIMEOUT_S))
+  while [ $SECONDS -lt $deadline ]; do
+    state=$(kubectl get clusterpolicies.tpu.k8s.io -o jsonpath='{.items[0].status.state}')
+    [ "$state" = ready ] && { echo "ClusterPolicy ready"; return 0; }
+    echo "ClusterPolicy state=$state; waiting..."
+    sleep "$POLL_S"
+  done
+  echo "TIMEOUT waiting for ClusterPolicy ready" >&2
+  return 1
+}
+
+check_pod_succeeded() {
+  local name=$1 deadline=$((SECONDS + 300))   # 5min, reference 60x5s
+  while [ $SECONDS -lt $deadline ]; do
+    phase=$(kubectl get pod "$name" -o jsonpath='{.status.phase}' 2>/dev/null)
+    [ "$phase" = Succeeded ] && { echo "$name Succeeded"; return 0; }
+    [ "$phase" = Failed ] && { echo "$name Failed" >&2; return 1; }
+    sleep 5
+  done
+  echo "TIMEOUT waiting for $name" >&2
+  return 1
+}

@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# E2E sequence on a real TPU node pool (reference
+# tests/scripts/end-to-end.sh:1-40 shape): install -> verify -> workload ->
+# update -> disable/enable operands -> uninstall.
+set -euo pipefail
+HERE=$(dirname "$0")
+source "$HERE/checks.sh"
+
+: "${CHART:=deployments/tpu-operator}"
+: "${TEST_NAMESPACE:=tpu-operator}"
+
+echo "=== install-operator"
+helm upgrade --install tpu-operator "$CHART" \
+  --namespace "$TEST_NAMESPACE" --create-namespace --wait
+
+echo "=== verify-operator"
+check_pod_ready tpu-operator
+check_clusterpolicy_ready
+check_pod_ready tpu-operator-validator
+
+echo "=== verify-operand-restarts (operator restart must not roll operands)"
+before=$(kubectl -n "$TEST_NAMESPACE" get pods -l app=tpu-device-plugin-daemonset -o jsonpath='{.items[*].metadata.uid}')
+kubectl -n "$TEST_NAMESPACE" rollout restart deployment/tpu-operator
+kubectl -n "$TEST_NAMESPACE" rollout status deployment/tpu-operator --timeout=5m
+check_clusterpolicy_ready
+after=$(kubectl -n "$TEST_NAMESPACE" get pods -l app=tpu-device-plugin-daemonset -o jsonpath='{.items[*].metadata.uid}')
+[ "$before" = "$after" ] || { echo "operands restarted on operator restart" >&2; exit 1; }
+
+echo "=== install-workload"
+kubectl apply -f "$HERE/../tpu-pod.yaml"
+check_pod_succeeded jax-matmul
+kubectl logs jax-matmul | grep OK
+kubectl delete -f "$HERE/../tpu-pod.yaml"
+
+echo "=== update-clusterpolicy"
+kubectl patch clusterpolicies.tpu.k8s.io cluster-policy --type merge \
+  -p '{"spec":{"metricsExporter":{"enabled":false}}}'
+sleep 15
+kubectl -n "$TEST_NAMESPACE" get ds tpu-metrics-exporter 2>/dev/null && \
+  { echo "exporter not deleted after disable" >&2; exit 1; }
+
+echo "=== enable-operands"
+kubectl patch clusterpolicies.tpu.k8s.io cluster-policy --type merge \
+  -p '{"spec":{"metricsExporter":{"enabled":true}}}'
+check_clusterpolicy_ready
+
+echo "=== uninstall"
+helm uninstall tpu-operator --namespace "$TEST_NAMESPACE"
+echo "E2E PASSED"

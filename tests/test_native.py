@@ -1,0 +1,92 @@
+"""Native libtpuinfo + tpu_smoke: built with make, driven through the real
+ctypes bindings and the CLI binary."""
+
+import json
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+LIB = os.path.join(NATIVE, "out", "libtpuinfo.so")
+SMOKE = os.path.join(NATIVE, "out", "tpu_smoke")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_native():
+    r = subprocess.run(
+        ["make", "-C", NATIVE], capture_output=True, text=True
+    )
+    if r.returncode != 0:
+        pytest.skip(f"native toolchain unavailable: {r.stderr[-200:]}")
+
+
+@pytest.fixture()
+def dev_root(tmp_path):
+    d = tmp_path / "dev"
+    d.mkdir()
+    for i in range(4):
+        (d / f"accel{i}").touch()
+    return str(d)
+
+
+def test_tpu_smoke_cli(dev_root, tmp_path):
+    r = subprocess.run(
+        [SMOKE, "--dev-root", dev_root, "--json"], capture_output=True, text=True
+    )
+    assert r.returncode == 0
+    chips = json.loads(r.stdout)
+    assert len(chips) == 4
+    assert chips[0]["index"] == 0 and chips[0]["path"].endswith("accel0")
+    # empty root -> exit 2 (probe ok, no chips)
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    r = subprocess.run([SMOKE, "--dev-root", str(empty)], capture_output=True)
+    assert r.returncode == 2
+
+
+def test_ctypes_bindings_use_native(dev_root, monkeypatch):
+    monkeypatch.setenv("LIBTPUINFO_PATH", LIB)
+    # reset the module-level cache so the env var is honored
+    from tpu_operator.native import tpuinfo
+
+    monkeypatch.setattr(tpuinfo, "_lib", None)
+    monkeypatch.setattr(tpuinfo, "_loaded", False)
+    assert tpuinfo.native_available()
+    assert tpuinfo.chip_count(dev_root) == 4
+    chips = tpuinfo.chip_summary(dev_root)
+    assert [c["index"] for c in chips] == [0, 1, 2, 3]
+    m = tpuinfo.metrics(dev_root)
+    assert m["source"] == "libtpuinfo"
+    assert len(m["chips"]) == 4 and m["chips"][0]["present"] == 1
+
+
+def test_vfio_fallback(tmp_path, monkeypatch):
+    monkeypatch.setenv("LIBTPUINFO_PATH", LIB)
+    from tpu_operator.native import tpuinfo
+
+    monkeypatch.setattr(tpuinfo, "_lib", None)
+    monkeypatch.setattr(tpuinfo, "_loaded", False)
+    d = tmp_path / "dev"
+    (d / "vfio").mkdir(parents=True)
+    (d / "vfio" / "7").touch()
+    (d / "vfio" / "vfio").touch()
+    assert tpuinfo.chip_count(str(d)) == 1
+    chips = tpuinfo.chip_summary(str(d))
+    assert chips[0]["path"].endswith("vfio/7")
+
+
+def test_python_fallback_matches_native_shape(dev_root, monkeypatch):
+    """With no .so, the pure-Python fallback returns the same data shape."""
+    from tpu_operator.native import tpuinfo
+
+    monkeypatch.setenv("LIBTPUINFO_PATH", "/nonexistent.so")
+    monkeypatch.setattr(tpuinfo, "_SEARCH_DIRS", ())
+    monkeypatch.setattr(tpuinfo, "_lib", None)
+    monkeypatch.setattr(tpuinfo, "_loaded", False)
+    assert not tpuinfo.native_available()
+    assert tpuinfo.chip_count(dev_root) == 4
+    chips = tpuinfo.chip_summary(dev_root)
+    assert [c["index"] for c in chips] == [0, 1, 2, 3]
+    assert all("path" in c for c in chips)

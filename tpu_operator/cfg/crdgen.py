@@ -1,0 +1,108 @@
+"""CRD manifest generation from the ClusterPolicy dataclasses.
+
+The reference ships a hand-maintained 1.5k-line CRD YAML
+(``deployments/gpu-operator/crds/nvidia.com_clusterpolicies_crd.yaml``)
+plus controller-gen. Here the dataclasses are the single source of truth:
+the openAPI v3 schema is derived by introspection, so spec fields can never
+drift from the decoder.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict
+
+from tpu_operator import consts
+from tpu_operator.api.v1 import clusterpolicy_types as cpt
+
+
+def _schema_for(tp) -> Dict[str, Any]:
+    tp = cpt._unwrap_optional(tp)
+    origin = typing.get_origin(tp)
+    if origin in (list, typing.List):
+        (item,) = typing.get_args(tp) or (Any,)
+        return {"type": "array", "items": _schema_for(item)}
+    if origin in (dict, typing.Dict):
+        return {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+    if dataclasses.is_dataclass(tp):
+        return _dataclass_schema(tp)
+    if tp is str:
+        return {"type": "string"}
+    if tp is bool:
+        return {"type": "boolean"}
+    if tp is int:
+        return {"type": "integer"}
+    if tp is float:
+        return {"type": "number"}
+    return {"x-kubernetes-preserve-unknown-fields": True}
+
+
+def _dataclass_schema(cls) -> Dict[str, Any]:
+    hints = typing.get_type_hints(cls)
+    props = {}
+    for f in dataclasses.fields(cls):
+        key = cpt._field_key(f)
+        props[key] = _schema_for(hints[f.name])
+        doc = f.metadata.get("doc")
+        if doc:
+            props[key]["description"] = doc
+    return {"type": "object", "properties": props}
+
+
+def build_crd() -> Dict[str, Any]:
+    spec_schema = _dataclass_schema(cpt.ClusterPolicySpec)
+    status_schema = _dataclass_schema(cpt.ClusterPolicyStatus)
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": consts.CRD_NAME},
+        "spec": {
+            "group": consts.GROUP,
+            "names": {
+                "kind": consts.CLUSTER_POLICY_KIND,
+                "listKind": "ClusterPolicyList",
+                "plural": "clusterpolicies",
+                "singular": "clusterpolicy",
+            },
+            "scope": "Cluster",
+            "versions": [
+                {
+                    "name": "v1",
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "additionalPrinterColumns": [
+                        {
+                            "jsonPath": ".status.state",
+                            "name": "Status",
+                            "type": "string",
+                        },
+                        {
+                            "jsonPath": ".metadata.creationTimestamp",
+                            "name": "Age",
+                            "type": "date",
+                        },
+                    ],
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "apiVersion": {"type": "string"},
+                                "kind": {"type": "string"},
+                                "metadata": {"type": "object"},
+                                "spec": spec_schema,
+                                "status": status_schema,
+                            },
+                        }
+                    },
+                }
+            ],
+        },
+    }
+
+
+def render_crd_yaml() -> str:
+    import yaml
+
+    return yaml.safe_dump(build_crd(), sort_keys=False)

@@ -1,0 +1,146 @@
+"""``tpuop-cfg`` — config validation CLI (reference ``cmd/gpuop-cfg``).
+
+Subcommands:
+  validate clusterpolicy --input FILE   decode + image-resolution checks
+                                        (reference ``cmd/gpuop-cfg/validate/
+                                        clusterpolicy/clusterpolicy.go:30-112``)
+  validate chart --dir DIR              chart values render a decodable CR
+                                        (CSV-validation slot: we have no OLM
+                                        bundle; the chart is the packaging)
+  generate crd                          print the CRD manifest
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import yaml
+
+from tpu_operator.api.v1.clusterpolicy_types import (
+    ClusterPolicySpec,
+    clusterpolicy_from_obj,
+)
+
+
+def validate_clusterpolicy(path: str) -> list:
+    """Returns a list of problems (empty = valid)."""
+    problems = []
+    with open(path) as f:
+        obj = yaml.safe_load(f)
+    if not isinstance(obj, dict):
+        return [f"{path}: not a mapping"]
+    if obj.get("kind") != "ClusterPolicy":
+        problems.append(f"kind is {obj.get('kind')!r}, want ClusterPolicy")
+    cp = clusterpolicy_from_obj(obj)
+    spec = cp.spec
+    # every enabled operand must resolve to a pullable image ref
+    # (reference checks image paths resolve, images.go:1-171)
+    named = [
+        ("libtpu", spec.libtpu),
+        ("runtime", spec.runtime),
+        ("devicePlugin", spec.device_plugin),
+        ("metricsd", spec.metricsd),
+        ("metricsExporter", spec.metrics_exporter),
+        ("nodeStatusExporter", spec.node_status_exporter),
+        ("tfd", spec.tfd),
+        ("sliceManager", spec.slice_manager),
+        ("validator", spec.validator),
+    ]
+    for name, sub in named:
+        if not sub.is_enabled():
+            continue
+        image = sub.image_path()
+        if not image:
+            problems.append(f"spec.{name}: no image (repository/image/version or env)")
+        elif ":" not in image.rsplit("/", 1)[-1] and "@" not in image:
+            problems.append(f"spec.{name}: image {image!r} has no tag or digest")
+    if spec.slice.strategy not in ("none", "single", "mixed"):
+        problems.append(f"spec.slice.strategy {spec.slice.strategy!r} invalid")
+    if spec.sandbox_workloads.default_workload not in (
+        "container",
+        "vm-passthrough",
+    ):
+        problems.append(
+            f"spec.sandboxWorkloads.defaultWorkload "
+            f"{spec.sandbox_workloads.default_workload!r} invalid"
+        )
+    pol = spec.libtpu.upgrade_policy
+    if pol is not None:
+        mu = str(pol.max_unavailable)
+        if mu.endswith("%"):
+            try:
+                float(mu[:-1])
+            except ValueError:
+                problems.append(f"upgradePolicy.maxUnavailable {mu!r} invalid")
+        if pol.max_parallel_upgrades < 0:
+            problems.append("upgradePolicy.maxParallelUpgrades negative")
+    return problems
+
+
+def validate_chart(chart_dir: str) -> list:
+    """The chart's values must decode as a ClusterPolicySpec and the CRD in
+    crds/ must match the generated one."""
+    import os
+
+    problems = []
+    values_path = os.path.join(chart_dir, "values.yaml")
+    try:
+        with open(values_path) as f:
+            values = yaml.safe_load(f) or {}
+    except OSError as e:
+        return [f"cannot read {values_path}: {e}"]
+    # chart values mirror the CR spec 1:1 (reference values.yaml shape)
+    ClusterPolicySpec.from_dict(values)
+    crd_path = os.path.join(chart_dir, "crds", "tpu.k8s.io_clusterpolicies.yaml")
+    if not os.path.exists(crd_path):
+        problems.append(f"missing CRD at {crd_path}")
+    else:
+        from tpu_operator.cfg.crdgen import build_crd
+
+        with open(crd_path) as f:
+            on_disk = yaml.safe_load(f)
+        if on_disk != build_crd():
+            problems.append(
+                f"{crd_path} is stale; regenerate with 'tpuop-cfg generate crd'"
+            )
+    return problems
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpuop-cfg")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    v = sub.add_parser("validate")
+    vsub = v.add_subparsers(dest="what", required=True)
+    vcp = vsub.add_parser("clusterpolicy")
+    vcp.add_argument("--input", required=True)
+    vch = vsub.add_parser("chart")
+    vch.add_argument("--dir", required=True)
+    g = sub.add_parser("generate")
+    gsub = g.add_subparsers(dest="what", required=True)
+    gsub.add_parser("crd")
+    args = p.parse_args(argv)
+
+    if args.cmd == "validate" and args.what == "clusterpolicy":
+        problems = validate_clusterpolicy(args.input)
+    elif args.cmd == "validate" and args.what == "chart":
+        problems = validate_chart(args.dir)
+    elif args.cmd == "generate" and args.what == "crd":
+        from tpu_operator.cfg.crdgen import render_crd_yaml
+
+        sys.stdout.write(render_crd_yaml())
+        return 0
+    else:  # pragma: no cover
+        p.error("unknown command")
+        return 2
+
+    for prob in problems:
+        print(f"INVALID: {prob}", file=sys.stderr)
+    if problems:
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

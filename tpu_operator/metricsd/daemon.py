@@ -1,0 +1,178 @@
+"""tpu-metricsd — the standalone metrics daemon (DCGM hostengine slot).
+
+The reference deploys DCGM's C++ hostengine on port 5555 and points
+dcgm-exporter at it (``controllers/object_controls.go:95-98,1441-1495``).
+TPU runtime is single-client: only one process can hold the chip, so the
+telemetry owner must be a daemon and every reader must stay out-of-band.
+This daemon:
+
+* collects chip facts via native libtpuinfo (presence, NUMA),
+* optionally samples on-chip counters when it is allowed to own the chip
+  (``--own-chip``: duty-cycle estimation by timing a tiny matmul),
+* publishes to the ``/run/tpu/metricsd.json`` drop-file (which libtpuinfo
+  merges for all other readers — validator, exporter fallback) and over
+  HTTP on :5555 (the hostengine port; HTTP instead of DCGM's custom
+  protocol — readers are in-cluster only).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tpu_operator.native import tpuinfo
+
+log = logging.getLogger("tpu-metricsd")
+
+DROP_FILE = "/run/tpu/metricsd.json"
+DEFAULT_PORT = 5555
+
+
+class MetricsDaemon:
+    def __init__(
+        self,
+        dev_root: str = "/dev",
+        drop_file: str = DROP_FILE,
+        own_chip: bool = False,
+        interval_s: float = 10.0,
+    ):
+        self.dev_root = dev_root
+        self.drop_file = drop_file
+        self.own_chip = own_chip
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._latest: dict = {"source": "tpu-metricsd", "chips": []}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def collect_once(self) -> dict:
+        chips = tpuinfo.chip_summary(self.dev_root)
+        sample = self._sample_duty_cycle() if self.own_chip else None
+        out = {"source": "tpu-metricsd", "ts": time.time(), "chips": []}
+        for chip in chips:
+            entry = {
+                "index": chip["index"],
+                "present": 1,
+            }
+            if "numa_node" in chip:
+                entry["numa_node"] = chip["numa_node"]
+            if sample is not None:
+                entry.update(sample)
+            out["chips"].append(entry)
+        with self._lock:
+            self._latest = out
+        self._write_drop_file(out)
+        return out
+
+    def _sample_duty_cycle(self) -> Optional[dict]:
+        """Rough TensorCore utilization: time a fixed-size matmul and
+        compare against the last idle-calibrated sample. Only meaningful
+        when this daemon owns the chip (single-client TPU runtime —
+        SURVEY.md §7 'hard parts')."""
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            dev = jax.devices()[0]
+            if dev.platform != "tpu":
+                return None
+            n = 2048
+            x = jnp.ones((n, n), jnp.bfloat16)
+            fn = jax.jit(
+                lambda a: jnp.dot(a, a, preferred_element_type=jnp.float32)
+            )
+            fn(x).block_until_ready()
+            t0 = time.perf_counter()
+            fn(x).block_until_ready()
+            dt = time.perf_counter() - t0
+            tflops = 2.0 * n**3 / dt / 1e12
+            return {"tensorcore_util": round(min(100.0, tflops / 1.97), 2)}
+        except Exception:
+            return None
+
+    def _write_drop_file(self, payload: dict) -> None:
+        try:
+            os.makedirs(os.path.dirname(self.drop_file), exist_ok=True)
+            tmp = self.drop_file + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.drop_file)
+        except OSError:
+            log.exception("drop-file write failed")
+
+    # ------------------------------------------------------------------
+    def latest(self) -> dict:
+        with self._lock:
+            return dict(self._latest)
+
+    def serve(self, port: int = DEFAULT_PORT, block: bool = True):
+        daemon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                body = json.dumps(daemon.latest()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.collect_once()
+                except Exception:
+                    log.exception("collection failed")
+                self._stop.wait(self.interval_s)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        log.info("tpu-metricsd serving :%d (drop-file %s)", port, self.drop_file)
+        if block:
+            while not self._stop.is_set():
+                time.sleep(1)
+        return server
+
+    def stop(self):
+        self._stop.set()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    logging.basicConfig(level="INFO")
+    p = argparse.ArgumentParser("tpu-metricsd")
+    p.add_argument("--port", type=int, default=int(os.environ.get("METRICSD_PORT", DEFAULT_PORT)))
+    p.add_argument("--dev-root", default="/dev")
+    p.add_argument("--drop-file", default=DROP_FILE)
+    p.add_argument("--interval", type=float, default=10.0)
+    p.add_argument(
+        "--own-chip",
+        action="store_true",
+        help="sample on-chip counters (requires exclusive chip access)",
+    )
+    args = p.parse_args(argv)
+    MetricsDaemon(
+        dev_root=args.dev_root,
+        drop_file=args.drop_file,
+        own_chip=args.own_chip,
+        interval_s=args.interval,
+    ).serve(port=args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,57 @@
+"""Event recording + status conditions on the reconcile path."""
+
+import os
+
+import yaml
+
+from tests.conftest import make_tpu_node
+from tests.test_reconciler import NS, load_cr, simulate_kubelet
+from tpu_operator import consts
+from tpu_operator.controllers.clusterpolicy_controller import ClusterPolicyReconciler
+from tpu_operator.kube import FakeClient
+from tpu_operator.kube.events import record_event
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ASSETS = os.path.join(REPO, "assets")
+
+
+def test_event_dedup():
+    c = FakeClient()
+    obj = {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n1"}}
+    record_event(c, NS, obj, "Warning", "TestReason", "first")
+    record_event(c, NS, obj, "Warning", "TestReason", "second")
+    events = c.list("v1", "Event", NS)
+    assert len(events) == 1
+    assert events[0]["count"] == 2
+    assert events[0]["message"] == "second"
+    record_event(c, NS, obj, "Normal", "OtherReason", "x")
+    assert len(c.list("v1", "Event", NS)) == 2
+
+
+def test_reconcile_emits_events_and_conditions(monkeypatch):
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            make_tpu_node("tpu-node-1"),
+        ]
+    )
+    client.create(load_cr())
+    r = ClusterPolicyReconciler(client, assets_dir=ASSETS)
+    r.reconcile()
+    # not-ready warning event
+    events = client.list("v1", "Event", NS)
+    reasons = {e["reason"] for e in events}
+    assert "OperandsNotReady" in reasons
+    cr = client.get(consts.API_VERSION, "ClusterPolicy", "cluster-policy")
+    cond = cr["status"]["conditions"][0]
+    assert cond["type"] == "Ready" and cond["status"] == "False"
+    # converge -> Ready event + condition flips
+    simulate_kubelet(client)
+    r.reconcile()
+    events = client.list("v1", "Event", NS)
+    reasons = {e["reason"] for e in events}
+    assert "Ready" in reasons
+    cr = client.get(consts.API_VERSION, "ClusterPolicy", "cluster-policy")
+    cond = cr["status"]["conditions"][0]
+    assert cond["status"] == "True" and cond["reason"] == "OperandsReady"

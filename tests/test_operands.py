@@ -1,0 +1,381 @@
+"""Node-agent operand entrypoints: libtpu installer/manager, runtime wire,
+vfio manager, vm/kata managers, subslice + vfio device plugins."""
+
+import json
+import os
+
+import grpc
+import pytest
+import yaml
+
+from tpu_operator import consts
+from tpu_operator.kube import FakeClient
+from tpu_operator.operands import (
+    libtpu_installer,
+    libtpu_manager,
+    runtime_wire,
+    vfio_manager,
+    vm_manager,
+)
+from tpu_operator.validator.components import StatusFiles
+
+
+# ---------------------------------------------------------------------------
+# libtpu installer
+# ---------------------------------------------------------------------------
+
+
+def test_libtpu_install_and_upgrade(tmp_path):
+    src = tmp_path / "image"
+    src.mkdir()
+    (src / "libtpu-2025.1.0.so").write_bytes(b"v1" * 100)
+    dst = tmp_path / "host"
+    libtpu_installer.install(str(src), str(dst))
+    assert (dst / "VERSION").read_text().strip() == "2025.1.0"
+    assert os.readlink(dst / "libtpu.so") == "libtpu-2025.1.0.so"
+    # upgrade swaps the symlink atomically and GCs the old version
+    (src / "libtpu-2025.1.0.so").unlink()
+    (src / "libtpu-2025.2.0.so").write_bytes(b"v2" * 100)
+    libtpu_installer.install(str(src), str(dst))
+    assert os.readlink(dst / "libtpu.so") == "libtpu-2025.2.0.so"
+    assert not (dst / "libtpu-2025.1.0.so").exists()
+    # uninstall clears everything
+    libtpu_installer.uninstall(str(dst))
+    assert not (dst / "VERSION").exists()
+    assert not os.path.lexists(dst / "libtpu.so")
+
+
+def test_libtpu_install_missing_source(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        libtpu_installer.install(str(tmp_path), str(tmp_path / "host"))
+
+
+def test_libtpu_installer_cli(tmp_path):
+    src = tmp_path / "image"
+    src.mkdir()
+    (src / "libtpu-1.0.so").write_bytes(b"x")
+    rc = libtpu_installer.main(
+        ["install", "--source-dir", str(src), "--install-dir", str(tmp_path / "h")]
+    )
+    assert rc == 0 and (tmp_path / "h" / "libtpu.so").exists()
+
+
+# ---------------------------------------------------------------------------
+# libtpu manager (pre-swap)
+# ---------------------------------------------------------------------------
+
+
+def test_libtpu_manager_clears_barriers_and_evicts(tmp_path):
+    status = StatusFiles(str(tmp_path / "val"))
+    for name in ("libtpu-ready", "runtime-ready", "plugin-ready"):
+        status.write(name)
+    client = FakeClient()
+    client.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": "train",
+                "namespace": "default",
+                "ownerReferences": [{"kind": "Job", "name": "j", "uid": "u"}],
+            },
+            "spec": {
+                "nodeName": "n1",
+                "containers": [
+                    {"resources": {"limits": {"google.com/tpu": "4"}}}
+                ],
+            },
+        }
+    )
+    rc = libtpu_manager.uninstall_libtpu(client, "n1", status)
+    assert rc == 0
+    assert not status.exists("libtpu-ready")
+    assert not status.exists("runtime-ready")
+    assert client.get_or_none("v1", "Pod", "train", "default") is None
+
+
+def test_libtpu_manager_unmanaged_pod_blocks_without_force(tmp_path):
+    status = StatusFiles(str(tmp_path / "val"))
+    client = FakeClient()
+    client.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "naked", "namespace": "default"},
+            "spec": {
+                "nodeName": "n1",
+                "containers": [
+                    {"resources": {"limits": {"google.com/tpu": "1"}}}
+                ],
+            },
+        }
+    )
+    assert libtpu_manager.uninstall_libtpu(client, "n1", status) == 1
+    assert libtpu_manager.uninstall_libtpu(client, "n1", status, force=True) == 0
+
+
+# ---------------------------------------------------------------------------
+# runtime wire
+# ---------------------------------------------------------------------------
+
+
+def test_runtime_wire_once(tmp_path):
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    (dev / "accel0").touch()
+    out = tmp_path / "cdi" / "spec.yaml"
+    conf = tmp_path / "containerd"
+    rc = runtime_wire.main(
+        [
+            "--cdi-output", str(out),
+            "--dev-root", str(dev),
+            "--libtpu-dir", str(tmp_path),
+            "--containerd-conf-dir", str(conf),
+            "--output-dir", str(tmp_path / "val"),
+            "--once",
+        ]
+    )
+    assert rc == 0
+    spec = yaml.safe_load(out.read_text())
+    assert spec["kind"] == "google.com/tpu"
+    assert "enable_cdi = true" in (conf / "tpu-cdi.toml").read_text()
+    assert (tmp_path / "val" / "runtime-ready").exists()
+
+
+# ---------------------------------------------------------------------------
+# vfio manager
+# ---------------------------------------------------------------------------
+
+
+def make_sysfs(tmp_path, addrs, vendor="0x1ae0", driver=None):
+    pci = tmp_path / "pci"
+    (pci / "drivers" / "vfio-pci").mkdir(parents=True)
+    (pci / "drivers_probe").touch()
+    for addr in addrs:
+        d = pci / "devices" / addr
+        d.mkdir(parents=True)
+        (d / "vendor").write_text(vendor + "\n")
+        (d / "driver_override").touch()
+        if driver:
+            drv = pci / "drivers" / driver
+            drv.mkdir(exist_ok=True)
+            (drv / "unbind").touch()
+            os.symlink(drv, d / "driver")
+    return str(pci)
+
+
+def test_vfio_bind_all(tmp_path):
+    pci = make_sysfs(tmp_path, ["0000:00:04.0", "0000:00:05.0"])
+    status = StatusFiles(str(tmp_path / "val"))
+
+    # drivers_probe is write-only in real sysfs; simulate the kernel binding
+    # by symlinking after the probe write
+    orig_write = vfio_manager._write
+
+    def fake_write(path, value):
+        orig_write(path, value)
+        if path.endswith("drivers_probe"):
+            dev = os.path.join(pci, "devices", value.strip(), "driver")
+            if not os.path.islink(dev):
+                os.symlink(os.path.join(pci, "drivers", "vfio-pci"), dev)
+
+    vfio_manager._write = fake_write
+    try:
+        rc = vfio_manager.bind_all(pci, status)
+    finally:
+        vfio_manager._write = orig_write
+    assert rc == 0
+    assert status.exists("vfio-pci-ready")
+    payload = json.loads((tmp_path / "val" / "vfio-pci-ready").read_text())
+    assert payload["bound"] == ["0000:00:04.0", "0000:00:05.0"]
+
+
+def test_vfio_no_devices(tmp_path):
+    pci = make_sysfs(tmp_path, [], vendor="0x8086")
+    assert vfio_manager.bind_all(pci, StatusFiles(str(tmp_path / "v"))) == 1
+
+
+# ---------------------------------------------------------------------------
+# vm manager / vm device manager / kata
+# ---------------------------------------------------------------------------
+
+
+def test_vm_manager_ready(tmp_path):
+    dev = tmp_path / "dev"
+    (dev / "vfio").mkdir(parents=True)
+    (dev / "vfio" / "vfio").touch()
+    (dev / "vfio" / "12").touch()
+    status = StatusFiles(str(tmp_path / "val"))
+    assert vm_manager.vm_manager_ready(str(dev), status) == 0
+    assert status.exists("vm-manager-ready")
+    # no control node -> fail
+    (dev / "vfio" / "vfio").unlink()
+    assert vm_manager.vm_manager_ready(str(dev), status) == 1
+
+
+def test_vm_device_config(tmp_path):
+    dev = tmp_path / "dev"
+    (dev / "vfio").mkdir(parents=True)
+    (dev / "vfio" / "vfio").touch()
+    (dev / "vfio" / "7").touch()
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(
+        yaml.safe_dump(
+            {"vm-device-configs": {"default": [{"devices": "all", "passthrough": True}]}}
+        )
+    )
+    state_file = tmp_path / "vm.json"
+    state = vm_manager.apply_vm_device_config(
+        str(cfg), "default", str(dev), str(state_file)
+    )
+    assert state["devices"][0]["vfio_group"].endswith("vfio/7")
+    with pytest.raises(ValueError):
+        vm_manager.apply_vm_device_config(str(cfg), "nope", str(dev), str(state_file))
+
+
+def test_kata_install(tmp_path):
+    src = tmp_path / "artifacts"
+    src.mkdir()
+    (src / "configuration-tpu.toml").write_text("x")
+    conf = tmp_path / "conf.d"
+    rc = vm_manager.install_kata(str(src), str(tmp_path / "kata"), str(conf))
+    assert rc == 0
+    assert (tmp_path / "kata" / "configuration-tpu.toml").exists()
+    assert "kata-tpu" in (conf / "kata-tpu.toml").read_text()
+
+
+# ---------------------------------------------------------------------------
+# plugin manager: mixed-strategy subslices + vfio plugin
+# ---------------------------------------------------------------------------
+
+
+def test_plugin_manager_mixed_strategy(tmp_path):
+    from tpu_operator.plugin import grpc_glue
+    from tpu_operator.plugin.manager import PluginManager
+    from tpu_operator.plugin.proto import pb2
+
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    for i in range(8):
+        (dev / f"accel{i}").touch()
+    part = tmp_path / "partitions.json"
+    part.write_text(
+        json.dumps(
+            {
+                "partitioned": True,
+                "shape": "2x2",
+                "subslices": [
+                    {"id": 0, "shape": "2x2", "chips": [0, 1, 4, 5]},
+                    {"id": 1, "shape": "2x2", "chips": [2, 3, 6, 7]},
+                ],
+            }
+        )
+    )
+    mgr = PluginManager(
+        strategy="mixed",
+        partition_file=str(part),
+        socket_dir=str(tmp_path / "kubelet"),
+        servicer_kw={"dev_root": str(dev), "cdi_enabled": True},
+    )
+    assert mgr.sync() is True
+    assert list(mgr.servers) == ["google.com/tpu-2x2"]
+    server = mgr.servers["google.com/tpu-2x2"]
+    channel = grpc.insecure_channel(f"unix://{server.socket_path}")
+    stub = grpc_glue.DevicePluginStub(channel)
+    listing = next(stub.ListAndWatch(pb2.Empty()))
+    assert len(listing.devices) == 2  # one device per subslice
+    req = pb2.AllocateRequest()
+    req.container_requests.add().devicesIDs.extend(["0"])
+    resp = stub.Allocate(req)
+    cresp = resp.container_responses[0]
+    assert cresp.envs["TPU_CHIPS_VISIBLE"] == "0,1,4,5"
+    assert cresp.cdi_devices[0].name == "google.com/tpu=subslice-0-2x2"
+    channel.close()
+    # unpartition -> falls back to a single google.com/tpu server
+    part.write_text(json.dumps({"partitioned": False, "subslices": []}))
+    assert mgr.sync() is True
+    assert list(mgr.servers) == ["google.com/tpu"]
+    mgr.stop()
+
+
+def test_plugin_manager_single_strategy_partitioned(tmp_path):
+    """MIG 'single' semantics: a uniform partition is advertised under the
+    plain google.com/tpu resource, one device per subslice."""
+    from tpu_operator.plugin.manager import PluginManager
+
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    for i in range(4):
+        (dev / f"accel{i}").touch()
+    part = tmp_path / "partitions.json"
+    part.write_text(
+        json.dumps(
+            {
+                "partitioned": True,
+                "shape": "1x2",
+                "subslices": [
+                    {"id": 0, "shape": "1x2", "chips": [0, 1]},
+                    {"id": 1, "shape": "1x2", "chips": [2, 3]},
+                ],
+            }
+        )
+    )
+    mgr = PluginManager(
+        strategy="single",
+        partition_file=str(part),
+        socket_dir=str(tmp_path / "kubelet"),
+        servicer_kw={"dev_root": str(dev), "cdi_enabled": True},
+    )
+    desired = mgr.desired_resources()
+    assert list(desired) == ["google.com/tpu"]
+    assert desired["google.com/tpu"]["kind"] == "subslice"
+    assert len(desired["google.com/tpu"]["subslices"]) == 2
+
+
+def test_cdi_spec_includes_subslices(tmp_path):
+    """Regression: every CDI writer must include subslice composite devices
+    when a partition is active, so plugin Allocate names always resolve."""
+    from tpu_operator.plugin import cdi
+
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    for i in range(4):
+        (dev / f"accel{i}").touch()
+    part = tmp_path / "partitions.json"
+    part.write_text(
+        json.dumps(
+            {
+                "partitioned": True,
+                "shape": "1x2",
+                "subslices": [{"id": 0, "shape": "1x2", "chips": [0, 1]}],
+            }
+        )
+    )
+    spec = cdi.build_spec(dev_root=str(dev), partition_file=str(part))
+    names = [d["name"] for d in spec["devices"]]
+    assert "subslice-0-1x2" in names
+    sub = [d for d in spec["devices"] if d["name"] == "subslice-0-1x2"][0]
+    paths = [n["path"] for n in sub["containerEdits"]["deviceNodes"]]
+    assert paths == [str(dev / "accel0"), str(dev / "accel1")]
+
+
+def test_vfio_plugin_servicer(tmp_path):
+    from tpu_operator.plugin.manager import VfioPluginServicer
+    from tpu_operator.plugin.proto import pb2
+
+    state = tmp_path / "vm.json"
+    state.write_text(
+        json.dumps(
+            {
+                "devices": [
+                    {"id": 0, "vfio_group": "/dev/vfio/7", "resource": "google.com/tpu-vm"}
+                ]
+            }
+        )
+    )
+    servicer = VfioPluginServicer(str(state), dev_root=str(tmp_path))
+    req = pb2.AllocateRequest()
+    req.container_requests.add().devicesIDs.extend(["0"])
+    resp = servicer.Allocate(req, None)
+    paths = [d.host_path for d in resp.container_responses[0].devices]
+    assert paths == ["/dev/vfio/7", "/dev/vfio/vfio"]

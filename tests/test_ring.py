@@ -1,0 +1,29 @@
+"""ICI ring probe on the virtual CPU mesh."""
+
+from tpu_operator.validator.components import StatusFiles, validate_ici
+from tpu_operator.workloads.ring import run_ring_probe
+
+
+def test_ring_probe_8_devices():
+    res = run_ring_probe(n_devices=8, payload_mb=0.5, iters=2)
+    assert res.ok, res.error
+    assert res.integrity
+    assert res.n_devices == 8
+    assert res.hops == 16
+    assert res.gbps_per_hop > 0
+
+
+def test_ring_probe_single_device_vacuous():
+    res = run_ring_probe(n_devices=1)
+    assert res.ok and res.hops == 0
+
+
+def test_ring_probe_too_many_devices():
+    res = run_ring_probe(n_devices=99)
+    assert not res.ok and "need 99 devices" in res.error
+
+
+def test_validator_ici_component(tmp_path):
+    status = StatusFiles(str(tmp_path))
+    info = validate_ici(status, expect_devices=4, payload_mb=0.25)
+    assert info["ok"] and status.exists("ici-ready")

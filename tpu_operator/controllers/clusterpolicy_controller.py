@@ -18,6 +18,7 @@ from typing import Optional
 
 from tpu_operator import consts
 from tpu_operator.api.v1.clusterpolicy_types import State
+from tpu_operator.kube.events import TYPE_NORMAL, TYPE_WARNING, record_event
 from tpu_operator.controllers.operator_metrics import OperatorMetrics
 from tpu_operator.controllers.state_manager import (
     ClusterPolicyController,
@@ -86,6 +87,7 @@ class ClusterPolicyReconciler:
             return Result(requeue_after=REQUEUE_NO_LABELS_S)
 
         overall = State.READY
+        not_ready_states = []
         self.ctrl.idx = 0
         while not self.ctrl.last():
             state_name = self.ctrl.state_names[self.ctrl.idx]
@@ -96,7 +98,28 @@ class ClusterPolicyReconciler:
             )
             if status == State.NOT_READY:
                 overall = State.NOT_READY
+                not_ready_states.append(state_name)
                 log.info("state %s not ready; will requeue", state_name)
+
+        was_ready = (primary.get("status", {}) or {}).get("state") == State.READY
+        if overall == State.READY and not was_ready:
+            record_event(
+                self.client,
+                self.ctrl.namespace,
+                primary,
+                TYPE_NORMAL,
+                "Ready",
+                "all TPU operand states are ready",
+            )
+        elif not_ready_states:
+            record_event(
+                self.client,
+                self.ctrl.namespace,
+                primary,
+                TYPE_WARNING,
+                "OperandsNotReady",
+                f"states not ready: {', '.join(not_ready_states)}",
+            )
 
         self._set_status(primary, overall)
         self._update_fleet_metrics()
@@ -118,14 +141,30 @@ class ClusterPolicyReconciler:
             )
 
     def _set_status(self, cp_obj, state: str) -> None:
-        """reference ``updateCRState`` (``:198``)."""
+        """reference ``updateCRState`` (``:198``) + a Ready condition."""
         status = cp_obj.setdefault("status", {})
         if status.get("state") == state and status.get("namespace") == (
             self.ctrl.namespace or status.get("namespace")
         ):
             return
+        from datetime import datetime, timezone
+
         status["state"] = state
         status["namespace"] = self.ctrl.namespace
+        status["conditions"] = [
+            {
+                "type": "Ready",
+                "status": "True" if state == State.READY else "False",
+                "reason": {
+                    State.READY: "OperandsReady",
+                    State.NOT_READY: "OperandsNotReady",
+                    State.IGNORED: "IgnoredDuplicate",
+                }.get(state, "Unknown"),
+                "lastTransitionTime": datetime.now(timezone.utc).strftime(
+                    "%Y-%m-%dT%H:%M:%SZ"
+                ),
+            }
+        ]
         try:
             self.client.update_status(cp_obj)
         except Exception:

@@ -640,6 +640,10 @@ def transform_slice_manager(n, ds: Obj, generation: Optional[str] = None) -> Non
     _set_container_env(
         main, "WITH_REBOOT", "false"
     )  # TPU repartition never needs a reboot
+    if n.cp.spec.cdi.is_enabled():
+        _set_container_env(
+            main, "CDI_SPEC_PATH", "/var/run/cdi/google.com-tpu.yaml"
+        )
     if spec.config and spec.config.name:
         for vol in ds["spec"]["template"]["spec"]["volumes"]:
             if vol["name"] == "slice-config":

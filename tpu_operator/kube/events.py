@@ -1,0 +1,81 @@
+"""Kubernetes Event recording.
+
+The operator emits Events on state transitions and failures so ``kubectl
+describe clusterpolicy``/``get events`` explains what happened (the
+controller-runtime EventRecorder role). Events are deduplicated by
+(involved object, reason): repeats bump ``count``/``lastTimestamp``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from datetime import datetime, timezone
+
+from tpu_operator.kube.client import Client, Obj
+
+log = logging.getLogger("tpu-operator.events")
+
+TYPE_NORMAL = "Normal"
+TYPE_WARNING = "Warning"
+
+COMPONENT = "tpu-operator"
+
+
+def _now() -> str:
+    return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def record_event(
+    client: Client,
+    namespace: str,
+    involved: Obj,
+    event_type: str,
+    reason: str,
+    message: str,
+) -> None:
+    """Create-or-bump an Event (best-effort: never raises)."""
+    try:
+        meta = involved.get("metadata", {})
+        key = hashlib.sha1(
+            "/".join(
+                [
+                    involved.get("kind", ""),
+                    meta.get("namespace", ""),
+                    meta.get("name", ""),
+                    reason,
+                ]
+            ).encode()
+        ).hexdigest()[:12]
+        name = f"{meta.get('name', 'unknown')}.{key}"
+        now = _now()
+        existing = client.get_or_none("v1", "Event", name, namespace)
+        if existing is not None:
+            existing["count"] = int(existing.get("count", 1)) + 1
+            existing["lastTimestamp"] = now
+            existing["message"] = message
+            client.update(existing)
+            return
+        client.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Event",
+                "metadata": {"name": name, "namespace": namespace},
+                "involvedObject": {
+                    "apiVersion": involved.get("apiVersion", ""),
+                    "kind": involved.get("kind", ""),
+                    "name": meta.get("name", ""),
+                    "namespace": meta.get("namespace", ""),
+                    "uid": meta.get("uid", ""),
+                },
+                "reason": reason,
+                "message": message,
+                "type": event_type,
+                "source": {"component": COMPONENT},
+                "firstTimestamp": now,
+                "lastTimestamp": now,
+                "count": 1,
+            }
+        )
+    except Exception:
+        log.debug("event recording failed", exc_info=True)

@@ -203,3 +203,133 @@ class RestClient(Client):
         self._request(
             "DELETE", _resource_path(api_version, kind, namespace, name)
         )
+
+    # -- watch ------------------------------------------------------------
+    def watch(
+        self,
+        api_version: str,
+        kind: str,
+        callback,
+        namespace: str = "",
+        stop_event=None,
+        timeout_s: int = 300,
+    ) -> None:
+        """Blocking list+watch loop: calls ``callback(event_type, obj)`` for
+        ADDED/MODIFIED/DELETED. Re-lists on expiry/disconnect (the
+        controller-runtime informer contract, minus caching)."""
+        import logging
+        import threading
+
+        log = logging.getLogger("tpu-operator.watch")
+        stop_event = stop_event or threading.Event()
+
+        def deliver(etype, obj):
+            # a poison object must not kill the watch loop
+            try:
+                callback(etype, obj)
+            except Exception:
+                log.exception("watch callback failed for %s %s", etype, kind)
+
+        known = set()
+        while not stop_event.is_set():
+            try:
+                listing = self._request(
+                    "GET", _resource_path(api_version, kind, namespace)
+                )
+                rv = listing.get("metadata", {}).get("resourceVersion", "")
+                seen = set()
+                for item in listing.get("items", []):
+                    item.setdefault("apiVersion", api_version)
+                    item.setdefault("kind", kind)
+                    meta = item.get("metadata", {})
+                    seen.add((meta.get("namespace", ""), meta.get("name", "")))
+                    deliver("ADDED", item)
+                # objects deleted during a watch gap: synthesize DELETED
+                for ns_name in known - seen:
+                    deliver(
+                        "DELETED",
+                        {
+                            "apiVersion": api_version,
+                            "kind": kind,
+                            "metadata": {
+                                "namespace": ns_name[0],
+                                "name": ns_name[1],
+                            },
+                        },
+                    )
+                known = seen
+                self._watch_stream(
+                    api_version,
+                    kind,
+                    namespace,
+                    rv,
+                    deliver,
+                    stop_event,
+                    timeout_s,
+                    known,
+                )
+            except Exception:
+                if stop_event.is_set():
+                    return
+                log.exception("watch %s/%s disconnected; re-listing", api_version, kind)
+                stop_event.wait(5)  # backoff, then re-list
+
+    def _watch_stream(
+        self,
+        api_version,
+        kind,
+        namespace,
+        rv,
+        callback,
+        stop_event,
+        timeout_s,
+        known=None,
+    ) -> None:
+        path = _resource_path(api_version, kind, namespace)
+        params = {"watch": "true", "timeoutSeconds": str(timeout_s)}
+        if rv:
+            params["resourceVersion"] = rv
+        path += "?" + urlencode(params)
+        conn = HTTPSConnection(
+            self.host, self.port, context=self._ctx, timeout=timeout_s + 30
+        )
+        try:
+            headers = {"Accept": "application/json"}
+            token = self._token()
+            if token:
+                headers["Authorization"] = f"Bearer {token}"
+            conn.request("GET", path, headers=headers)
+            resp = conn.getresponse()
+            if resp.status >= 400:
+                raise RuntimeError(f"watch {path} -> {resp.status}")
+            buf = b""
+            while not stop_event.is_set():
+                chunk = resp.read1(65536)
+                if not chunk:
+                    return  # server closed; caller re-lists
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if not line.strip():
+                        continue
+                    event = json.loads(line)
+                    etype = event.get("type", "")
+                    obj = event.get("object", {})
+                    if etype == "ERROR":
+                        return  # resourceVersion expired; re-list
+                    if etype in ("ADDED", "MODIFIED", "DELETED"):
+                        obj.setdefault("apiVersion", api_version)
+                        obj.setdefault("kind", kind)
+                        if known is not None:
+                            meta = obj.get("metadata", {})
+                            key = (
+                                meta.get("namespace", ""),
+                                meta.get("name", ""),
+                            )
+                            if etype == "DELETED":
+                                known.discard(key)
+                            else:
+                                known.add(key)
+                        callback(etype, obj)
+        finally:
+            conn.close()

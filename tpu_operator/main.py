@@ -127,27 +127,41 @@ def main(argv=None) -> int:
     upgrade = UpgradeReconciler(client, namespace)
     mgr.add_reconciler(UPGRADE_KEY, lambda _key: upgrade.reconcile())
 
-    # watches: fake client pushes events; a real deployment would run watch
-    # loops against the API server here (list+watch with resourceVersion).
-    if hasattr(client, "add_watcher"):
-        node_cache = {}
+    # watches feed the workqueue (reference watch wiring,
+    # controllers/clusterpolicy_controller.go:317-344)
+    node_cache = {}
 
-        def on_event(event, obj):
-            kind = obj.get("kind")
-            if kind == "ClusterPolicy":
+    def on_event(event, obj):
+        kind = obj.get("kind")
+        if kind == "ClusterPolicy":
+            mgr.enqueue(CP_KEY)
+            mgr.enqueue(UPGRADE_KEY)
+        elif kind == "Node":
+            name = obj["metadata"]["name"]
+            old = node_cache.get(name)
+            node_cache[name] = None if event == "DELETED" else obj
+            if node_event_needs_reconcile(event, old, obj):
                 mgr.enqueue(CP_KEY)
-                mgr.enqueue(UPGRADE_KEY)
-            elif kind == "Node":
-                name = obj["metadata"]["name"]
-                old = node_cache.get(name)
-                node_cache[name] = None if event == "DELETED" else obj
-                if node_event_needs_reconcile(event, old, obj):
-                    mgr.enqueue(CP_KEY)
-            elif kind == "DaemonSet":
-                # owned-operand drift (reference watch on owned DaemonSets)
-                mgr.enqueue(CP_KEY, delay=0.1)
+        elif kind == "DaemonSet":
+            # owned-operand drift (reference watch on owned DaemonSets)
+            mgr.enqueue(CP_KEY, delay=0.1)
 
+    if hasattr(client, "add_watcher"):
+        # fake client pushes events in-process
         client.add_watcher(on_event)
+    elif hasattr(client, "watch"):
+        # real API server: one list+watch loop per watched kind
+        for av, kind, ns in (
+            (consts.API_VERSION, "ClusterPolicy", ""),
+            ("v1", "Node", ""),
+            ("apps/v1", "DaemonSet", namespace),
+        ):
+            threading.Thread(
+                target=client.watch,
+                args=(av, kind, on_event),
+                kwargs={"namespace": ns},
+                daemon=True,
+            ).start()
     else:
         def poll():
             while True:

@@ -1,0 +1,130 @@
+"""``tpu-libtpu-installer`` — the driver-container entrypoint.
+
+The reference's ``nvidia-driver init`` builds and loads a kernel module
+(``assets/state-driver/0500_daemonset.yaml``); libtpu is userspace, so
+installation is: copy the image's versioned ``libtpu.so`` onto the host
+install dir, atomically repoint the ``libtpu.so`` symlink, record VERSION,
+then stay resident so the DaemonSet's startupProbe
+(``tpu-smoke && touch .libtpu-ctr-ready``) and preStop hook manage the
+barrier files.
+
+Subcommands: ``init`` (install + stay resident), ``install`` (one-shot),
+``uninstall``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import logging
+import os
+import shutil
+import signal
+import sys
+import time
+
+from tpu_operator import consts
+
+log = logging.getLogger("tpu-libtpu-installer")
+
+# where the operand image ships its payload
+DEFAULT_SOURCE_DIR = "/opt/libtpu"
+
+
+def find_source(source_dir: str, version: str = "") -> str:
+    """The payload .so inside the image: ``libtpu-<version>.so`` or any
+    ``libtpu*.so``."""
+    if version:
+        exact = os.path.join(source_dir, f"libtpu-{version}.so")
+        if os.path.exists(exact):
+            return exact
+    candidates = sorted(glob.glob(os.path.join(source_dir, "libtpu*.so")))
+    if not candidates:
+        raise FileNotFoundError(f"no libtpu*.so under {source_dir}")
+    return candidates[-1]
+
+
+def install(
+    source_dir: str = DEFAULT_SOURCE_DIR,
+    install_dir: str = consts.LIBTPU_HOST_DIR,
+    version: str = "",
+) -> str:
+    src = find_source(source_dir, version)
+    if not version:
+        base = os.path.basename(src)
+        version = base[len("libtpu-"):-len(".so")] if base.startswith("libtpu-") else "unknown"
+    os.makedirs(install_dir, exist_ok=True)
+    versioned = os.path.join(install_dir, f"libtpu-{version}.so")
+    tmp = versioned + ".tmp"
+    shutil.copyfile(src, tmp)
+    os.replace(tmp, versioned)
+    # atomic symlink swap: running workloads keep their mmapped old version
+    link = os.path.join(install_dir, "libtpu.so")
+    tmp_link = link + ".tmp"
+    if os.path.lexists(tmp_link):
+        os.unlink(tmp_link)
+    os.symlink(os.path.basename(versioned), tmp_link)
+    os.replace(tmp_link, link)
+    with open(os.path.join(install_dir, "VERSION"), "w") as f:
+        f.write(version + "\n")
+    # GC older versions, keeping the active one
+    for old in glob.glob(os.path.join(install_dir, "libtpu-*.so")):
+        if os.path.basename(old) != os.path.basename(versioned):
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+    log.info("installed libtpu %s -> %s", version, versioned)
+    return versioned
+
+
+def uninstall(install_dir: str = consts.LIBTPU_HOST_DIR) -> None:
+    for path in glob.glob(os.path.join(install_dir, "libtpu*")) + [
+        os.path.join(install_dir, "VERSION")
+    ]:
+        try:
+            os.unlink(path)
+            log.info("removed %s", path)
+        except OSError:
+            pass
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level="INFO")
+    p = argparse.ArgumentParser("tpu-libtpu-installer")
+    p.add_argument("command", choices=["init", "install", "uninstall"])
+    p.add_argument("--source-dir", default=os.environ.get("LIBTPU_SOURCE_DIR", DEFAULT_SOURCE_DIR))
+    p.add_argument(
+        "--install-dir",
+        default=os.environ.get("LIBTPU_INSTALL_DIR", consts.LIBTPU_HOST_DIR),
+    )
+    p.add_argument("--version", default=os.environ.get("LIBTPU_VERSION", ""))
+    args = p.parse_args(argv)
+
+    if args.command == "uninstall":
+        uninstall(args.install_dir)
+        return 0
+
+    try:
+        install(args.source_dir, args.install_dir, args.version)
+    except FileNotFoundError as e:
+        log.error("%s", e)
+        return 1
+    if args.command == "install":
+        return 0
+
+    # init: stay resident; preStop removes the barrier files
+    stop = {"flag": False}
+
+    def on_term(*_):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    log.info("libtpu installed; holding (startupProbe gates the barrier)")
+    while not stop["flag"]:
+        time.sleep(5)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,91 @@
+"""``tpu-libtpu-manager`` — pre-swap node preparation.
+
+The reference's k8s-driver-manager initContainer
+(``assets/state-driver/0500_daemonset.yaml:62-102``) evicts GPU pods and
+drains before a driver swap. TPU version: before the installer container
+replaces libtpu, evict TPU-consuming pods from this node (they hold the old
+library mmapped and the single-client chip), and clear the barrier files so
+dependent DaemonSets re-block until validation re-passes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from tpu_operator import consts
+from tpu_operator.validator.components import StatusFiles
+
+log = logging.getLogger("tpu-libtpu-manager")
+
+
+def uninstall_libtpu(
+    client,
+    node_name: str,
+    status: StatusFiles,
+    force: bool = False,
+) -> int:
+    from tpu_operator.upgrade.upgrade_state import PodManager
+
+    # 1. clear barriers so device-plugin/exporter/validator pods re-block
+    #    (reference preStop semantics, validator/main.go:123-157)
+    for name in (
+        consts.STATUS_FILE_LIBTPU,
+        consts.STATUS_FILE_RUNTIME,
+        consts.STATUS_FILE_PLUGIN,
+        consts.STATUS_FILE_JAX,
+        consts.STATUS_FILE_LIBTPU_CTR,
+    ):
+        status.remove(name)
+
+    # 2. evict TPU workload pods still holding the chip
+    if client is not None and node_name:
+        pods = PodManager(client, "").tpu_pods_on_node(node_name)
+        if pods:
+            log.info("evicting %d TPU pods from %s", len(pods), node_name)
+            PodManager(client, "").delete_pods(pods, force=force)
+            remaining = PodManager(client, "").tpu_pods_on_node(node_name)
+            if remaining:
+                log.error(
+                    "%d TPU pods still present (unmanaged? set DRAIN_USE_FORCE)",
+                    len(remaining),
+                )
+                return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level="INFO")
+    p = argparse.ArgumentParser("tpu-libtpu-manager")
+    p.add_argument("command", choices=["uninstall_libtpu", "preflight"])
+    p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
+    p.add_argument(
+        "--output-dir",
+        default=os.environ.get("VALIDATION_OUTPUT_DIR", consts.VALIDATION_DIR),
+    )
+    p.add_argument(
+        "--force",
+        action="store_true",
+        default=os.environ.get("DRAIN_USE_FORCE", "") == "true",
+    )
+    args = p.parse_args(argv)
+    status = StatusFiles(args.output_dir)
+
+    client = None
+    try:
+        from tpu_operator.kube.rest import RestClient
+
+        client = RestClient()
+    except Exception:
+        log.warning("no in-cluster client; skipping pod eviction")
+
+    if args.command == "preflight":
+        # nothing to prepare on TPU hosts (no kernel, no mofed); succeed
+        return 0
+    return uninstall_libtpu(client, args.node_name, status, force=args.force)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

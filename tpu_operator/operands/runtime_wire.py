@@ -1,0 +1,124 @@
+"""``tpu-runtime-wire`` — the container-toolkit-slot entrypoint.
+
+Where the reference rewrites containerd/docker/crio configs and installs
+the nvidia runtime hook (``assets/state-container-toolkit/``), the TPU path
+is CDI-first: generate the CDI spec for every visible chip and keep it
+fresh as devices change; for clusters without CDI-capable runtimes, drop a
+legacy containerd snippet enabling the CDI plugin. Signals
+``runtime-ready`` when the spec is live.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import time
+
+from tpu_operator import consts
+from tpu_operator.native import tpuinfo
+from tpu_operator.plugin import cdi
+from tpu_operator.validator.components import StatusFiles
+
+log = logging.getLogger("tpu-runtime-wire")
+
+CONTAINERD_SNIPPET = """\
+# Installed by tpu-operator (tpu-runtime-wire): enables CDI injection.
+[plugins."io.containerd.grpc.v1.cri"]
+  enable_cdi = true
+  cdi_spec_dirs = ["/etc/cdi", "/var/run/cdi"]
+"""
+
+
+def wire_once(
+    cdi_output: str,
+    dev_root: str = "/dev",
+    libtpu_dir: str = consts.LIBTPU_HOST_DIR,
+    containerd_conf_dir: str = "",
+) -> dict:
+    spec = cdi.write_spec(
+        cdi_output, dev_root=dev_root, libtpu_dir=libtpu_dir
+    )
+    if containerd_conf_dir:
+        os.makedirs(containerd_conf_dir, exist_ok=True)
+        snippet = os.path.join(containerd_conf_dir, "tpu-cdi.toml")
+        if not os.path.exists(snippet):
+            with open(snippet, "w") as f:
+                f.write(CONTAINERD_SNIPPET)
+            log.info("wrote containerd CDI snippet %s", snippet)
+    return spec
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level="INFO")
+    p = argparse.ArgumentParser("tpu-runtime-wire")
+    p.add_argument(
+        "--cdi-output",
+        default=os.environ.get("CDI_SPEC_PATH", cdi.DEFAULT_SPEC_PATH),
+    )
+    p.add_argument("--dev-root", default="/dev")
+    p.add_argument(
+        "--libtpu-dir",
+        default=os.environ.get("LIBTPU_INSTALL_DIR", consts.LIBTPU_HOST_DIR),
+    )
+    p.add_argument(
+        "--containerd-conf-dir",
+        default=os.environ.get("CONTAINERD_CONF_DIR", ""),
+        help="also drop a containerd conf.d snippet enabling CDI",
+    )
+    p.add_argument(
+        "--output-dir",
+        default=os.environ.get("VALIDATION_OUTPUT_DIR", consts.VALIDATION_DIR),
+    )
+    p.add_argument("--interval", type=float, default=30.0)
+    p.add_argument("--once", action="store_true")
+    args = p.parse_args(argv)
+    status = StatusFiles(args.output_dir)
+
+    stop = {"flag": False}
+    signal.signal(signal.SIGTERM, lambda *_: stop.update(flag=True))
+
+    from tpu_operator.plugin.cdi import DEFAULT_PARTITION_FILE
+
+    last_chips = None
+    while True:
+        try:
+            try:
+                part_mtime = os.stat(DEFAULT_PARTITION_FILE).st_mtime
+            except OSError:
+                part_mtime = 0.0
+            chips = (
+                tuple(
+                    c.get("path", "")
+                    for c in tpuinfo.chip_summary(args.dev_root)
+                ),
+                part_mtime,  # repartition must refresh the spec too
+            )
+            if chips != last_chips:
+                n_chips = len(chips[0])
+                wire_once(
+                    args.cdi_output,
+                    dev_root=args.dev_root,
+                    libtpu_dir=args.libtpu_dir,
+                    containerd_conf_dir=args.containerd_conf_dir,
+                )
+                status.write(
+                    consts.STATUS_FILE_RUNTIME,
+                    {"cdiSpec": args.cdi_output, "chips": n_chips},
+                )
+                log.info("CDI spec refreshed for %d chips", n_chips)
+                last_chips = chips
+        except Exception:
+            log.exception("wire pass failed")
+        if args.once or stop["flag"]:
+            break
+        time.sleep(args.interval)
+    if stop["flag"]:
+        status.remove(consts.STATUS_FILE_RUNTIME)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,128 @@
+"""``tpu-vfio-manager`` — binds TPU PCI functions to vfio-pci.
+
+Sandbox-workload operand (reference ``assets/state-vfio-manager/``): on
+vm-passthrough nodes, every Google accelerator PCI function must be driven
+by vfio-pci before VMs can claim it. Uses the standard sysfs flow:
+``driver_override`` → unbind current driver → drivers_probe.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from tpu_operator import consts
+from tpu_operator.validator.components import GOOGLE_PCI_VENDOR, StatusFiles
+
+log = logging.getLogger("tpu-vfio-manager")
+
+SYSFS_PCI = "/sys/bus/pci"
+
+
+def _write(path: str, value: str) -> None:
+    with open(path, "w") as f:
+        f.write(value)
+
+
+def google_functions(sysfs_pci: str = SYSFS_PCI) -> list:
+    devices_dir = os.path.join(sysfs_pci, "devices")
+    out = []
+    if not os.path.isdir(devices_dir):
+        return out
+    for addr in sorted(os.listdir(devices_dir)):
+        try:
+            with open(os.path.join(devices_dir, addr, "vendor")) as f:
+                if f.read().strip() == GOOGLE_PCI_VENDOR:
+                    out.append(addr)
+        except OSError:
+            continue
+    return out
+
+
+def current_driver(addr: str, sysfs_pci: str = SYSFS_PCI) -> str:
+    link = os.path.join(sysfs_pci, "devices", addr, "driver")
+    return os.path.basename(os.readlink(link)) if os.path.islink(link) else ""
+
+
+def bind_one(addr: str, sysfs_pci: str = SYSFS_PCI) -> bool:
+    dev_dir = os.path.join(sysfs_pci, "devices", addr)
+    drv = current_driver(addr, sysfs_pci)
+    if drv == "vfio-pci":
+        return False
+    _write(os.path.join(dev_dir, "driver_override"), "vfio-pci")
+    if drv:
+        _write(os.path.join(dev_dir, "driver", "unbind"), addr)
+    probe = os.path.join(sysfs_pci, "drivers_probe")
+    if os.path.exists(probe):
+        _write(probe, addr)
+    else:  # older kernels: bind directly
+        _write(os.path.join(sysfs_pci, "drivers", "vfio-pci", "bind"), addr)
+    log.info("bound %s to vfio-pci (was %r)", addr, drv)
+    return True
+
+
+def unbind_one(addr: str, sysfs_pci: str = SYSFS_PCI) -> bool:
+    dev_dir = os.path.join(sysfs_pci, "devices", addr)
+    if current_driver(addr, sysfs_pci) != "vfio-pci":
+        return False
+    _write(os.path.join(dev_dir, "driver_override"), "")
+    _write(os.path.join(dev_dir, "driver", "unbind"), addr)
+    probe = os.path.join(sysfs_pci, "drivers_probe")
+    if os.path.exists(probe):
+        _write(probe, addr)
+    log.info("released %s from vfio-pci", addr)
+    return True
+
+
+def bind_all(sysfs_pci: str = SYSFS_PCI, status: StatusFiles = None) -> int:
+    funcs = google_functions(sysfs_pci)
+    if not funcs:
+        log.error("no Google PCI accelerator functions found")
+        return 1
+    for addr in funcs:
+        bind_one(addr, sysfs_pci)
+    bad = [a for a in funcs if current_driver(a, sysfs_pci) != "vfio-pci"]
+    if bad:
+        log.error("functions not bound after probe: %s", bad)
+        return 1
+    if status is not None:
+        status.write("vfio-pci-ready", {"bound": funcs})
+    return 0
+
+
+def unbind_all(sysfs_pci: str = SYSFS_PCI, status: StatusFiles = None) -> int:
+    for addr in google_functions(sysfs_pci):
+        unbind_one(addr, sysfs_pci)
+    if status is not None:
+        status.remove("vfio-pci-ready")
+    return 0
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level="INFO")
+    p = argparse.ArgumentParser("tpu-vfio-manager")
+    p.add_argument("command", choices=["bind-all", "unbind-all"])
+    p.add_argument("--sysfs-pci", default=SYSFS_PCI)
+    p.add_argument(
+        "--output-dir",
+        default=os.environ.get("VALIDATION_OUTPUT_DIR", consts.VALIDATION_DIR),
+    )
+    args = p.parse_args(argv)
+    status = StatusFiles(args.output_dir)
+    if args.command == "bind-all":
+        rc = bind_all(args.sysfs_pci, status)
+        if rc == 0:
+            # stay resident: the DaemonSet restarts us (and re-binds) if the
+            # node reboots or devices reappear
+            import time
+
+            while True:
+                time.sleep(60)
+        return rc
+    return unbind_all(args.sysfs_pci, status)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,199 @@
+"""``tpu-vm-manager`` / ``tpu-vm-device-manager`` / ``tpu-kata-manager`` —
+sandbox-workload operands (reference vgpu-manager / vgpu-device-manager /
+kata-manager slots).
+
+* vm-manager: prepares a vm-passthrough host — verifies the vfio stack,
+  publishes ``vm-manager-ready``.
+* vm-device-manager: materializes passthrough devices per named config
+  (reference ``assets/state-vgpu-device-manager/0500_configmap.yaml``):
+  groups vfio devices into VM-attachable units, recorded in a state file
+  the sandbox device plugin advertises from.
+* kata-manager: installs kata runtime artifacts and the containerd runtime
+  snippet for the ``kata-tpu`` RuntimeClass (reference
+  ``controllers/object_controls.go:4336-4428``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import logging
+import os
+import shutil
+import sys
+import time
+
+import yaml
+
+from tpu_operator import consts
+from tpu_operator.validator.components import StatusFiles
+
+log = logging.getLogger("tpu-vm-manager")
+
+
+# ---------------------------------------------------------------------------
+# vm-manager
+# ---------------------------------------------------------------------------
+
+
+def vm_manager_ready(
+    dev_root: str = "/dev", status: StatusFiles = None
+) -> int:
+    groups = [
+        g
+        for g in glob.glob(os.path.join(dev_root, "vfio", "*"))
+        if os.path.basename(g) != "vfio"
+    ]
+    control = os.path.join(dev_root, "vfio", "vfio")
+    if not os.path.exists(control):
+        log.error("vfio control node missing at %s (vfio modules loaded?)", control)
+        return 1
+    if status is not None:
+        status.write("vm-manager-ready", {"groups": sorted(groups)})
+    log.info("vm host ready: %d vfio groups", len(groups))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# vm-device-manager
+# ---------------------------------------------------------------------------
+
+DEFAULT_VM_STATE_FILE = "/run/tpu/vm-devices.json"
+
+
+def apply_vm_device_config(
+    config_file: str,
+    config_name: str,
+    dev_root: str = "/dev",
+    state_file: str = DEFAULT_VM_STATE_FILE,
+) -> dict:
+    with open(config_file) as f:
+        doc = yaml.safe_load(f) or {}
+    configs = doc.get("vm-device-configs", {})
+    if config_name not in configs:
+        raise ValueError(f"unknown vm-device config {config_name!r}")
+    groups = sorted(
+        g
+        for g in glob.glob(os.path.join(dev_root, "vfio", "*"))
+        if os.path.basename(g) != "vfio"
+    )
+    devices = [
+        {"id": i, "vfio_group": g, "resource": "google.com/tpu-vm"}
+        for i, g in enumerate(groups)
+    ]
+    state = {"config": config_name, "devices": devices}
+    os.makedirs(os.path.dirname(state_file), exist_ok=True)
+    tmp = state_file + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(tmp, state_file)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# kata-manager
+# ---------------------------------------------------------------------------
+
+KATA_SNIPPET = """\
+# Installed by tpu-operator (tpu-kata-manager).
+[plugins."io.containerd.grpc.v1.cri".containerd.runtimes.kata-tpu]
+  runtime_type = "io.containerd.kata.v2"
+  [plugins."io.containerd.grpc.v1.cri".containerd.runtimes.kata-tpu.options]
+    ConfigPath = "/opt/kata/configuration-tpu.toml"
+"""
+
+
+def install_kata(
+    artifacts_src: str = "/opt/kata-artifacts",
+    artifacts_dst: str = "/opt/kata",
+    containerd_conf_dir: str = "/etc/containerd/conf.d",
+) -> int:
+    if os.path.isdir(artifacts_src):
+        os.makedirs(artifacts_dst, exist_ok=True)
+        for name in os.listdir(artifacts_src):
+            src = os.path.join(artifacts_src, name)
+            dst = os.path.join(artifacts_dst, name)
+            if os.path.isfile(src) and not os.path.exists(dst):
+                shutil.copyfile(src, dst)
+    os.makedirs(containerd_conf_dir, exist_ok=True)
+    snippet = os.path.join(containerd_conf_dir, "kata-tpu.toml")
+    if not os.path.exists(snippet):
+        with open(snippet, "w") as f:
+            f.write(KATA_SNIPPET)
+        log.info("wrote kata containerd snippet %s", snippet)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# entrypoints
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level="INFO")
+    p = argparse.ArgumentParser("tpu-vm-manager")
+    p.add_argument("--dev-root", default="/dev")
+    p.add_argument(
+        "--output-dir",
+        default=os.environ.get("VALIDATION_OUTPUT_DIR", consts.VALIDATION_DIR),
+    )
+    args = p.parse_args(argv)
+    rc = vm_manager_ready(args.dev_root, StatusFiles(args.output_dir))
+    if rc:
+        return rc
+    while True:
+        time.sleep(60)
+
+
+def vm_device_main(argv=None) -> int:
+    logging.basicConfig(level="INFO")
+    p = argparse.ArgumentParser("tpu-vm-device-manager")
+    p.add_argument(
+        "--config-file",
+        default=os.environ.get(
+            "VM_DEVICE_CONFIG_FILE", "/vm-device-config/config.yaml"
+        ),
+    )
+    p.add_argument(
+        "--config",
+        default=os.environ.get("DEFAULT_VM_DEVICE_CONFIG", "default"),
+    )
+    p.add_argument("--dev-root", default="/dev")
+    p.add_argument("--state-file", default=DEFAULT_VM_STATE_FILE)
+    p.add_argument("--once", action="store_true")
+    args = p.parse_args(argv)
+    try:
+        state = apply_vm_device_config(
+            args.config_file, args.config, args.dev_root, args.state_file
+        )
+        log.info("materialized %d vm devices", len(state["devices"]))
+    except Exception:
+        log.exception("vm-device config failed")
+        return 1
+    if args.once:
+        return 0
+    while True:
+        time.sleep(60)
+
+
+def kata_main(argv=None) -> int:
+    logging.basicConfig(level="INFO")
+    p = argparse.ArgumentParser("tpu-kata-manager")
+    p.add_argument("--artifacts-src", default="/opt/kata-artifacts")
+    p.add_argument("--artifacts-dst", default="/opt/kata")
+    p.add_argument(
+        "--containerd-conf-dir",
+        default=os.environ.get("CONTAINERD_CONF_DIR", "/etc/containerd/conf.d"),
+    )
+    p.add_argument("--once", action="store_true")
+    args = p.parse_args(argv)
+    rc = install_kata(args.artifacts_src, args.artifacts_dst, args.containerd_conf_dir)
+    if rc or args.once:
+        return rc
+    while True:
+        time.sleep(60)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,13 +21,30 @@ from tpu_operator.native import tpuinfo
 CDI_VERSION = "0.6.0"
 CDI_KIND = "google.com/tpu"
 DEFAULT_SPEC_PATH = "/var/run/cdi/google.com-tpu.yaml"
+DEFAULT_PARTITION_FILE = "/run/tpu/partitions.json"
+
+
+def _load_partitions(partition_file: str) -> Optional[dict]:
+    import json
+
+    try:
+        with open(partition_file) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def build_spec(
     dev_root: str = "/dev",
     libtpu_dir: str = consts.LIBTPU_HOST_DIR,
     chips: Optional[List[dict]] = None,
+    partition_file: str = DEFAULT_PARTITION_FILE,
 ) -> dict:
+    """Every spec writer is partition-aware: when the slice manager has
+    partitioned the host (``partitions.json``), one composite CDI device per
+    subslice is included, so the device plugin's
+    ``google.com/tpu=subslice-<id>-<shape>`` names always resolve no matter
+    which operand wrote the spec last."""
     chips = chips if chips is not None else tpuinfo.chip_summary(dev_root)
     devices = []
     all_nodes = []
@@ -51,6 +68,19 @@ def build_spec(
             "containerEdits": {"deviceNodes": [dict(n) for n in all_nodes]},
         }
     )
+    partitions = _load_partitions(partition_file)
+    if partitions and partitions.get("partitioned"):
+        chip_nodes = {c["index"]: all_nodes[i] for i, c in enumerate(chips)}
+        for sub in partitions.get("subslices", []):
+            nodes = [
+                dict(chip_nodes[c]) for c in sub["chips"] if c in chip_nodes
+            ]
+            devices.append(
+                {
+                    "name": f"subslice-{sub['id']}-{sub['shape']}",
+                    "containerEdits": {"deviceNodes": nodes},
+                }
+            )
     return {
         "cdiVersion": CDI_VERSION,
         "kind": CDI_KIND,
@@ -73,8 +103,14 @@ def write_spec(
     dev_root: str = "/dev",
     libtpu_dir: str = consts.LIBTPU_HOST_DIR,
     chips: Optional[List[dict]] = None,
+    partition_file: str = DEFAULT_PARTITION_FILE,
 ) -> dict:
-    spec = build_spec(dev_root=dev_root, libtpu_dir=libtpu_dir, chips=chips)
+    spec = build_spec(
+        dev_root=dev_root,
+        libtpu_dir=libtpu_dir,
+        chips=chips,
+        partition_file=partition_file,
+    )
     os.makedirs(os.path.dirname(output_path), exist_ok=True)
     tmp = output_path + ".tmp"
     with open(tmp, "w") as f:

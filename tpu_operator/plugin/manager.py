@@ -1,0 +1,259 @@
+"""Plugin manager: one DevicePlugin server per advertised resource.
+
+Closes the slice-manager → device-plugin loop (the reference's
+mig-strategy plumbing, ``controllers/object_controls.go:1187-1256``):
+
+* ``single`` strategy (or unpartitioned): one ``google.com/tpu`` plugin
+  over whole chips;
+* ``mixed`` strategy with a partition state file
+  (``sliceman.write_partition_state``): one ``google.com/tpu-<shape>``
+  plugin per subslice shape, each subslice one schedulable device whose
+  Allocate expands to its member chips;
+* sandbox mode: a ``google.com/tpu-vm`` plugin advertising vfio groups
+  from the vm-device state file (the kubevirt-style sandbox plugin slot).
+
+Watches the partition file and restarts resource servers on change — the
+device-plugin side of the ``tpu.slice.config`` label FSM.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Dict, List, Optional
+
+from tpu_operator import consts
+from tpu_operator.plugin.proto import pb2
+from tpu_operator.plugin.server import (
+    KUBELET_SOCKET_DIR,
+    DevicePluginServer,
+    TPUDevicePluginServicer,
+)
+
+log = logging.getLogger("tpu-device-plugin")
+
+
+class SubslicePluginServicer(TPUDevicePluginServicer):
+    """Advertises one device per subslice; Allocate expands to member chips."""
+
+    def __init__(self, subslices: List[dict], resource_name: str, **kw):
+        self.subslices = {str(s["id"]): s for s in subslices}
+        super().__init__(resource_name=resource_name, **kw)
+
+    def discover(self):
+        return [{"index": int(i)} for i in sorted(self.subslices, key=int)]
+
+    def Allocate(self, request, context):
+        resp = pb2.AllocateResponse()
+        for creq in request.container_requests:
+            cresp = resp.container_responses.add()
+            chips: List[int] = []
+            for sub_id in creq.devicesIDs:
+                chips.extend(self.subslices[str(sub_id)]["chips"])
+            if self.cdi_enabled:
+                for sub_id in creq.devicesIDs:
+                    sub = self.subslices[str(sub_id)]
+                    cresp.cdi_devices.add().name = (
+                        f"google.com/tpu=subslice-{sub['id']}-{sub['shape']}"
+                    )
+            else:
+                for chip in sorted(chips):
+                    spec = cresp.devices.add()
+                    spec.host_path = os.path.join(self.dev_root, f"accel{chip}")
+                    spec.container_path = f"/dev/accel{chip}"
+                    spec.permissions = "rw"
+            env = dict(self.slice_env)
+            env["TPU_CHIPS_VISIBLE"] = ",".join(str(c) for c in sorted(chips))
+            env["TPU_SUBSLICE_SHAPE"] = self.subslices[
+                str(creq.devicesIDs[0])
+            ]["shape"] if creq.devicesIDs else ""
+            for k, v in sorted(env.items()):
+                cresp.envs[k] = v
+        return resp
+
+
+class VfioPluginServicer(TPUDevicePluginServicer):
+    """Sandbox device plugin: advertises vfio groups for VM workloads."""
+
+    def __init__(self, vm_state_file: str, **kw):
+        self.vm_state_file = vm_state_file
+        kw.setdefault("resource_name", "google.com/tpu-vm")
+        super().__init__(**kw)
+
+    def discover(self):
+        try:
+            with open(self.vm_state_file) as f:
+                state = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return []
+        return [{"index": d["id"], "path": d["vfio_group"]} for d in state.get("devices", [])]
+
+    def Allocate(self, request, context):
+        resp = pb2.AllocateResponse()
+        with open(self.vm_state_file) as f:
+            devices = {
+                str(d["id"]): d for d in json.load(f).get("devices", [])
+            }
+        for creq in request.container_requests:
+            cresp = resp.container_responses.add()
+            for dev_id in creq.devicesIDs:
+                group = devices[str(dev_id)]["vfio_group"]
+                spec = cresp.devices.add()
+                spec.host_path = group
+                spec.container_path = group
+                spec.permissions = "rw"
+            ctl = cresp.devices.add()
+            ctl.host_path = ctl.container_path = "/dev/vfio/vfio"
+            ctl.permissions = "rw"
+        return resp
+
+
+class PluginManager:
+    def __init__(
+        self,
+        strategy: str = "single",
+        partition_file: str = "/run/tpu/partitions.json",
+        socket_dir: str = KUBELET_SOCKET_DIR,
+        servicer_kw: Optional[dict] = None,
+        poll_interval_s: float = 10.0,
+    ):
+        self.strategy = strategy
+        self.partition_file = partition_file
+        self.socket_dir = socket_dir
+        self.servicer_kw = servicer_kw or {}
+        self.poll_interval_s = poll_interval_s
+        self.servers: Dict[str, DevicePluginServer] = {}
+        self._stop = threading.Event()
+        self._last_sig = None
+
+    # ------------------------------------------------------------------
+    def _partition_state(self) -> Optional[dict]:
+        try:
+            with open(self.partition_file) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def desired_resources(self) -> Dict[str, dict]:
+        """resource name -> config for the servicer factory (the MIG
+        single/mixed strategy semantics)."""
+        state = self._partition_state()
+        partitioned = bool(
+            state and state.get("partitioned") and state.get("subslices")
+        )
+        if partitioned and self.strategy == "mixed":
+            by_shape: Dict[str, List[dict]] = {}
+            for sub in state["subslices"]:
+                by_shape.setdefault(sub["shape"], []).append(sub)
+            return {
+                consts.TPU_SUBSLICE_RESOURCE_PREFIX + shape: {
+                    "kind": "subslice",
+                    "subslices": subs,
+                }
+                for shape, subs in by_shape.items()
+            }
+        if partitioned and self.strategy == "single":
+            # uniform partition advertised under the plain resource name:
+            # each schedulable unit is one subslice (MIG single strategy)
+            return {
+                consts.TPU_RESOURCE: {
+                    "kind": "subslice",
+                    "subslices": state["subslices"],
+                }
+            }
+        return {consts.TPU_RESOURCE: {"kind": "chips"}}
+
+    def _make_server(self, resource: str, cfg: dict) -> DevicePluginServer:
+        if cfg["kind"] == "subslice":
+            servicer = SubslicePluginServicer(
+                cfg["subslices"], resource_name=resource, **self.servicer_kw
+            )
+        else:
+            servicer = TPUDevicePluginServicer(
+                resource_name=resource, **self.servicer_kw
+            )
+        sock = "tpu-" + resource.split("/")[-1] + ".sock"
+        return DevicePluginServer(
+            servicer, socket_dir=self.socket_dir, socket_name=sock
+        )
+
+    def sync(self, register: bool = False) -> bool:
+        """Reconcile running servers against desired resources; returns True
+        when the server set changed."""
+        desired = self.desired_resources()
+        sig = json.dumps(desired, sort_keys=True)
+        if sig == self._last_sig:
+            return False
+        self._last_sig = sig
+        for resource, server in list(self.servers.items()):
+            server.stop()
+            del self.servers[resource]
+        for resource, cfg in desired.items():
+            server = self._make_server(resource, cfg)
+            server.start()
+            if register:
+                try:
+                    server.register_with_kubelet()
+                except Exception:
+                    log.exception("kubelet registration failed for %s", resource)
+            self.servers[resource] = server
+        log.info("serving resources: %s", sorted(self.servers))
+        return True
+
+    def run(self, register: bool = True, block: bool = True):
+        self.sync(register=register)
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.sync(register=register)
+                except Exception:
+                    log.exception("plugin sync failed")
+                self._stop.wait(self.poll_interval_s)
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        if block:
+            while not self._stop.is_set():
+                import time
+
+                time.sleep(1)
+
+    def stop(self):
+        self._stop.set()
+        for server in self.servers.values():
+            server.stop()
+
+
+def sandbox_main(argv=None) -> int:
+    """``tpu-sandbox-device-plugin`` entrypoint: vfio-group device plugin for
+    VM workloads (reference sandbox-device-plugin slot)."""
+    import argparse
+    import time
+
+    logging.basicConfig(level="INFO")
+    p = argparse.ArgumentParser("tpu-sandbox-device-plugin")
+    p.add_argument(
+        "--vm-state-file",
+        default=os.environ.get("VM_STATE_FILE", "/run/tpu/vm-devices.json"),
+    )
+    p.add_argument("--socket-dir", default=KUBELET_SOCKET_DIR)
+    p.add_argument("--dev-root", default="/dev")
+    args = p.parse_args(argv)
+    servicer = VfioPluginServicer(
+        args.vm_state_file, dev_root=args.dev_root, cdi_enabled=False
+    )
+    server = DevicePluginServer(
+        servicer, socket_dir=args.socket_dir, socket_name="tpu-vm.sock"
+    )
+    server.start()
+    try:
+        server.register_with_kubelet()
+    except Exception:
+        log.exception("kubelet registration failed; serving anyway")
+    try:
+        while True:
+            time.sleep(5)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0

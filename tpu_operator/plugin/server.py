@@ -287,25 +287,23 @@ def main(argv=None) -> int:
             log.warning("could not read node labels; slice env disabled")
 
     from tpu_operator.controllers.state_manager import node_generation
+    from tpu_operator.plugin.manager import PluginManager
 
-    servicer = TPUDevicePluginServicer(
-        dev_root=args.dev_root,
-        generation=node_generation({"metadata": {"labels": labels}}) or "",
-        host_topology=labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, ""),
-        cdi_enabled=bool(args.cdi),
-        slice_env=slice_env_from_node_labels(labels),
+    mgr = PluginManager(
+        strategy=args.strategy,
+        socket_dir=args.socket_dir,
+        servicer_kw=dict(
+            dev_root=args.dev_root,
+            generation=node_generation({"metadata": {"labels": labels}}) or "",
+            host_topology=labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, ""),
+            cdi_enabled=bool(args.cdi),
+            slice_env=slice_env_from_node_labels(labels),
+        ),
     )
-    server = DevicePluginServer(servicer, socket_dir=args.socket_dir)
-    server.start()
     try:
-        server.register_with_kubelet()
-    except Exception:
-        log.exception("kubelet registration failed; serving anyway")
-    try:
-        while True:
-            time.sleep(5)
+        mgr.run(register=True, block=True)
     except KeyboardInterrupt:
-        server.stop()
+        mgr.stop()
     return 0
 
 

@@ -200,24 +200,16 @@ class SliceManager:
         return state
 
     def _regenerate_cdi(self, state: dict) -> None:
+        # build_spec reads the partition file we just wrote, so the subslice
+        # composite devices land in the shared spec path that runtime-wire
+        # also maintains — both writers produce identical content
         from tpu_operator.plugin import cdi
 
-        spec = cdi.build_spec(dev_root=self.dev_root)
-        # one composite CDI device per subslice
-        for sub in state.get("subslices", []):
-            nodes = [
-                {"path": os.path.join(self.dev_root, f"accel{c}"), "permissions": "rw"}
-                for c in sub["chips"]
-            ]
-            spec["devices"].append(
-                {
-                    "name": f"subslice-{sub['id']}-{sub['shape']}",
-                    "containerEdits": {"deviceNodes": nodes},
-                }
-            )
-        os.makedirs(os.path.dirname(self.cdi_spec_path), exist_ok=True)
-        with open(self.cdi_spec_path, "w") as f:
-            yaml.safe_dump(spec, f, sort_keys=False)
+        cdi.write_spec(
+            self.cdi_spec_path,
+            dev_root=self.dev_root,
+            partition_file=self.partition_file,
+        )
 
     # ------------------------------------------------------------------
     def reconcile_once(self) -> Optional[str]:
@@ -271,8 +263,11 @@ def main(argv=None) -> int:
         default=os.environ.get("CHIP_CLIENTS_FILE", "/chip-clients/clients.yaml"),
     )
     p.add_argument("--partition-file", default=DEFAULT_PARTITION_FILE)
+    from tpu_operator.plugin.cdi import DEFAULT_SPEC_PATH
+
     p.add_argument(
-        "--cdi-spec", default=os.environ.get("CDI_SPEC_PATH", "")
+        "--cdi-spec",
+        default=os.environ.get("CDI_SPEC_PATH", DEFAULT_SPEC_PATH),
     )
     p.add_argument("--interval", type=float, default=15.0)
     p.add_argument("--once", action="store_true")

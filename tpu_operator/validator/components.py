@@ -272,6 +272,30 @@ def validate_slice(
 
 
 # ---------------------------------------------------------------------------
+# ici component (ring probe: per-link health + bandwidth)
+# ---------------------------------------------------------------------------
+
+
+def validate_ici(
+    status: StatusFiles,
+    expect_devices: Optional[int] = None,
+    payload_mb: float = 4.0,
+) -> dict:
+    """Rotate a payload around the full device ring via ppermute; every
+    shard must return bit-exact (isolates individual ICI links, unlike the
+    aggregate burn-in)."""
+    from tpu_operator.workloads.ring import run_ring_probe
+
+    res = run_ring_probe(n_devices=expect_devices, payload_mb=payload_mb)
+    if not res.ok:
+        raise ValidationError(
+            f"ICI ring probe failed: {res.error or 'integrity mismatch'}"
+        )
+    status.write("ici-ready", res.to_dict())
+    return res.to_dict()
+
+
+# ---------------------------------------------------------------------------
 # vfio-pci component (reference validator/main.go:1301-1501, go-nvlib PCI)
 # ---------------------------------------------------------------------------
 

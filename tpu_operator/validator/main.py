@@ -23,6 +23,7 @@ COMPONENTS = (
     "plugin",
     "jax",
     "slice",
+    "ici",
     "vfio-pci",
     "nodestatus",
 )
@@ -131,6 +132,10 @@ def main(argv=None) -> int:
             )
         elif args.component == "slice":
             info = comp.validate_slice(
+                status, expect_devices=args.expect_devices
+            )
+        elif args.component == "ici":
+            info = comp.validate_ici(
                 status, expect_devices=args.expect_devices
             )
         elif args.component == "vfio-pci":

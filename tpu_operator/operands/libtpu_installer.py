@@ -18,6 +18,7 @@ import argparse
 import glob
 import logging
 import os
+import re
 import shutil
 import signal
 import sys
@@ -38,7 +39,19 @@ def find_source(source_dir: str, version: str = "") -> str:
         exact = os.path.join(source_dir, f"libtpu-{version}.so")
         if os.path.exists(exact):
             return exact
-    candidates = sorted(glob.glob(os.path.join(source_dir, "libtpu*.so")))
+    def version_key(path: str):
+        # numeric-aware sort so 2025.10.0 > 2025.2.0 (lexicographic fails
+        # once any component reaches two digits)
+        base = os.path.basename(path)[len("libtpu"):].strip("-").removesuffix(".so")
+        return [
+            (0, int(part), "") if part.isdigit() else (1, 0, part)
+            for part in re.split(r"[._-]", base)
+            if part
+        ]
+
+    candidates = sorted(
+        glob.glob(os.path.join(source_dir, "libtpu*.so")), key=version_key
+    )
     if not candidates:
         raise FileNotFoundError(f"no libtpu*.so under {source_dir}")
     return candidates[-1]

@@ -14,6 +14,7 @@ import argparse
 import logging
 import os
 import sys
+import time
 
 from tpu_operator import consts
 from tpu_operator.validator.components import StatusFiles
@@ -26,6 +27,7 @@ def uninstall_libtpu(
     node_name: str,
     status: StatusFiles,
     force: bool = False,
+    eviction_timeout_s: float = 300.0,
 ) -> int:
     from tpu_operator.upgrade.upgrade_state import PodManager
 
@@ -42,17 +44,31 @@ def uninstall_libtpu(
 
     # 2. evict TPU workload pods still holding the chip
     if client is not None and node_name:
-        pods = PodManager(client, "").tpu_pods_on_node(node_name)
+        pm = PodManager(client, "")
+        pods = pm.tpu_pods_on_node(node_name)
         if pods:
             log.info("evicting %d TPU pods from %s", len(pods), node_name)
-            PodManager(client, "").delete_pods(pods, force=force)
-            remaining = PodManager(client, "").tpu_pods_on_node(node_name)
-            if remaining:
-                log.error(
-                    "%d TPU pods still present (unmanaged? set DRAIN_USE_FORCE)",
-                    len(remaining),
-                )
-                return 1
+            pm.delete_pods(pods, force=force)
+            # Graceful deletes leave pods listed (with deletionTimestamp) for
+            # their grace period; poll until they disappear rather than failing
+            # on the first still-Terminating listing.
+            deadline = time.monotonic() + eviction_timeout_s
+            while True:
+                remaining = [
+                    p
+                    for p in pm.tpu_pods_on_node(node_name)
+                    if not p["metadata"].get("deletionTimestamp")
+                ]
+                if not remaining:
+                    break
+                if time.monotonic() >= deadline:
+                    log.error(
+                        "%d TPU pods still present (unmanaged? set "
+                        "DRAIN_USE_FORCE)",
+                        len(remaining),
+                    )
+                    return 1
+                time.sleep(2.0)
     return 0
 
 

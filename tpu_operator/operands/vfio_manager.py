@@ -67,7 +67,9 @@ def unbind_one(addr: str, sysfs_pci: str = SYSFS_PCI) -> bool:
     dev_dir = os.path.join(sysfs_pci, "devices", addr)
     if current_driver(addr, sysfs_pci) != "vfio-pci":
         return False
-    _write(os.path.join(dev_dir, "driver_override"), "")
+    # a bare newline is the sysfs idiom for clearing driver_override; a
+    # zero-byte write never reaches the kernel's store callback
+    _write(os.path.join(dev_dir, "driver_override"), "\n")
     _write(os.path.join(dev_dir, "driver", "unbind"), addr)
     probe = os.path.join(sysfs_pci, "drivers_probe")
     if os.path.exists(probe):

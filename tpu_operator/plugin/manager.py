@@ -92,14 +92,32 @@ class VfioPluginServicer(TPUDevicePluginServicer):
 
     def Allocate(self, request, context):
         resp = pb2.AllocateResponse()
-        with open(self.vm_state_file) as f:
-            devices = {
-                str(d["id"]): d for d in json.load(f).get("devices", [])
-            }
+        try:
+            with open(self.vm_state_file) as f:
+                devices = {
+                    str(d["id"]): d for d in json.load(f).get("devices", [])
+                }
+        except (OSError, json.JSONDecodeError) as e:
+            import grpc
+
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"vm device state unreadable ({e}); retry after "
+                "tpu-vm-device-manager rewrites it",
+            )
         for creq in request.container_requests:
             cresp = resp.container_responses.add()
             for dev_id in creq.devicesIDs:
-                group = devices[str(dev_id)]["vfio_group"]
+                dev = devices.get(str(dev_id))
+                if dev is None:
+                    import grpc
+
+                    context.abort(
+                        grpc.StatusCode.NOT_FOUND,
+                        f"stale allocation: vfio device {dev_id!r} no longer "
+                        "in vm device state (repartitioned?)",
+                    )
+                group = dev["vfio_group"]
                 spec = cresp.devices.add()
                 spec.host_path = group
                 spec.container_path = group
@@ -186,7 +204,6 @@ class PluginManager:
         sig = json.dumps(desired, sort_keys=True)
         if sig == self._last_sig:
             return False
-        self._last_sig = sig
         for resource, server in list(self.servers.items()):
             server.stop()
             del self.servers[resource]
@@ -199,6 +216,9 @@ class PluginManager:
                 except Exception:
                     log.exception("kubelet registration failed for %s", resource)
             self.servers[resource] = server
+        # only after every server is up: a start failure above leaves the
+        # signature stale so the next sync retries instead of no-opping
+        self._last_sig = sig
         log.info("serving resources: %s", sorted(self.servers))
         return True
 

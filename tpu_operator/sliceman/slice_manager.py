@@ -263,11 +263,12 @@ def main(argv=None) -> int:
         default=os.environ.get("CHIP_CLIENTS_FILE", "/chip-clients/clients.yaml"),
     )
     p.add_argument("--partition-file", default=DEFAULT_PARTITION_FILE)
-    from tpu_operator.plugin.cdi import DEFAULT_SPEC_PATH
-
+    # CDI spec regeneration is opt-in: the operator injects CDI_SPEC_PATH
+    # only when cp.spec.cdi is enabled (object_controls.transform_slice_manager);
+    # an empty default keeps CDI-off clusters from writing host specs
     p.add_argument(
         "--cdi-spec",
-        default=os.environ.get("CDI_SPEC_PATH", DEFAULT_SPEC_PATH),
+        default=os.environ.get("CDI_SPEC_PATH", ""),
     )
     p.add_argument("--interval", type=float, default=15.0)
     p.add_argument("--once", action="store_true")

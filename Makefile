@@ -1,8 +1,10 @@
 # Top-level targets (reference Makefile shape: build/test/validate).
 
-.PHONY: all native test crd validate lint clean dev-run
+.PHONY: all native test crd bundle validate lint clean dev-run docker-build
 
-all: native crd
+IMAGE ?= gcr.io/tpu-operator/tpu-operator:0.1.0
+
+all: native crd bundle
 
 native:
 	$(MAKE) -C native
@@ -14,10 +16,21 @@ test:
 crd:
 	python -c "from tpu_operator.cfg.crdgen import render_crd_yaml; \
 	  open('deployments/tpu-operator/crds/tpu.k8s.io_clusterpolicies.yaml','w').write(render_crd_yaml())"
+	cp deployments/tpu-operator/crds/tpu.k8s.io_clusterpolicies.yaml config/crd/
+	cp deployments/tpu-operator/crds/tpu.k8s.io_clusterpolicies.yaml bundle/manifests/
+
+# regenerate the OLM bundle CSV from config/ sources
+bundle:
+	python -m tpu_operator.cfg.main generate csv > bundle/manifests/tpu-operator.clusterserviceversion.yaml
 
 validate:
 	python -m tpu_operator.cfg.main validate clusterpolicy --input config/samples/v1_clusterpolicy.yaml
 	python -m tpu_operator.cfg.main validate chart --dir deployments/tpu-operator
+	python -m tpu_operator.cfg.main validate csv --input bundle/manifests/tpu-operator.clusterserviceversion.yaml
+
+docker-build:
+	docker build -f docker/Dockerfile -t $(IMAGE) .
+	docker build -f docker/Dockerfile.jax-validator -t $(IMAGE)-jax-validator .
 
 bench:
 	python bench.py

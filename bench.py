@@ -23,7 +23,11 @@ def main() -> int:
 
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
     if on_tpu:
-        res = run_matmul_validation(size=8192, depth=8, iters=16, expect_tpu=True)
+        # 16384² bf16 operands, 16-deep chain, 8 chained dispatches: big
+        # enough that the MXU pipeline stays saturated and the single
+        # end-of-chain sync is amortized (measured 96% of v5e peak vs 87%
+        # for 8192/8/16)
+        res = run_matmul_validation(size=16384, depth=16, iters=8, expect_tpu=True)
     else:
         res = run_matmul_validation(size=1024, depth=2, iters=2, expect_tpu=False)
 

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Test launcher (reference tests/local.sh shape, minus the AWS terraform —
+# TPU node pools come from GKE, not an instance bring-up):
+#
+#   tests/local.sh fake            # no cluster needed: in-memory e2e
+#   tests/local.sh defaults        # full e2e on the current kube context
+#   tests/local.sh sandbox         # e2e with sandboxWorkloads enabled
+#
+# For real cases the kube context must point at a cluster with a TPU node
+# pool (e.g. GKE v4-8/v5e); see tests/README in SURVEY.md §4.
+set -euo pipefail
+HERE=$(cd "$(dirname "$0")" && pwd)
+CASE=${1:-fake}
+
+case "$CASE" in
+  fake)
+    exec python3 "$HERE/scripts/fake_e2e.py"
+    ;;
+  defaults|sandbox)
+    command -v kubectl >/dev/null || { echo "kubectl required" >&2; exit 1; }
+    command -v helm >/dev/null || { echo "helm required" >&2; exit 1; }
+    exec "$HERE/cases/$CASE.sh"
+    ;;
+  *)
+    echo "unknown case: $CASE (want fake|defaults|sandbox)" >&2
+    exit 2
+    ;;
+esac

@@ -6,11 +6,13 @@
 : "${TIMEOUT_S:=2700}"   # 45min ceiling, same as the reference
 
 check_pod_ready() {
-  local label=$1 deadline=$((SECONDS + TIMEOUT_S))
+  local label=$1 deadline=$((SECONDS + TIMEOUT_S)) statuses
   while [ $SECONDS -lt $deadline ]; do
-    if kubectl -n "$TEST_NAMESPACE" get pods -l "app=$label" \
-        -o jsonpath='{.items[*].status.conditions[?(@.type=="Ready")].status}' \
-        | grep -qv False | grep -q True; then
+    statuses=$(kubectl -n "$TEST_NAMESPACE" get pods -l "app=$label" \
+        -o jsonpath='{.items[*].status.conditions[?(@.type=="Ready")].status}')
+    # non-empty, at least one True, no False
+    if [ -n "$statuses" ] && echo "$statuses" | grep -q True && \
+        ! echo "$statuses" | grep -q False; then
       echo "pods for $label Ready"
       return 0
     fi

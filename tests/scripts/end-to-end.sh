@@ -10,8 +10,10 @@ source "$HERE/checks.sh"
 : "${TEST_NAMESPACE:=tpu-operator}"
 
 echo "=== install-operator"
+# shellcheck disable=SC2086  # CHART_EXTRA_ARGS is intentionally word-split
 helm upgrade --install tpu-operator "$CHART" \
-  --namespace "$TEST_NAMESPACE" --create-namespace --wait
+  --namespace "$TEST_NAMESPACE" --create-namespace --wait \
+  ${CHART_EXTRA_ARGS:-}
 
 echo "=== verify-operator"
 check_pod_ready tpu-operator

@@ -1,0 +1,130 @@
+"""Fake-cluster end-to-end: the full operand lifecycle with no k8s.
+
+Drives the same sequence as ``end-to-end.sh`` (install → converge →
+operator restart → update-clusterpolicy → disable/enable → uninstall)
+against the in-memory API server with the simulated kubelet, so the whole
+state machine is exercised in CI — the reference has no such no-cluster
+path (SURVEY.md §4: "no multi-node-without-cluster simulation"); this is
+the TPU build's improvement on it.
+
+Run: OPERATOR_NAMESPACE=tpu-operator python tests/scripts/fake_e2e.py
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+NS = os.environ["OPERATOR_NAMESPACE"]
+CP = "tpu.k8s.io/v1"
+
+
+def wait_for(what, pred, timeout_s=60.0, poll_s=0.2):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            print(f"ok: {what}")
+            return
+        time.sleep(poll_s)
+    raise SystemExit(f"TIMEOUT waiting for {what}")
+
+
+def main() -> int:
+    from tpu_operator.kube.testing import simulate_kubelet_once
+    from tpu_operator.main import make_fake_client
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        ClusterPolicyReconciler,
+    )
+
+    client = make_fake_client()
+    reconciler = ClusterPolicyReconciler(client)
+
+    def converge(max_rounds=30):
+        for _ in range(max_rounds):
+            res = reconciler.reconcile()
+            simulate_kubelet_once(client, NS)
+            if res.ready:
+                return res
+        return res
+
+    print("=== install-operator (reconcile to Ready)")
+    res = converge()
+    assert res.ready, f"never converged: {res}"
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    assert cp["status"]["state"] == "ready", cp["status"]
+
+    print("=== verify-operator (DaemonSets present)")
+    ds_names = {d["metadata"]["name"] for d in client.list("apps/v1", "DaemonSet", NS)}
+    for expected in (
+        "tpu-libtpu-daemonset",
+        "tpu-device-plugin-daemonset",
+        "tpu-operator-validator",
+        "tpu-feature-discovery",
+        "tpu-metrics-exporter",
+    ):
+        assert expected in ds_names, f"{expected} missing from {sorted(ds_names)}"
+
+    print("=== verify-operand-restarts (reconciler restart keeps operands)")
+    uids_before = {
+        d["metadata"]["name"]: d["metadata"].get("uid")
+        for d in client.list("apps/v1", "DaemonSet", NS)
+    }
+    reconciler2 = ClusterPolicyReconciler(client)  # fresh process analogue
+    res = reconciler2.reconcile()
+    uids_after = {
+        d["metadata"]["name"]: d["metadata"].get("uid")
+        for d in client.list("apps/v1", "DaemonSet", NS)
+    }
+    assert uids_before == uids_after, "operands churned on operator restart"
+
+    print("=== update-clusterpolicy (disable metricsExporter)")
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    cp["spec"]["metricsExporter"]["enabled"] = False
+    client.update(cp)
+    converge()
+    ds_names = {d["metadata"]["name"] for d in client.list("apps/v1", "DaemonSet", NS)}
+    assert "tpu-metrics-exporter" not in ds_names, "exporter not deleted on disable"
+
+    print("=== enable-operands (re-enable metricsExporter)")
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    cp["spec"]["metricsExporter"]["enabled"] = True
+    client.update(cp)
+    res = converge()
+    assert res.ready
+    ds_names = {d["metadata"]["name"] for d in client.list("apps/v1", "DaemonSet", NS)}
+    assert "tpu-metrics-exporter" in ds_names
+
+    print("=== node-departure (last TPU node removed → 45s NFD-poll posture)")
+    client.delete("v1", "Node", "fake-tpu-node-1")
+    res = reconciler.reconcile()
+    # reference semantics (clusterpolicy_controller.go:169-182): with no
+    # NFD-labelled node left the CR drops to notReady and polls at 45s
+    assert not res.ready and res.requeue_after == 45.0, res
+
+    print("=== node-arrival (TPU node joins → back to Ready)")
+    from tpu_operator.kube.testing import make_tpu_node
+
+    client.create(make_tpu_node("fake-tpu-node-1"))
+    res = converge()
+    assert res.ready, f"did not recover on node arrival: {res}"
+
+    print("=== uninstall (delete CR → operands garbage-collected by ownerRef)")
+    client.delete(CP, "ClusterPolicy", "cluster-policy")
+    # fake client implements ownerRef cascade like the API server's GC
+    wait_for(
+        "operand GC",
+        lambda: not client.list("apps/v1", "DaemonSet", NS),
+        timeout_s=10,
+    )
+
+    print("FAKE-E2E PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

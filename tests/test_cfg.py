@@ -101,3 +101,53 @@ def test_sample_cr_decodes_under_chart_values_shape():
     spec = ClusterPolicySpec.from_dict(values)
     assert spec.libtpu.image == "libtpu-installer"
     assert spec.metricsd.host_port == 5555
+
+
+BUNDLE_CSV = os.path.join(
+    REPO, "bundle", "manifests", "tpu-operator.clusterserviceversion.yaml"
+)
+
+
+def test_bundle_csv_valid():
+    from tpu_operator.cfg.csvgen import validate_csv
+
+    assert validate_csv(BUNDLE_CSV, config_dir=os.path.join(REPO, "config")) == []
+
+
+def test_bundle_csv_stale_or_broken_detected(tmp_path):
+    from tpu_operator.cfg.csvgen import validate_csv
+
+    csv = yaml.safe_load(open(BUNDLE_CSV))
+    csv["spec"]["relatedImages"][0]["image"] = "gcr.io/tpu-operator/tpu-operator"
+    csv["spec"]["customresourcedefinitions"]["owned"][0]["version"] = "v2"
+    bad = tmp_path / "csv.yaml"
+    bad.write_text(yaml.safe_dump(csv))
+    problems = validate_csv(str(bad), config_dir=os.path.join(REPO, "config"))
+    assert any("unpinned" in p for p in problems)
+    assert any("owned" in p for p in problems)
+    assert any("stale" in p for p in problems)
+
+
+def test_bundle_csv_alm_examples_match_sample():
+    import json
+
+    csv = yaml.safe_load(open(BUNDLE_CSV))
+    examples = json.loads(csv["metadata"]["annotations"]["alm-examples"])
+    sample = yaml.safe_load(open(SAMPLE))
+    assert examples[0] == sample
+
+
+def test_bundle_crd_matches_generated():
+    bundle_crd = yaml.safe_load(
+        open(os.path.join(REPO, "bundle", "manifests", "tpu.k8s.io_clusterpolicies.yaml"))
+    )
+    assert bundle_crd == crdgen.build_crd()
+
+
+def test_cli_csv_commands(capsys):
+    assert main(["validate", "csv", "--input", BUNDLE_CSV,
+                 "--config-dir", os.path.join(REPO, "config")]) == 0
+    capsys.readouterr()
+    assert main(["generate", "csv", "--config-dir", os.path.join(REPO, "config")]) == 0
+    out = capsys.readouterr().out
+    assert yaml.safe_load(out)["kind"] == "ClusterServiceVersion"

@@ -1,0 +1,13 @@
+"""The fake-cluster e2e sequence as a CI test (tests/scripts/fake_e2e.py)."""
+
+import importlib.util
+import os
+
+
+def test_fake_e2e_sequence(monkeypatch):
+    monkeypatch.setenv("OPERATOR_NAMESPACE", "tpu-operator")
+    path = os.path.join(os.path.dirname(__file__), "scripts", "fake_e2e.py")
+    spec = importlib.util.spec_from_file_location("fake_e2e", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0

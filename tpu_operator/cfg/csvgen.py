@@ -1,0 +1,186 @@
+"""OLM ClusterServiceVersion generation for the bundle.
+
+The reference ships hand-maintained per-release CSVs under ``bundle/<ver>/``
+(SURVEY.md §2.1 #15). Here the CSV is generated from the same sources the
+rest of the repo already treats as truth — the sample ClusterPolicy
+(``config/samples``), the operator Deployment (``config/manager``), and the
+RBAC rules (``config/rbac``) — so bundle, kustomize base, and chart can
+never drift apart. ``tpuop-cfg generate csv`` prints it; ``tpuop-cfg
+validate csv`` (reference ``cmd/gpuop-cfg/validate/csv/csv.go:1-117``)
+checks the on-disk bundle is fresh and its images resolvable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+import yaml
+
+from tpu_operator import consts
+
+OPERATOR_VERSION = "0.1.0"
+
+DESCRIPTION = """\
+The TPU Operator manages the software needed to provision Cloud TPU nodes
+in a Kubernetes cluster: libtpu install, TPU device plugin, runtime/CDI
+wiring, slice partitioning, feature discovery, metrics export, and an
+end-to-end JAX validation harness — all driven by a single cluster-scoped
+ClusterPolicy resource reconciled through an ordered state machine.
+"""
+
+
+def _load_yaml(path: str):
+    with open(path) as f:
+        return list(yaml.safe_load_all(f))
+
+
+def build_csv(
+    config_dir: str = "config",
+    version: str = OPERATOR_VERSION,
+) -> Dict[str, Any]:
+    sample = _load_yaml(os.path.join(config_dir, "samples", "v1_clusterpolicy.yaml"))[0]
+    deployment = _load_yaml(os.path.join(config_dir, "manager", "manager.yaml"))[0]
+    rbac_docs = _load_yaml(os.path.join(config_dir, "rbac", "role.yaml"))
+    cluster_rules: List[dict] = []
+    for doc in rbac_docs:
+        if doc and doc.get("kind") == "ClusterRole":
+            cluster_rules.extend(doc.get("rules", []))
+
+    dep_spec = deployment["spec"]
+    service_account = dep_spec["template"]["spec"]["serviceAccountName"]
+    operator_image = dep_spec["template"]["spec"]["containers"][0]["image"]
+
+    related = [{"name": "tpu-operator", "image": operator_image}]
+    for key, sub in sorted(sample.get("spec", {}).items()):
+        if not isinstance(sub, dict) or "image" not in sub:
+            continue
+        repo, img, ver = sub.get("repository", ""), sub["image"], sub.get("version", "")
+        if repo and ver:
+            related.append({"name": img, "image": f"{repo}/{img}:{ver}"})
+
+    return {
+        "apiVersion": "operators.coreos.com/v1alpha1",
+        "kind": "ClusterServiceVersion",
+        "metadata": {
+            "name": f"tpu-operator.v{version}",
+            "namespace": "placeholder",
+            "annotations": {
+                "alm-examples": json.dumps([sample], indent=2),
+                "operators.operatorframework.io/builder": "tpuop-cfg",
+                "operators.operatorframework.io/project_layout": "python",
+                "capabilities": "Deep Insights",
+                "categories": "AI/Machine Learning, OpenShift Optional",
+                "description": "Automates provisioning of Cloud TPU nodes.",
+                "provider": "tpu-operator authors",
+            },
+        },
+        "spec": {
+            "displayName": "TPU Operator",
+            "description": DESCRIPTION,
+            "version": version,
+            "maturity": "alpha",
+            "provider": {"name": "tpu-operator authors"},
+            "keywords": ["tpu", "jax", "xla", "device plugin", "accelerator"],
+            "maintainers": [{"name": "tpu-operator authors"}],
+            "links": [],
+            "minKubeVersion": "1.24.0",
+            "installModes": [
+                {"type": "OwnNamespace", "supported": True},
+                {"type": "SingleNamespace", "supported": True},
+                {"type": "MultiNamespace", "supported": False},
+                {"type": "AllNamespaces", "supported": False},
+            ],
+            "customresourcedefinitions": {
+                "owned": [
+                    {
+                        "name": consts.CRD_NAME,
+                        "kind": "ClusterPolicy",
+                        "version": "v1",
+                        "displayName": "ClusterPolicy",
+                        "description": "Desired state of the TPU software "
+                        "stack on every TPU node.",
+                    }
+                ]
+            },
+            "install": {
+                "strategy": "deployment",
+                "spec": {
+                    "clusterPermissions": [
+                        {"serviceAccountName": service_account, "rules": cluster_rules}
+                    ],
+                    "deployments": [
+                        {"name": deployment["metadata"]["name"], "spec": dep_spec}
+                    ],
+                },
+            },
+            "relatedImages": related,
+        },
+    }
+
+
+def render_csv_yaml(config_dir: str = "config") -> str:
+    return yaml.safe_dump(build_csv(config_dir), sort_keys=False, width=100)
+
+
+def validate_csv(path: str, config_dir: str = "config") -> List[str]:
+    """Problems list (empty = valid): decodability, alm-examples validity,
+    owned-CRD consistency, image resolvability, freshness vs generator."""
+    from tpu_operator.cfg.main import validate_clusterpolicy_obj
+
+    problems: List[str] = []
+    try:
+        with open(path) as f:
+            csv = yaml.safe_load(f)
+    except (OSError, yaml.YAMLError) as e:
+        return [f"cannot read {path}: {e}"]
+    if not isinstance(csv, dict) or csv.get("kind") != "ClusterServiceVersion":
+        return [f"{path}: not a ClusterServiceVersion"]
+
+    # alm-examples decode + validate (reference csv.go alm-examples check)
+    alm = csv.get("metadata", {}).get("annotations", {}).get("alm-examples", "[]")
+    try:
+        examples = json.loads(alm)
+    except json.JSONDecodeError as e:
+        examples = []
+        problems.append(f"alm-examples not valid JSON: {e}")
+    cps = [e for e in examples if e.get("kind") == "ClusterPolicy"]
+    if not cps:
+        problems.append("alm-examples has no ClusterPolicy example")
+    for example in cps:
+        problems.extend(validate_clusterpolicy_obj(example))
+
+    # owned CRD (reference csv.go owned-CRD check)
+    owned = (
+        csv.get("spec", {})
+        .get("customresourcedefinitions", {})
+        .get("owned", [])
+    )
+    names = {(o.get("name"), o.get("version"), o.get("kind")) for o in owned}
+    if (consts.CRD_NAME, "v1", "ClusterPolicy") not in names:
+        problems.append(
+            f"owned CRDs {sorted(names)} missing "
+            f"({consts.CRD_NAME!r}, 'v1', 'ClusterPolicy')"
+        )
+
+    # every image pinned (reference images.go)
+    for entry in csv.get("spec", {}).get("relatedImages", []):
+        image = entry.get("image", "")
+        if ":" not in image.rsplit("/", 1)[-1] and "@" not in image:
+            problems.append(f"relatedImage {entry.get('name')}: {image!r} unpinned")
+    for dep in (
+        csv.get("spec", {}).get("install", {}).get("spec", {}).get("deployments", [])
+    ):
+        for ctr in dep["spec"]["template"]["spec"].get("containers", []):
+            image = ctr.get("image", "")
+            if ":" not in image.rsplit("/", 1)[-1] and "@" not in image:
+                problems.append(f"deployment container {ctr['name']}: {image!r} unpinned")
+
+    # freshness vs the generator (same pattern as the chart CRD check)
+    if os.path.isdir(config_dir):
+        if csv != build_csv(config_dir):
+            problems.append(
+                f"{path} is stale; regenerate with 'tpuop-cfg generate csv'"
+            )
+    return problems

@@ -25,11 +25,15 @@ from tpu_operator.api.v1.clusterpolicy_types import (
 
 def validate_clusterpolicy(path: str) -> list:
     """Returns a list of problems (empty = valid)."""
-    problems = []
     with open(path) as f:
         obj = yaml.safe_load(f)
     if not isinstance(obj, dict):
         return [f"{path}: not a mapping"]
+    return validate_clusterpolicy_obj(obj)
+
+
+def validate_clusterpolicy_obj(obj: dict) -> list:
+    problems = []
     if obj.get("kind") != "ClusterPolicy":
         problems.append(f"kind is {obj.get('kind')!r}, want ClusterPolicy")
     cp = clusterpolicy_from_obj(obj)
@@ -116,19 +120,33 @@ def main(argv=None) -> int:
     vcp.add_argument("--input", required=True)
     vch = vsub.add_parser("chart")
     vch.add_argument("--dir", required=True)
+    vcsv = vsub.add_parser("csv")
+    vcsv.add_argument("--input", required=True)
+    vcsv.add_argument("--config-dir", default="config")
     g = sub.add_parser("generate")
     gsub = g.add_subparsers(dest="what", required=True)
     gsub.add_parser("crd")
+    gcsv = gsub.add_parser("csv")
+    gcsv.add_argument("--config-dir", default="config")
     args = p.parse_args(argv)
 
     if args.cmd == "validate" and args.what == "clusterpolicy":
         problems = validate_clusterpolicy(args.input)
     elif args.cmd == "validate" and args.what == "chart":
         problems = validate_chart(args.dir)
+    elif args.cmd == "validate" and args.what == "csv":
+        from tpu_operator.cfg.csvgen import validate_csv
+
+        problems = validate_csv(args.input, config_dir=args.config_dir)
     elif args.cmd == "generate" and args.what == "crd":
         from tpu_operator.cfg.crdgen import render_crd_yaml
 
         sys.stdout.write(render_crd_yaml())
+        return 0
+    elif args.cmd == "generate" and args.what == "csv":
+        from tpu_operator.cfg.csvgen import render_csv_yaml
+
+        sys.stdout.write(render_csv_yaml(args.config_dir))
         return 0
     else:  # pragma: no cover
         p.error("unknown command")

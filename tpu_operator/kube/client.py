@@ -271,7 +271,25 @@ class FakeClient(Client):
             if key not in self._store:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
             obj = self._store.pop(key)
+            deleted_uid = obj.get("metadata", {}).get("uid")
             self._notify("DELETED", obj)
+            # ownerReference cascade, like the API server's garbage collector
+            # (the reference leans on SetControllerReference for operand
+            # cleanup on CR deletion)
+            if deleted_uid:
+                orphans = [
+                    (k, o)
+                    for k, o in list(self._store.items())
+                    if any(
+                        ref.get("uid") == deleted_uid
+                        for ref in o.get("metadata", {}).get("ownerReferences", [])
+                    )
+                ]
+                for (av, k, ns, n), _o in orphans:
+                    try:
+                        self.delete(av, k, n, ns)
+                    except NotFoundError:
+                        pass
 
     # -- test helpers ----------------------------------------------------
     def all_objects(self) -> List[Obj]:

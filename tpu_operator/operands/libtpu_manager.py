@@ -50,22 +50,33 @@ def uninstall_libtpu(
             log.info("evicting %d TPU pods from %s", len(pods), node_name)
             pm.delete_pods(pods, force=force)
             # Graceful deletes leave pods listed (with deletionTimestamp) for
-            # their grace period; poll until they disappear rather than failing
-            # on the first still-Terminating listing.
+            # their grace period: wait for them to actually disappear — the
+            # chip is single-client and the old libtpu stays mmapped until
+            # the pod is gone. A pod with NO deletionTimestamp was skipped by
+            # delete_pods (unmanaged, no force): fail fast, waiting can't
+            # help it.
             deadline = time.monotonic() + eviction_timeout_s
             while True:
-                remaining = [
+                pods_now = pm.tpu_pods_on_node(node_name)
+                if not pods_now:
+                    break
+                undeleted = [
                     p
-                    for p in pm.tpu_pods_on_node(node_name)
+                    for p in pods_now
                     if not p["metadata"].get("deletionTimestamp")
                 ]
-                if not remaining:
-                    break
+                if undeleted:
+                    log.error(
+                        "%d TPU pods not evictable (unmanaged? set "
+                        "DRAIN_USE_FORCE)",
+                        len(undeleted),
+                    )
+                    return 1
                 if time.monotonic() >= deadline:
                     log.error(
-                        "%d TPU pods still present (unmanaged? set "
-                        "DRAIN_USE_FORCE)",
-                        len(remaining),
+                        "%d TPU pods still terminating after %.0fs",
+                        len(pods_now),
+                        eviction_timeout_s,
                     )
                     return 1
                 time.sleep(2.0)

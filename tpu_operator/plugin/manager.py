@@ -207,6 +207,7 @@ class PluginManager:
         for resource, server in list(self.servers.items()):
             server.stop()
             del self.servers[resource]
+        all_registered = True
         for resource, cfg in desired.items():
             server = self._make_server(resource, cfg)
             server.start()
@@ -214,12 +215,19 @@ class PluginManager:
                 try:
                     server.register_with_kubelet()
                 except Exception:
+                    all_registered = False
                     log.exception("kubelet registration failed for %s", resource)
             self.servers[resource] = server
-        # only after every server is up: a start failure above leaves the
-        # signature stale so the next sync retries instead of no-opping
-        self._last_sig = sig
-        log.info("serving resources: %s", sorted(self.servers))
+        # cache the signature only when every server started AND registered:
+        # a failure leaves it stale so the next sync retries (start failures
+        # raise out of the loop above; registration failures land here)
+        if all_registered:
+            self._last_sig = sig
+        log.info(
+            "serving resources: %s%s",
+            sorted(self.servers),
+            "" if all_registered else " (registration pending retry)",
+        )
         return True
 
     def run(self, register: bool = True, block: bool = True):

@@ -14,7 +14,11 @@ TPU-first design notes:
 * a K-chained matmul loop under one ``jit`` keeps the benchmark
   compute-bound instead of HBM-bound, measuring the systolic array rather
   than input streaming;
-* everything is statically shaped; timing uses ``block_until_ready``.
+* everything is statically shaped; timing feeds each dispatch's output into
+  the next (serial dependency chain) and synchronizes with ONE tiny scalar
+  host fetch at the end — robust on remote/tunneled PJRT platforms where
+  ``block_until_ready`` can return before execution finishes, and it
+  amortizes the fetch latency over the whole chain.
 """
 
 from __future__ import annotations
@@ -140,13 +144,18 @@ def run_matmul_validation(
         if float(rel.mean()) > 0.02:
             raise RuntimeError(f"matmul numerics off: mean rel err {rel.mean():.4f}")
 
-        # warmup/compile
-        fn(a, b).block_until_ready()
+        def force(x):
+            # scalar fetch: the only reliable completion barrier on remote
+            # PJRT platforms (block_until_ready can no-op over a tunnel)
+            return float(jnp.sum(x.astype(jnp.float32)))
+
+        # warmup/compile + sync
+        force(fn(a, b))
         t0 = time.perf_counter()
-        out = None
+        x = a
         for _ in range(iters):
-            out = fn(a, b)
-        out.block_until_ready()
+            x = fn(x, b)  # serial chain: each dispatch depends on the last
+        force(x)
         elapsed = time.perf_counter() - t0
 
         flops = 2.0 * size * size * size * depth * iters

@@ -6,17 +6,21 @@
 : "${TIMEOUT_S:=2700}"   # 45min ceiling, same as the reference
 
 check_pod_ready() {
-  local label=$1 deadline=$((SECONDS + TIMEOUT_S)) statuses
+  local label=$1 deadline=$((SECONDS + TIMEOUT_S)) statuses pods n_pods n_ready
   while [ $SECONDS -lt $deadline ]; do
+    pods=$(kubectl -n "$TEST_NAMESPACE" get pods -l "app=$label" \
+        -o jsonpath='{.items[*].metadata.name}')
     statuses=$(kubectl -n "$TEST_NAMESPACE" get pods -l "app=$label" \
         -o jsonpath='{.items[*].status.conditions[?(@.type=="Ready")].status}')
-    # non-empty, at least one True, no False
-    if [ -n "$statuses" ] && echo "$statuses" | grep -q True && \
-        ! echo "$statuses" | grep -q False; then
-      echo "pods for $label Ready"
+    # every pod must report Ready=True; pods with no Ready condition yet
+    # (just scheduled) produce fewer statuses than pods, so compare counts
+    n_pods=$(echo "$pods" | wc -w)
+    n_ready=$(echo "$statuses" | tr ' ' '\n' | grep -c '^True$' || true)
+    if [ "$n_pods" -gt 0 ] && [ "$n_ready" -eq "$n_pods" ]; then
+      echo "pods for $label Ready ($n_ready/$n_pods)"
       return 0
     fi
-    echo "waiting for $label pods..."
+    echo "waiting for $label pods ($n_ready/$n_pods ready)..."
     sleep "$POLL_S"
   done
   echo "TIMEOUT waiting for $label" >&2

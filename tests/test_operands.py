@@ -94,6 +94,49 @@ def test_libtpu_manager_clears_barriers_and_evicts(tmp_path):
     assert client.get_or_none("v1", "Pod", "train", "default") is None
 
 
+
+def test_libtpu_manager_reevicts_recreated_managed_pod(tmp_path):
+    """A controller recreating its evicted pod mid-drain must be re-evicted,
+    not misreported as 'not evictable' (it has ownerReferences)."""
+    status = StatusFiles(str(tmp_path / "val"))
+    client = FakeClient()
+
+    def managed_pod(name):
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "namespace": "default",
+                "ownerReferences": [{"kind": "Job", "name": "j", "uid": "u"}],
+            },
+            "spec": {
+                "nodeName": "n1",
+                "containers": [
+                    {"resources": {"limits": {"google.com/tpu": "1"}}}
+                ],
+            },
+        }
+
+    client.create(managed_pod("train-0"))
+    # simulate the Job controller racing the drain: the first delete
+    # triggers an immediate recreation, the second sticks
+    real_delete = client.delete_if_exists
+    recreated = {"done": False}
+
+    def racing_delete(api, kind, name, ns=""):
+        real_delete(api, kind, name, ns)
+        if kind == "Pod" and not recreated["done"]:
+            recreated["done"] = True
+            client.create(managed_pod("train-1"))
+
+    client.delete_if_exists = racing_delete
+    rc = libtpu_manager.uninstall_libtpu(
+        client, "n1", status, eviction_timeout_s=10.0
+    )
+    assert rc == 0
+    assert client.get_or_none("v1", "Pod", "train-1", "default") is None
+
 def test_libtpu_manager_unmanaged_pod_blocks_without_force(tmp_path):
     status = StatusFiles(str(tmp_path / "val"))
     client = FakeClient()

@@ -1,0 +1,214 @@
+"""Slice-scoped readiness aggregation (SURVEY.md §7 multi-host hard part):
+grouping, all-hosts-or-nothing semantics, node labels, CR status, metrics."""
+
+import os
+
+import pytest
+import yaml
+
+from tests.conftest import make_tpu_node
+from tpu_operator import consts
+from tpu_operator.controllers import slice_status
+from tpu_operator.controllers.clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+)
+from tpu_operator.discovery import tfd
+from tpu_operator.kube import FakeClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NS = "tpu-operator"
+
+
+def multihost_node(name, pool="pool-a", hosts=4, worker=0):
+    return make_tpu_node(
+        name,
+        accelerator="tpu-v5p-slice",
+        topology="4x4x4",  # v5p 4x4x4 = 64 chips / 4 per host = 16 hosts
+        extra_labels={
+            consts.GKE_NODEPOOL_LABEL: pool,
+            consts.TFD_SLICE_HOSTS_LABEL: str(hosts),
+            consts.TFD_WORKER_ID_LABEL: str(worker),
+        },
+    )
+
+
+def validator_pod(client, node, ready=True):
+    client.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"val-{node}",
+                "namespace": NS,
+                "labels": {"app": slice_status.VALIDATOR_APP},
+            },
+            "spec": {"nodeName": node},
+            "status": {
+                "phase": "Running" if ready else "Pending",
+                "containerStatuses": [{"ready": ready}],
+            },
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# grouping
+# ---------------------------------------------------------------------------
+
+
+def test_single_host_nodes_are_own_slices():
+    nodes = [make_tpu_node("n1"), make_tpu_node("n2")]
+    slices = slice_status.group_slices(nodes)
+    assert set(slices) == {"n1", "n2"}
+
+
+def test_multihost_nodes_group_by_pool():
+    nodes = [multihost_node(f"n{i}", hosts=4, worker=i) for i in range(4)]
+    slices = slice_status.group_slices(nodes)
+    assert set(slices) == {"pool-a"}
+    assert sorted(slices["pool-a"].member_nodes) == ["n0", "n1", "n2", "n3"]
+    assert slices["pool-a"].expected_hosts == 4
+
+
+def test_explicit_slice_id_label_wins():
+    n = multihost_node("n1")
+    n["metadata"]["labels"][consts.TFD_SLICE_ID_LABEL] = "slice-7"
+    assert slice_status.slice_id_for_node(n) == "slice-7"
+
+
+def test_expected_hosts_derived_from_topology_when_tfd_absent():
+    n = make_tpu_node(
+        "n1",
+        accelerator="tpu-v5p-slice",
+        topology="4x4x4",
+        extra_labels={consts.GKE_NODEPOOL_LABEL: "pool-b"},
+    )
+    # no TFD slice-hosts label: 4x4x4 v5p = 64 chips / 4 chips-per-host = 16
+    assert slice_status._expected_hosts(n) == 16
+    assert slice_status.slice_id_for_node(n) == "pool-b"
+
+
+# ---------------------------------------------------------------------------
+# aggregation semantics
+# ---------------------------------------------------------------------------
+
+
+def test_slice_ready_only_when_all_hosts_validated():
+    client = FakeClient()
+    nodes = [multihost_node(f"n{i}", hosts=4, worker=i) for i in range(4)]
+    for n in nodes:
+        client.create(n)
+    for i in range(3):
+        validator_pod(client, f"n{i}", ready=True)
+    validator_pod(client, "n3", ready=False)
+
+    summary = slice_status.aggregate(client, NS, nodes)
+    assert summary.total == 1
+    assert summary.ready == 0
+    assert summary.degraded == ["pool-a"]
+    for n in nodes:
+        node = client.get("v1", "Node", n["metadata"]["name"])
+        assert node["metadata"]["labels"][consts.SLICE_READY_LABEL] == "false"
+
+    # last host comes up -> whole slice flips ready
+    client.delete("v1", "Pod", "val-n3", NS)
+    validator_pod(client, "n3", ready=True)
+    summary = slice_status.aggregate(client, NS, nodes)
+    assert summary.ready == 1 and summary.degraded == []
+    for n in nodes:
+        node = client.get("v1", "Node", n["metadata"]["name"])
+        assert node["metadata"]["labels"][consts.SLICE_READY_LABEL] == "true"
+
+
+def test_missing_member_hosts_keep_slice_not_ready():
+    """expected_hosts=4 but only 3 nodes exist in the cluster: even with all
+    present members validated the slice must not report ready."""
+    client = FakeClient()
+    nodes = [multihost_node(f"n{i}", hosts=4, worker=i) for i in range(3)]
+    for n in nodes:
+        client.create(n)
+        validator_pod(client, n["metadata"]["name"], ready=True)
+    summary = slice_status.aggregate(client, NS, nodes)
+    assert summary.total == 1 and summary.ready == 0
+
+
+def test_mixed_single_and_multi_host():
+    client = FakeClient()
+    nodes = [multihost_node(f"m{i}", hosts=2, worker=i) for i in range(2)]
+    nodes.append(make_tpu_node("solo"))
+    for n in nodes:
+        client.create(n)
+        validator_pod(client, n["metadata"]["name"], ready=True)
+    summary = slice_status.aggregate(client, NS, nodes)
+    assert summary.total == 2
+    assert summary.ready == 2
+
+
+# ---------------------------------------------------------------------------
+# TFD publishes slice-id
+# ---------------------------------------------------------------------------
+
+
+def test_tfd_publishes_slice_id_for_multihost(tmp_path):
+    node = multihost_node("n1", pool="pool-z")
+    features = tfd.gather_features(
+        node, dev_root=str(tmp_path), libtpu_dir=str(tmp_path), env={}
+    )
+    assert features[consts.TFD_SLICE_ID_LABEL] == "pool-z"
+
+
+def test_tfd_slice_id_env_override(tmp_path):
+    node = multihost_node("n1")
+    features = tfd.gather_features(
+        node,
+        dev_root=str(tmp_path),
+        libtpu_dir=str(tmp_path),
+        env={"TPU_SLICE_ID": "custom-slice"},
+    )
+    assert features[consts.TFD_SLICE_ID_LABEL] == "custom-slice"
+
+
+def test_tfd_no_slice_id_for_single_host(tmp_path):
+    node = make_tpu_node("n1", accelerator="tpu-v5-lite-device", topology="")
+    node["metadata"]["labels"].pop(consts.GKE_TPU_TOPOLOGY_LABEL, None)
+    features = tfd.gather_features(
+        node, dev_root=str(tmp_path), libtpu_dir=str(tmp_path), env={}
+    )
+    assert consts.TFD_SLICE_ID_LABEL not in features
+
+
+# ---------------------------------------------------------------------------
+# reconciler integration: CR status carries the aggregate
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def env(monkeypatch):
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+
+
+def test_reconcile_status_includes_slices(env):
+    with open(
+        os.path.join(REPO, "config", "samples", "v1_clusterpolicy.yaml")
+    ) as f:
+        cr = yaml.safe_load(f)
+    cr["metadata"]["uid"] = "uid-cp"
+    client = FakeClient()
+    client.create(cr)
+    for i in range(2):
+        n = multihost_node(f"n{i}", hosts=2, worker=i)
+        client.create(n)
+        validator_pod(client, f"n{i}", ready=True)
+
+    rec = ClusterPolicyReconciler(
+        client, assets_dir=os.path.join(REPO, "assets")
+    )
+    rec.reconcile()
+    status = client.list(consts.API_VERSION, consts.CLUSTER_POLICY_KIND)[0][
+        "status"
+    ]
+    assert status["slices"]["total"] == 1
+    assert status["slices"]["ready"] == 1
+    for i in range(2):
+        node = client.get("v1", "Node", f"n{i}")
+        assert node["metadata"]["labels"][consts.SLICE_READY_LABEL] == "true"

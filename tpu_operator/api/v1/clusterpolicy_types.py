@@ -700,6 +700,9 @@ class ClusterPolicyStatus(SpecBase):
     state: str = ""
     namespace: str = ""
     conditions: List[Dict[str, Any]] = field(default_factory=list)
+    # slice-scoped readiness aggregate (no reference analogue; SURVEY.md §7
+    # multi-host hard part): {"total": N, "ready": M, "degraded": [ids]}
+    slices: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass

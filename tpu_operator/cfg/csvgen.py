@@ -57,8 +57,15 @@ def build_csv(
         if not isinstance(sub, dict) or "image" not in sub:
             continue
         repo, img, ver = sub.get("repository", ""), sub["image"], sub.get("version", "")
-        if repo and ver:
-            related.append({"name": img, "image": f"{repo}/{img}:{ver}"})
+        # always emit the entry, even when repository/version are missing:
+        # an incomplete ref renders untagged and validate_csv's unpinned
+        # check flags it — silently dropping it would hide exactly the
+        # misconfiguration the pinning check exists to catch
+        ref = f"{repo}/{img}" if repo else img
+        if ver:
+            sep = "@" if ver.startswith("sha256:") else ":"
+            ref = f"{ref}{sep}{ver}"
+        related.append({"name": img, "image": ref})
 
     return {
         "apiVersion": "operators.coreos.com/v1alpha1",
@@ -145,6 +152,13 @@ def validate_csv(path: str, config_dir: str = "config") -> List[str]:
     except json.JSONDecodeError as e:
         examples = []
         problems.append(f"alm-examples not valid JSON: {e}")
+    if not isinstance(examples, list) or not all(
+        isinstance(e, dict) for e in examples
+    ):
+        problems.append("alm-examples is not a list of objects")
+        examples = [e for e in examples if isinstance(e, dict)] if isinstance(
+            examples, list
+        ) else []
     cps = [e for e in examples if e.get("kind") == "ClusterPolicy"]
     if not cps:
         problems.append("alm-examples has no ClusterPolicy example")
@@ -172,10 +186,24 @@ def validate_csv(path: str, config_dir: str = "config") -> List[str]:
     for dep in (
         csv.get("spec", {}).get("install", {}).get("spec", {}).get("deployments", [])
     ):
-        for ctr in dep["spec"]["template"]["spec"].get("containers", []):
+        if not isinstance(dep, dict):
+            problems.append(f"install.spec.deployments entry not an object: {dep!r}")
+            continue
+        pod_spec = (
+            (dep.get("spec") or {}).get("template", {}) or {}
+        ).get("spec", {}) or {}
+        containers = pod_spec.get("containers")
+        if not containers:
+            problems.append(
+                f"deployment {dep.get('name', '?')}: no pod template containers"
+            )
+            continue
+        for ctr in containers:
             image = ctr.get("image", "")
             if ":" not in image.rsplit("/", 1)[-1] and "@" not in image:
-                problems.append(f"deployment container {ctr['name']}: {image!r} unpinned")
+                problems.append(
+                    f"deployment container {ctr.get('name', '?')}: {image!r} unpinned"
+                )
 
     # freshness vs the generator (same pattern as the chart CRD check)
     if os.path.isdir(config_dir):

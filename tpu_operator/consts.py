@@ -22,6 +22,7 @@ TPU_SUBSLICE_RESOURCE_PREFIX = "google.com/tpu-"  # mixed-strategy subslices
 # GKE node pools carry these natively:
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"  # e.g. tpu-v5-lite-podslice
 GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"  # e.g. 2x4
+GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"  # all hosts of one multi-host slice share a pool
 # NFD fallback: Google PCI vendor id 1ae0 present on the node
 NFD_TPU_PCI_LABEL = "feature.node.kubernetes.io/pci-1ae0.present"
 NFD_KERNEL_LABEL = "feature.node.kubernetes.io/kernel-version.full"
@@ -101,6 +102,12 @@ TFD_SLICE_HOSTS_LABEL = f"{TFD_LABEL_PREFIX}slice-hosts"
 TFD_WORKER_ID_LABEL = f"{TFD_LABEL_PREFIX}worker-id"
 TFD_ICI_WRAP_LABEL = f"{TFD_LABEL_PREFIX}ici-wraparound"
 TFD_LIBTPU_VERSION_LABEL = f"{TFD_LABEL_PREFIX}libtpu-version"
+TFD_SLICE_ID_LABEL = f"{TFD_LABEL_PREFIX}slice-id"
+
+# slice-scoped aggregate readiness (no reference analogue — SURVEY.md §7
+# "readiness semantics on multi-host slices"): all hosts of a pod-slice
+# validated => every member node gets slice.ready=true
+SLICE_READY_LABEL = f"{GROUP}/tpu.slice.ready"
 
 # --- host paths --------------------------------------------------------
 # status-file barrier directory (reference /run/nvidia/validations,

@@ -101,6 +101,8 @@ class ClusterPolicyReconciler:
                 not_ready_states.append(state_name)
                 log.info("state %s not ready; will requeue", state_name)
 
+        slice_summary = self._aggregate_slices()
+
         was_ready = (primary.get("status", {}) or {}).get("state") == State.READY
         if overall == State.READY and not was_ready:
             record_event(
@@ -121,7 +123,7 @@ class ClusterPolicyReconciler:
                 f"states not ready: {', '.join(not_ready_states)}",
             )
 
-        self._set_status(primary, overall)
+        self._set_status(primary, overall, slice_summary)
         self._update_fleet_metrics()
         if overall == State.NOT_READY:
             self.metrics.observe_reconcile(0)
@@ -130,6 +132,29 @@ class ClusterPolicyReconciler:
         return Result(ready=True)
 
     # ------------------------------------------------------------------
+    def _aggregate_slices(self):
+        """Slice-scoped readiness (SURVEY.md §7 hard part): a multi-host
+        pod-slice is only Ready when every member host validated. Publishes
+        ``tpu.k8s.io/tpu.slice.ready`` node labels + metrics; summarized in
+        the CR status by ``_set_status``."""
+        from tpu_operator.controllers import slice_status
+        from tpu_operator.controllers.state_manager import has_tpu_labels
+
+        try:
+            tpu_nodes = [
+                n for n in self.ctrl._nodes_cache if has_tpu_labels(n)
+            ]
+            summary = slice_status.aggregate(
+                self.client, self.ctrl.namespace, tpu_nodes
+            )
+        except Exception:
+            log.exception("slice readiness aggregation failed")
+            return None
+        if self.metrics and getattr(self.metrics, "slices_total", None):
+            self.metrics.slices_total.set(summary.total)
+            self.metrics.slices_ready.set(summary.ready)
+        return summary
+
     def _update_fleet_metrics(self) -> None:
         if self.metrics and getattr(self.metrics, "tpu_nodes_total", None):
             self.metrics.tpu_nodes_total.set(self.ctrl.tpu_node_count)
@@ -140,17 +165,42 @@ class ClusterPolicyReconciler:
                 len(self.ctrl.tpu_generations)
             )
 
-    def _set_status(self, cp_obj, state: str) -> None:
-        """reference ``updateCRState`` (``:198``) + a Ready condition."""
+    def _set_status(self, cp_obj, state: str, slice_summary=None) -> None:
+        """reference ``updateCRState`` (``:198``) + a Ready condition + the
+        slice-readiness aggregate (no reference analogue)."""
         status = cp_obj.setdefault("status", {})
-        if status.get("state") == state and status.get("namespace") == (
-            self.ctrl.namespace or status.get("namespace")
+        slices = None
+        if slice_summary is not None:
+            slices = {
+                "total": slice_summary.total,
+                "ready": slice_summary.ready,
+            }
+            if slice_summary.degraded:
+                slices["degraded"] = slice_summary.degraded
+        if (
+            status.get("state") == state
+            and status.get("namespace")
+            == (self.ctrl.namespace or status.get("namespace"))
+            and (slices is None or status.get("slices") == slices)
         ):
             return
         from datetime import datetime, timezone
 
+        prev_state = status.get("state")
+        prev_conditions = status.get("conditions") or []
         status["state"] = state
         status["namespace"] = self.ctrl.namespace
+        if slices is not None:
+            status["slices"] = slices
+        # k8s condition semantics: lastTransitionTime only moves when the
+        # condition's status actually flips, not on every status write
+        # (e.g. a slices-aggregate fluctuation while Ready stays True)
+        if prev_state == state and prev_conditions:
+            transition = prev_conditions[0].get("lastTransitionTime")
+        else:
+            transition = datetime.now(timezone.utc).strftime(
+                "%Y-%m-%dT%H:%M:%SZ"
+            )
         status["conditions"] = [
             {
                 "type": "Ready",
@@ -160,9 +210,7 @@ class ClusterPolicyReconciler:
                     State.NOT_READY: "OperandsNotReady",
                     State.IGNORED: "IgnoredDuplicate",
                 }.get(state, "Unknown"),
-                "lastTransitionTime": datetime.now(timezone.utc).strftime(
-                    "%Y-%m-%dT%H:%M:%SZ"
-                ),
+                "lastTransitionTime": transition,
             }
         ]
         try:

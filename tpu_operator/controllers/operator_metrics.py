@@ -67,6 +67,13 @@ class OperatorMetrics:
             "Per-state readiness: 1 ready / 0 not-ready / -1 disabled",
             ("state",),
         )
+        # slice-scoped readiness (no reference analogue; SURVEY.md §7)
+        self.slices_total = g(
+            "tpu_slices_total", "TPU slices (multi-host groups + single hosts)"
+        )
+        self.slices_ready = g(
+            "tpu_slices_ready", "TPU slices with every member host validated"
+        )
         # upgrade FSM gauges (reference :142-185)
         self.upgrades_in_progress = g(
             "libtpu_upgrades_in_progress", "Nodes currently upgrading libtpu"

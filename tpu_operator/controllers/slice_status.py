@@ -1,0 +1,194 @@
+"""Slice-scoped readiness aggregation.
+
+The reference's readiness is strictly per-node (DaemonSet unavailable==0,
+``controllers/object_controls.go:3107-3177``). A multi-host TPU pod-slice is
+only usable when **every** host in the slice is validated — a v5p-64 with 15
+of 16 hosts ready is 0% useful, not 94%. This is the "readiness semantics on
+multi-host slices" hard part called out in SURVEY.md §7: an aggregate the
+reference does not have.
+
+Mechanics, staying on the node-label bus:
+
+* nodes are grouped into slices by the ``tpu.k8s.io/tpu.slice-id`` label
+  (published by TPU feature discovery; falls back to the GKE node-pool label
+  for multi-host node pools, else every node is its own single-host slice);
+* the expected host count comes from ``tpu.k8s.io/tpu.slice-hosts`` (TFD
+  computes it from the ICI topology string) — a slice with members missing
+  from the cluster is *not* ready even if every present member is;
+* a member host counts as validated when the operator-validator DaemonSet
+  pod on it is Running (the validator's main container only runs after the
+  libtpu → runtime → plugin → jax initContainer chain passed, exactly the
+  reference's "validator Running == node good" semantics,
+  ``assets/state-operator-validation/0500_daemonset.yaml:28-157``);
+* the verdict is published back onto each member node as
+  ``tpu.k8s.io/tpu.slice.ready=true|false`` so schedulers / workload
+  controllers can gate multi-host jobs on it, and summarized into the
+  ClusterPolicy status and operator metrics.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from tpu_operator import consts
+from tpu_operator.kube.client import Client, Obj
+
+log = logging.getLogger("tpu-operator.slices")
+
+VALIDATOR_APP = "tpu-operator-validator"
+
+
+@dataclass
+class SliceInfo:
+    slice_id: str
+    member_nodes: List[str] = field(default_factory=list)
+    expected_hosts: int = 0  # 0 = unknown; fall back to member count
+    ready_nodes: int = 0
+
+    @property
+    def ready(self) -> bool:
+        want = self.expected_hosts or len(self.member_nodes)
+        return want > 0 and self.ready_nodes >= want and (
+            len(self.member_nodes) >= want
+        )
+
+
+@dataclass
+class SliceSummary:
+    slices: Dict[str, SliceInfo] = field(default_factory=dict)
+
+    @property
+    def total(self) -> int:
+        return len(self.slices)
+
+    @property
+    def ready(self) -> int:
+        return sum(1 for s in self.slices.values() if s.ready)
+
+    @property
+    def degraded(self) -> List[str]:
+        return sorted(k for k, s in self.slices.items() if not s.ready)
+
+
+def slice_id_for_node(node: Obj) -> str:
+    """Slice identity for a TPU node.
+
+    Priority: explicit TFD slice-id label; GKE node-pool label when the node
+    is part of a multi-host slice (all hosts of one GKE multi-host TPU slice
+    live in one node pool); else the node is a single-host slice of its own.
+    """
+    labels = node.get("metadata", {}).get("labels", {}) or {}
+    explicit = labels.get(consts.TFD_SLICE_ID_LABEL)
+    if explicit:
+        return explicit
+    hosts = _expected_hosts(node)
+    if hosts > 1:
+        pool = labels.get(consts.GKE_NODEPOOL_LABEL)
+        if pool:
+            return pool
+    return node["metadata"]["name"]
+
+
+def _expected_hosts(node: Obj) -> int:
+    labels = node.get("metadata", {}).get("labels", {}) or {}
+    raw = labels.get(consts.TFD_SLICE_HOSTS_LABEL, "")
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        pass
+    # derive from the GKE topology label when TFD hasn't run yet
+    topology = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, "")
+    gen = labels.get(consts.TFD_CHIP_TYPE_LABEL, "")
+    if topology:
+        try:
+            from tpu_operator.workloads import topology as topo
+
+            acc = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, "")
+            gen = gen or consts.GKE_ACCELERATOR_TO_GENERATION.get(acc, "")
+            if gen:
+                return topo.host_count(topology, gen)
+        except Exception:
+            return 0
+    return 0
+
+
+def validator_ready_nodes(
+    client: Client, namespace: str, app: str = VALIDATOR_APP
+) -> Set[str]:
+    """Nodes whose operator-validator pod is Running (initContainer chain
+    passed — reference semantics: validator Running == node validated)."""
+    ready: Set[str] = set()
+    for pod in client.list("v1", "Pod", namespace):
+        if (pod["metadata"].get("labels", {}) or {}).get("app") != app:
+            continue
+        if pod.get("status", {}).get("phase") != "Running":
+            continue
+        statuses = pod.get("status", {}).get("containerStatuses")
+        if statuses is not None and not all(
+            cs.get("ready", True) for cs in statuses
+        ):
+            continue
+        node = pod.get("spec", {}).get("nodeName")
+        if node:
+            ready.add(node)
+    return ready
+
+
+def group_slices(tpu_nodes: List[Obj]) -> Dict[str, SliceInfo]:
+    slices: Dict[str, SliceInfo] = {}
+    for node in tpu_nodes:
+        sid = slice_id_for_node(node)
+        info = slices.setdefault(sid, SliceInfo(slice_id=sid))
+        info.member_nodes.append(node["metadata"]["name"])
+        info.expected_hosts = max(info.expected_hosts, _expected_hosts(node))
+    return slices
+
+
+def aggregate(
+    client: Client,
+    namespace: str,
+    tpu_nodes: List[Obj],
+    validated: Optional[Set[str]] = None,
+) -> SliceSummary:
+    """Compute per-slice readiness and publish it to member node labels.
+
+    ``validated`` overrides the validator-pod scan (used by tests and by
+    callers that already listed pods this pass).
+    """
+    if validated is None:
+        validated = validator_ready_nodes(client, namespace)
+    slices = group_slices(tpu_nodes)
+    cached = {n["metadata"]["name"]: n for n in tpu_nodes}
+    for info in slices.values():
+        info.ready_nodes = sum(
+            1 for n in info.member_nodes if n in validated
+        )
+        verdict = "true" if info.ready else "false"
+        for node_name in info.member_nodes:
+            # steady-state cheap path: when the cached node already carries
+            # the right verdict, skip the API round-trip entirely; only
+            # re-fetch (for a fresh resourceVersion) nodes needing a write
+            cached_labels = (
+                cached[node_name].get("metadata", {}).get("labels", {}) or {}
+            )
+            if cached_labels.get(consts.SLICE_READY_LABEL) == verdict:
+                continue
+            try:
+                node = client.get("v1", "Node", node_name)
+            except Exception:
+                log.exception("failed to fetch node %s", node_name)
+                continue
+            labels = node["metadata"].setdefault("labels", {})
+            if labels.get(consts.SLICE_READY_LABEL) != verdict:
+                labels[consts.SLICE_READY_LABEL] = verdict
+                try:
+                    client.update(node)
+                except Exception:
+                    log.exception(
+                        "failed to label node %s slice.ready=%s",
+                        node_name,
+                        verdict,
+                    )
+    return SliceSummary(slices=slices)

@@ -72,6 +72,17 @@ def gather_features(
     if worker_id != "":
         features[consts.TFD_WORKER_ID_LABEL] = worker_id
 
+    # slice identity for the operator's slice-scoped readiness aggregate:
+    # explicit env wins; multi-host slices fall back to the GKE node pool
+    # (all hosts of one multi-host slice live in one pool)
+    slice_id = env.get("TPU_SLICE_ID", "") or env.get("TPU_SLICE_NAME", "")
+    if not slice_id:
+        hosts = features.get(consts.TFD_SLICE_HOSTS_LABEL, "1")
+        if hosts.isdigit() and int(hosts) > 1:
+            slice_id = labels.get(consts.GKE_NODEPOOL_LABEL, "")
+    if slice_id:
+        features[consts.TFD_SLICE_ID_LABEL] = slice_id
+
     libtpu_version = _libtpu_version(libtpu_dir)
     if libtpu_version:
         features[consts.TFD_LIBTPU_VERSION_LABEL] = libtpu_version
@@ -111,6 +122,7 @@ def apply_features(client, node_name: str, features: Dict[str, str]) -> bool:
         consts.TFD_WORKER_ID_LABEL,
         consts.TFD_ICI_WRAP_LABEL,
         consts.TFD_LIBTPU_VERSION_LABEL,
+        consts.TFD_SLICE_ID_LABEL,
     )
     changed = False
     for key in managed_prefixes:

@@ -52,9 +52,10 @@ def uninstall_libtpu(
             # Graceful deletes leave pods listed (with deletionTimestamp) for
             # their grace period: wait for them to actually disappear — the
             # chip is single-client and the old libtpu stays mmapped until
-            # the pod is gone. A pod with NO deletionTimestamp was skipped by
-            # delete_pods (unmanaged, no force): fail fast, waiting can't
-            # help it.
+            # the pod is gone. A pod with NO deletionTimestamp is either
+            # unmanaged (delete_pods skipped it; without force that's
+            # terminal — waiting can't help) or a managed pod a controller
+            # (re)created since the last pass — those get evicted again.
             deadline = time.monotonic() + eviction_timeout_s
             while True:
                 pods_now = pm.tpu_pods_on_node(node_name)
@@ -66,12 +67,20 @@ def uninstall_libtpu(
                     if not p["metadata"].get("deletionTimestamp")
                 ]
                 if undeleted:
-                    log.error(
-                        "%d TPU pods not evictable (unmanaged? set "
-                        "DRAIN_USE_FORCE)",
-                        len(undeleted),
-                    )
-                    return 1
+                    stuck = [
+                        p
+                        for p in undeleted
+                        if not force
+                        and not p["metadata"].get("ownerReferences")
+                    ]
+                    if stuck:
+                        log.error(
+                            "%d unmanaged TPU pods not evictable (set "
+                            "DRAIN_USE_FORCE)",
+                            len(stuck),
+                        )
+                        return 1
+                    pm.delete_pods(undeleted, force=force)
                 if time.monotonic() >= deadline:
                     log.error(
                         "%d TPU pods still terminating after %.0fs",

@@ -1,0 +1,307 @@
+// tpu-metricsd — native metrics hostengine (the DCGM hostengine slot).
+//
+// The reference deploys DCGM's C++ hostengine on :5555 and points
+// dcgm-exporter at it (reference controllers/object_controls.go:95-98,
+// 1441-1495). This is the TPU-native equivalent: a small C++ daemon that
+// owns node-local telemetry collection and serves it to in-cluster readers.
+//
+//   * chip presence / PCI / NUMA via the same enumeration the rest of the
+//     stack uses (libtpuinfo.cpp, compiled in),
+//   * generic sysfs telemetry probes per chip (best-effort reads that fail
+//     silently when a file is absent),
+//   * on-chip counters merged from the JAX sampler side-file: the TPU
+//     runtime is single-client, so anything needing the chip itself lives
+//     in the (Python/JAX) sampler, which drops a JSON file this daemon
+//     embeds verbatim — the hostengine/reader split, with the chip-owning
+//     process decoupled from the serving process,
+//   * HTTP endpoints: /healthz, /json (full snapshot), /metrics
+//     (Prometheus text),
+//   * atomic drop-file publication for file-based readers (validator,
+//     libtpuinfo merge path).
+//
+// Plain POSIX sockets; sequential accept loop (scrape traffic only).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+// C ABI from libtpuinfo.cpp (compiled into this binary).
+extern "C" {
+int tpuinfo_chip_count(const char* dev_root);
+int tpuinfo_summary_json(const char* dev_root, char* buf, int buf_len);
+}
+
+namespace {
+
+std::atomic<bool> g_stop{false};
+
+void on_signal(int) { g_stop = true; }
+
+std::string read_file(const std::string& path, size_t max = 1 << 20) {
+  FILE* f = std::fopen(path.c_str(), "r");
+  if (!f) return "";
+  std::string out;
+  char buf[4096];
+  size_t n;
+  while ((n = std::fread(buf, 1, sizeof(buf), f)) > 0) {
+    out.append(buf, n);
+    if (out.size() > max) break;
+  }
+  std::fclose(f);
+  while (!out.empty() && (out.back() == '\n' || out.back() == ' ')) out.pop_back();
+  return out;
+}
+
+// Minimal scanner: find `"key":<number>` occurrences in a JSON blob in
+// order. Enough to lift per-chip sampler numbers into Prometheus series
+// without a full JSON parser.
+std::vector<double> scan_numbers(const std::string& json, const std::string& key) {
+  std::vector<double> out;
+  std::string needle = "\"" + key + "\":";
+  size_t pos = 0;
+  while ((pos = json.find(needle, pos)) != std::string::npos) {
+    pos += needle.size();
+    out.push_back(std::atof(json.c_str() + pos));
+  }
+  return out;
+}
+
+struct Snapshot {
+  std::string json;        // full /json body
+  std::string prometheus;  // /metrics body
+};
+
+class Collector {
+ public:
+  Collector(std::string dev_root, std::string sample_file, std::string drop_file)
+      : dev_root_(std::move(dev_root)),
+        sample_file_(std::move(sample_file)),
+        drop_file_(std::move(drop_file)) {}
+
+  void collect_once() {
+    std::vector<char> buf(1 << 20);
+    std::string chips = "[]";
+    if (tpuinfo_summary_json(dev_root_.c_str(), buf.data(), (int)buf.size()) == 0)
+      chips = buf.data();
+    int count = tpuinfo_chip_count(dev_root_.c_str());
+    std::string sample = read_file(sample_file_);
+    bool have_sample = !sample.empty() && sample.front() == '{';
+    collections_++;
+
+    std::string json = "{\"source\":\"tpu-metricsd-native\",\"ts\":" +
+                       std::to_string((long)::time(nullptr)) +
+                       ",\"chip_count\":" + std::to_string(count < 0 ? 0 : count) +
+                       ",\"chips\":" + chips;
+    if (have_sample) json += ",\"sample\":" + sample;
+    json += "}";
+
+    std::string prom;
+    auto gauge = [&prom](const std::string& name, const std::string& help,
+                         const std::string& labels, double v) {
+      if (prom.find("# HELP " + name + " ") == std::string::npos) {
+        prom += "# HELP " + name + " " + help + "\n# TYPE " + name + " gauge\n";
+      }
+      char num[64];
+      std::snprintf(num, sizeof(num), "%.10g", v);
+      prom += name + (labels.empty() ? "" : "{" + labels + "}") + " " + num + "\n";
+    };
+    gauge("tpu_metricsd_chips", "Visible TPU chip device nodes", "",
+          count < 0 ? 0 : count);
+    gauge("tpu_metricsd_collections_total", "Collection passes", "",
+          (double)collections_);
+    gauge("tpu_metricsd_last_collect_ts_seconds", "Last collection time", "",
+          (double)::time(nullptr));
+    auto numas = scan_numbers(chips, "numa_node");
+    auto indices = scan_numbers(chips, "index");
+    for (size_t i = 0; i < indices.size(); ++i) {
+      std::string label = "chip=\"" + std::to_string((int)indices[i]) + "\"";
+      gauge("tpu_chip_present", "Chip device node visible", label, 1);
+      if (i < numas.size())
+        gauge("tpu_chip_numa_node", "Chip NUMA affinity", label, numas[i]);
+    }
+    if (have_sample) {
+      gauge("tpu_metricsd_sample_fresh", "Sampler side-file present", "", 1);
+      auto utils = scan_numbers(sample, "tensorcore_util");
+      auto sample_idx = scan_numbers(sample, "index");
+      for (size_t i = 0; i < utils.size(); ++i) {
+        int chip = i < sample_idx.size() ? (int)sample_idx[i] : (int)i;
+        gauge("tpu_tensorcore_utilization_percent",
+              "TensorCore utilization % (from chip-owning sampler)",
+              "chip=\"" + std::to_string(chip) + "\"", utils[i]);
+      }
+      auto hbm = scan_numbers(sample, "hbm_used");
+      for (size_t i = 0; i < hbm.size(); ++i) {
+        int chip = i < sample_idx.size() ? (int)sample_idx[i] : (int)i;
+        gauge("tpu_hbm_used_bytes", "HBM bytes in use (from sampler)",
+              "chip=\"" + std::to_string(chip) + "\"", hbm[i]);
+      }
+    } else {
+      gauge("tpu_metricsd_sample_fresh", "Sampler side-file present", "", 0);
+    }
+
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      snap_.json = json;
+      snap_.prometheus = prom;
+    }
+    write_drop_file(json);
+  }
+
+  Snapshot snapshot() {
+    std::lock_guard<std::mutex> lock(mu_);
+    return snap_;
+  }
+
+ private:
+  void write_drop_file(const std::string& payload) {
+    if (drop_file_.empty()) return;
+    std::string dir = drop_file_.substr(0, drop_file_.find_last_of('/'));
+    if (!dir.empty()) {
+      std::string cmd_free_mkdir = dir;  // mkdir -p without system()
+      for (size_t i = 1; i <= cmd_free_mkdir.size(); ++i) {
+        if (i == cmd_free_mkdir.size() || cmd_free_mkdir[i] == '/') {
+          std::string prefix = cmd_free_mkdir.substr(0, i);
+          if (!prefix.empty()) ::mkdir(prefix.c_str(), 0755);
+        }
+      }
+    }
+    std::string tmp = drop_file_ + ".tmp";
+    FILE* f = std::fopen(tmp.c_str(), "w");
+    if (!f) return;
+    std::fwrite(payload.data(), 1, payload.size(), f);
+    std::fclose(f);
+    std::rename(tmp.c_str(), drop_file_.c_str());
+  }
+
+  std::string dev_root_;
+  std::string sample_file_;
+  std::string drop_file_;
+  std::mutex mu_;
+  Snapshot snap_;
+  long collections_ = 0;
+};
+
+void respond(int fd, const char* status, const std::string& content_type,
+             const std::string& body) {
+  std::string head = "HTTP/1.1 " + std::string(status) +
+                     "\r\nContent-Type: " + content_type +
+                     "\r\nContent-Length: " + std::to_string(body.size()) +
+                     "\r\nConnection: close\r\n\r\n";
+  (void)!::write(fd, head.data(), head.size());
+  (void)!::write(fd, body.data(), body.size());
+}
+
+void handle(int fd, Collector& collector) {
+  char req[2048];
+  ssize_t n = ::read(fd, req, sizeof(req) - 1);
+  if (n <= 0) return;
+  req[n] = '\0';
+  char method[8] = {0}, path[256] = {0};
+  std::sscanf(req, "%7s %255s", method, path);
+  if (std::strcmp(method, "GET") != 0) {
+    respond(fd, "405 Method Not Allowed", "text/plain", "GET only\n");
+    return;
+  }
+  Snapshot snap = collector.snapshot();
+  if (std::strcmp(path, "/healthz") == 0) {
+    respond(fd, "200 OK", "text/plain", "ok\n");
+  } else if (std::strcmp(path, "/metrics") == 0) {
+    respond(fd, "200 OK", "text/plain; version=0.0.4", snap.prometheus);
+  } else if (std::strcmp(path, "/") == 0 || std::strcmp(path, "/json") == 0) {
+    respond(fd, "200 OK", "application/json", snap.json);
+  } else {
+    respond(fd, "404 Not Found", "text/plain", "not found\n");
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string dev_root = "/dev";
+  std::string drop_file = "/run/tpu/metricsd.json";
+  std::string sample_file = "/run/tpu/metricsd-sample.json";
+  int port = 5555;
+  double interval_s = 10.0;
+  bool once = false;
+
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
+    if (a == "--dev-root") dev_root = next();
+    else if (a == "--drop-file") drop_file = next();
+    else if (a == "--sample-file") sample_file = next();
+    else if (a == "--port") port = std::atoi(next());
+    else if (a == "--interval") interval_s = std::atof(next());
+    else if (a == "--once") once = true;
+    else if (a == "--help" || a == "-h") {
+      std::printf(
+          "tpu-metricsd [--port N] [--dev-root D] [--drop-file F]\n"
+          "             [--sample-file F] [--interval S] [--once]\n");
+      return 0;
+    }
+  }
+
+  Collector collector(dev_root, sample_file, drop_file);
+  collector.collect_once();
+  if (once) {
+    std::printf("%s\n", collector.snapshot().json.c_str());
+    return 0;
+  }
+
+  ::signal(SIGINT, on_signal);
+  ::signal(SIGTERM, on_signal);
+  ::signal(SIGPIPE, SIG_IGN);
+
+  int srv = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (srv < 0) { std::perror("socket"); return 1; }
+  int opt = 1;
+  ::setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &opt, sizeof(opt));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (::bind(srv, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    std::perror("bind");
+    return 1;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(srv, (sockaddr*)&addr, &len);  // resolve --port 0
+  if (::listen(srv, 16) != 0) { std::perror("listen"); return 1; }
+  std::printf("tpu-metricsd listening on port %d (dev-root %s)\n",
+              (int)ntohs(addr.sin_port), dev_root.c_str());
+  std::fflush(stdout);
+
+  std::thread loop([&] {
+    while (!g_stop) {
+      collector.collect_once();
+      for (double waited = 0; waited < interval_s && !g_stop; waited += 0.2)
+        ::usleep(200 * 1000);
+    }
+  });
+
+  // accept with timeout so SIGTERM is honored promptly
+  timeval tv{1, 0};
+  ::setsockopt(srv, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  while (!g_stop) {
+    int fd = ::accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    handle(fd, collector);
+    ::close(fd);
+  }
+  ::close(srv);
+  loop.join();
+  return 0;
+}

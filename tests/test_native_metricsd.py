@@ -1,0 +1,309 @@
+"""Native C++ tpu-metricsd hostengine (the DCGM hostengine slot): HTTP
+endpoints, Prometheus output, sampler side-file merge, drop-file, shutdown —
+plus the Python launcher delegation and the exporter's remote scrape path."""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+BIN = os.path.join(NATIVE, "out", "tpu_metricsd")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_native():
+    r = subprocess.run(["make", "-C", NATIVE], capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"native toolchain unavailable: {r.stderr[-200:]}")
+
+
+@pytest.fixture()
+def dev_root(tmp_path):
+    d = tmp_path / "dev"
+    d.mkdir()
+    for i in range(2):
+        (d / f"accel{i}").touch()
+    return str(d)
+
+
+@pytest.fixture()
+def daemon(dev_root, tmp_path):
+    """Running daemon on an ephemeral port; yields (port, paths)."""
+    drop = str(tmp_path / "drop.json")
+    sample = str(tmp_path / "sample.json")
+    proc = subprocess.Popen(
+        [
+            BIN,
+            "--port", "0",
+            "--dev-root", dev_root,
+            "--drop-file", drop,
+            "--sample-file", sample,
+            "--interval", "0.3",
+        ],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    line = proc.stdout.readline()
+    m = re.search(r"port (\d+)", line)
+    assert m, f"no port line: {line!r}"
+    port = int(m.group(1))
+    yield port, {"drop": drop, "sample": sample, "proc": proc}
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
+
+
+def get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    ) as r:
+        return r.read().decode()
+
+
+def test_once_mode(dev_root, tmp_path):
+    drop = str(tmp_path / "drop.json")
+    r = subprocess.run(
+        [BIN, "--dev-root", dev_root, "--once", "--drop-file", drop],
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0
+    snap = json.loads(r.stdout)
+    assert snap["source"] == "tpu-metricsd-native"
+    assert snap["chip_count"] == 2
+    assert [c["index"] for c in snap["chips"]] == [0, 1]
+    assert json.load(open(drop)) == snap
+
+
+def test_http_endpoints(daemon):
+    port, _ = daemon
+    assert get(port, "/healthz").strip() == "ok"
+    snap = json.loads(get(port, "/json"))
+    assert snap["chip_count"] == 2
+    prom = get(port, "/metrics")
+    assert "tpu_metricsd_chips 2" in prom
+    assert 'tpu_chip_present{chip="0"} 1' in prom
+    assert 'tpu_chip_present{chip="1"} 1' in prom
+    assert "tpu_metricsd_sample_fresh 0" in prom
+
+
+def test_sampler_sidefile_merge(daemon):
+    port, paths = daemon
+    payload = {
+        "ts": 1.0,
+        "chips": [{"index": 0, "tensorcore_util": 87.5, "hbm_used": 2048}],
+    }
+    with open(paths["sample"], "w") as f:
+        json.dump(payload, f)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        snap = json.loads(get(port, "/json"))
+        if "sample" in snap:
+            break
+        time.sleep(0.2)
+    assert snap["sample"]["chips"][0]["tensorcore_util"] == 87.5
+    prom = get(port, "/metrics")
+    assert 'tpu_tensorcore_utilization_percent{chip="0"} 87.5' in prom
+    assert 'tpu_hbm_used_bytes{chip="0"} 2048' in prom
+    assert "tpu_metricsd_sample_fresh 1" in prom
+
+
+def test_clean_shutdown(daemon):
+    port, paths = daemon
+    proc = paths["proc"]
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=10) == 0
+
+
+def test_exporter_scrapes_native_hostengine(daemon):
+    """The dcgm-exporter slot reading the remote hostengine (reference
+    object_controls.go:95-98): sampler counters flow through to gauges."""
+    port, paths = daemon
+    with open(paths["sample"], "w") as f:
+        json.dump({"chips": [{"index": 0, "tensorcore_util": 55.0}]}, f)
+    time.sleep(0.8)
+
+    from prometheus_client import CollectorRegistry
+
+    from tpu_operator.exporter.exporter import Exporter
+
+    exp = Exporter(
+        node_name="n1",
+        dev_root="/nonexistent",  # must not matter: endpoint wins
+        generation="v5e",
+        registry=CollectorRegistry(),
+        metricsd_endpoint=f"127.0.0.1:{port}",
+    )
+    out = exp.collect_once()
+    assert out["0"]["present"] == 1.0
+    assert out["0"]["tensorcore_util"] == 55.0
+    assert out["1"]["present"] == 1.0
+
+
+def test_python_launcher_finds_native(monkeypatch):
+    from tpu_operator.metricsd import daemon as d
+
+    monkeypatch.setenv("TPU_METRICSD_NATIVE", BIN)
+    assert d.find_native_binary() == BIN
+    monkeypatch.setenv("TPU_METRICSD_NATIVE", "/nonexistent")
+    monkeypatch.delenv("TPU_METRICSD_NATIVE")
+
+
+def test_sampler_only_writes_sidefile(tmp_path, monkeypatch):
+    """--sampler-only loop drops the side-file (CPU: sampler yields None, so
+    seed a fake sampler result)."""
+    from tpu_operator.metricsd.daemon import MetricsDaemon
+
+    daemon = MetricsDaemon(dev_root=str(tmp_path), interval_s=0.1)
+    monkeypatch.setattr(
+        daemon, "_sample_duty_cycle", lambda: {"tensorcore_util": 12.0}
+    )
+    sample = str(tmp_path / "sample.json")
+
+    import threading
+
+    t = threading.Thread(target=daemon.run_sampler, args=(sample,))
+    t.start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not os.path.exists(sample):
+        time.sleep(0.05)
+    daemon.stop()
+    t.join(timeout=5)
+    data = json.load(open(sample))
+    assert data["chips"][0]["tensorcore_util"] == 12.0
+
+
+def test_metricsd_sampler_sidecar_transform():
+    """sample_on_chip=true adds the chip-owning sampler sidecar."""
+    import yaml
+
+    from tpu_operator.api.v1.clusterpolicy_types import clusterpolicy_from_obj
+    from tpu_operator.controllers import object_controls
+
+    with open(
+        os.path.join(REPO, "assets", "state-metricsd", "0400_daemonset.yaml")
+    ) as f:
+        ds = yaml.safe_load(f)
+    with open(
+        os.path.join(REPO, "config", "samples", "v1_clusterpolicy.yaml")
+    ) as f:
+        cp_obj = yaml.safe_load(f)
+    cp_obj["spec"].setdefault("metricsd", {})["sampleOnChip"] = True
+
+    class N:
+        cp = clusterpolicy_from_obj(cp_obj)
+        openshift = False
+        runtime = "containerd"
+
+    object_controls.TRANSFORMS["tpu-metricsd"](N(), ds)
+    names = [
+        c["name"] for c in ds["spec"]["template"]["spec"]["containers"]
+    ]
+    assert "tpu-metricsd-sampler" in names
+    sampler = next(
+        c
+        for c in ds["spec"]["template"]["spec"]["containers"]
+        if c["name"] == "tpu-metricsd-sampler"
+    )
+    assert sampler["args"] == ["--sampler-only"]
+
+
+def _stub_http(body: bytes):
+    """Tiny one-route HTTP server; returns (server, port)."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_port
+
+
+@pytest.mark.parametrize(
+    "body",
+    [
+        b'{"source":"tpu-metricsd","chips":[]}',  # up-but-empty
+        b"[1,2,3]",  # port squatter answering non-dict JSON
+        b"not json at all",
+    ],
+)
+def test_exporter_falls_back_when_metricsd_unusable(tmp_path, body):
+    """An up-but-empty or malformed hostengine response must not suppress
+    the local libtpuinfo fallback (and must not crash the collect loop)."""
+    from prometheus_client import CollectorRegistry
+
+    from tpu_operator.exporter.exporter import Exporter
+
+    d = tmp_path / "dev"
+    d.mkdir()
+    (d / "accel0").touch()
+
+    srv, port = _stub_http(body)
+    try:
+        exp = Exporter(
+            node_name="n1",
+            dev_root=str(d),
+            registry=CollectorRegistry(),
+            metricsd_endpoint=f"127.0.0.1:{port}",
+        )
+        out = exp.collect_once()
+        assert out["0"]["present"] == 1.0  # from libtpuinfo fallback
+    finally:
+        srv.shutdown()
+
+
+def test_exporter_falls_back_when_metricsd_down(tmp_path):
+    from prometheus_client import CollectorRegistry
+
+    from tpu_operator.exporter.exporter import Exporter
+
+    d = tmp_path / "dev"
+    d.mkdir()
+    (d / "accel0").touch()
+    exp = Exporter(
+        node_name="n1",
+        dev_root=str(d),
+        registry=CollectorRegistry(),
+        metricsd_endpoint="127.0.0.1:1",  # nothing listening
+    )
+    out = exp.collect_once()
+    assert out["0"]["present"] == 1.0
+
+
+def test_python_daemon_merges_sampler_sidefile(tmp_path):
+    """sampleOnChip must work on the pure-Python serving fallback: the
+    daemon merges the sidecar's side-file even without the native binary."""
+    import json as _json
+
+    from tpu_operator.metricsd.daemon import MetricsDaemon
+
+    d = tmp_path / "dev"
+    d.mkdir()
+    (d / "accel0").touch()
+    sample = tmp_path / "sample.json"
+    sample.write_text(
+        _json.dumps({"chips": [{"index": 0, "tensorcore_util": 61.0}]})
+    )
+    daemon = MetricsDaemon(
+        dev_root=str(d),
+        drop_file=str(tmp_path / "drop.json"),
+        sample_file=str(sample),
+    )
+    out = daemon.collect_once()
+    assert out["chips"][0]["tensorcore_util"] == 61.0

@@ -385,6 +385,9 @@ class MetricsdSpec(_ImageSpec):
     image_pull_secrets: List[str] = field(default_factory=list)
     host_port: int = 5555
     env: List[EnvVar] = field(default_factory=list)
+    # run the chip-owning JAX sampler sidecar next to the native hostengine
+    # (TPU runtime is single-client; only enable on nodes the daemon may own)
+    sample_on_chip: Optional[bool] = None
 
     ENV_VAR = "TPU_METRICSD_IMAGE"
 

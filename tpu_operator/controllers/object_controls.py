@@ -591,6 +591,27 @@ def transform_metricsd(n, ds: Obj, generation: Optional[str] = None) -> None:
                 port["hostPort"] = spec.host_port
                 port["containerPort"] = spec.host_port
         _set_container_env(main, "METRICSD_PORT", str(spec.host_port))
+    if spec.sample_on_chip:
+        # chip-owning JAX sampler sidecar; the native hostengine (main ctr)
+        # merges its side-file — single-client chip stays out of the server
+        pod_spec = ds["spec"]["template"]["spec"]
+        if not any(
+            c.get("name") == "tpu-metricsd-sampler"
+            for c in pod_spec.get("containers", [])
+        ):
+            sampler = {
+                "name": "tpu-metricsd-sampler",
+                "image": main["image"],
+                "imagePullPolicy": main.get("imagePullPolicy", "IfNotPresent"),
+                "command": ["tpu-metricsd"],
+                "args": ["--sampler-only"],
+                "securityContext": {"privileged": True},
+                "volumeMounts": [
+                    {"name": "run-tpu", "mountPath": "/run/tpu"},
+                    {"name": "dev", "mountPath": "/dev"},
+                ],
+            }
+            pod_spec["containers"].append(sampler)
 
 
 @_register("tpu-metrics-exporter")

@@ -58,11 +58,13 @@ class Exporter:
         enabled_metrics: Optional[List[str]] = None,
         interval_s: float = 10.0,
         registry=None,
+        metricsd_endpoint: str = "",
     ):
         from prometheus_client import CollectorRegistry, Gauge
 
         self.node_name = node_name
         self.dev_root = dev_root
+        self.metricsd_endpoint = metricsd_endpoint
         self.generation = generation
         self.host_topology = host_topology
         self.enabled = enabled_metrics or list(DEFAULT_METRICS)
@@ -75,10 +77,44 @@ class Exporter:
             name, doc = ALL_METRICS[key]
             self.gauges[key] = Gauge(name, doc, ["node", "chip"], **kw)
 
+    def _fetch_metricsd(self) -> Optional[dict]:
+        """Scrape the standalone hostengine's /json (reference
+        remote-hostengine pattern, ``object_controls.go:95-98``). Merges
+        the chip-owning sampler's counters into the per-chip entries."""
+        if not self.metricsd_endpoint:
+            return None
+        import json
+        import urllib.request
+
+        url = f"http://{self.metricsd_endpoint}/json"
+        try:
+            with urllib.request.urlopen(url, timeout=3) as r:
+                data = json.load(r)
+            if not isinstance(data, dict) or not data.get("chips"):
+                # up-but-empty (daemon starting, wrong dev-root) or a port
+                # squatter: treat as unusable so libtpuinfo still answers
+                return None
+            sample_by_idx = {
+                c.get("index"): c
+                for c in (data.get("sample", {}) or {}).get("chips", [])
+                if isinstance(c, dict)
+            }
+            for chip in data.get("chips", []):
+                chip.setdefault("present", 1)
+                extra = sample_by_idx.get(chip.get("index"))
+                if extra:
+                    chip.update(
+                        {k: v for k, v in extra.items() if k != "index"}
+                    )
+            return data
+        except Exception:
+            log.debug("metricsd scrape failed (%s); using libtpuinfo", url)
+            return None
+
     def collect_once(self) -> Dict[str, Dict[str, float]]:
-        """One scrape of libtpuinfo -> gauge updates. Returns {chip: {key: v}}
-        for tests."""
-        data = tpuinfo.metrics(self.dev_root)
+        """One scrape of metricsd (preferred) or libtpuinfo -> gauge
+        updates. Returns {chip: {key: v}} for tests."""
+        data = self._fetch_metricsd() or tpuinfo.metrics(self.dev_root)
         out: Dict[str, Dict[str, float]] = {}
         chips = data.get("chips", [])
         for chip in chips:
@@ -163,6 +199,7 @@ def main(argv=None) -> int:
         host_topology=topology,
         enabled_metrics=enabled,
         interval_s=args.interval,
+        metricsd_endpoint=os.environ.get("METRICSD_ENDPOINT", ""),
     ).run(port=args.port)
     return 0
 

@@ -30,7 +30,26 @@ from tpu_operator.native import tpuinfo
 log = logging.getLogger("tpu-metricsd")
 
 DROP_FILE = "/run/tpu/metricsd.json"
+SAMPLE_FILE = "/run/tpu/metricsd-sample.json"
 DEFAULT_PORT = 5555
+
+
+def find_native_binary() -> Optional[str]:
+    """The C++ hostengine (``native/tpu_metricsd.cpp``), when built/shipped.
+    Serving stays native (the DCGM-hostengine posture); Python remains the
+    chip-owning sampler (JAX) and the fallback."""
+    explicit = os.environ.get("TPU_METRICSD_NATIVE")
+    candidates = [explicit] if explicit else []
+    candidates += [
+        "/usr/local/bin/tpu-metricsd-native",
+        os.path.join(
+            os.path.dirname(__file__), "..", "..", "native", "out", "tpu_metricsd"
+        ),
+    ]
+    for c in candidates:
+        if c and os.path.isfile(c) and os.access(c, os.X_OK):
+            return os.path.abspath(c)
+    return None
 
 
 class MetricsDaemon:
@@ -40,11 +59,13 @@ class MetricsDaemon:
         drop_file: str = DROP_FILE,
         own_chip: bool = False,
         interval_s: float = 10.0,
+        sample_file: str = SAMPLE_FILE,
     ):
         self.dev_root = dev_root
         self.drop_file = drop_file
         self.own_chip = own_chip
         self.interval_s = interval_s
+        self.sample_file = sample_file
         self._stop = threading.Event()
         self._latest: dict = {"source": "tpu-metricsd", "chips": []}
         self._lock = threading.Lock()
@@ -53,6 +74,9 @@ class MetricsDaemon:
     def collect_once(self) -> dict:
         chips = tpuinfo.chip_summary(self.dev_root)
         sample = self._sample_duty_cycle() if self.own_chip else None
+        # merge a sampler sidecar's side-file (same contract as the native
+        # hostengine) so sampleOnChip works on the pure-Python fallback too
+        side = self._read_sample_file() if not self.own_chip else {}
         out = {"source": "tpu-metricsd", "ts": time.time(), "chips": []}
         for chip in chips:
             entry = {
@@ -63,6 +87,11 @@ class MetricsDaemon:
                 entry["numa_node"] = chip["numa_node"]
             if sample is not None:
                 entry.update(sample)
+            extra = side.get(chip["index"])
+            if extra:
+                entry.update(
+                    {k: v for k, v in extra.items() if k != "index"}
+                )
             out["chips"].append(entry)
         with self._lock:
             self._latest = out
@@ -94,6 +123,21 @@ class MetricsDaemon:
             return {"tensorcore_util": round(min(100.0, tflops / 1.97), 2)}
         except Exception:
             return None
+
+    def _read_sample_file(self) -> dict:
+        """{chip_index: counters} from the chip-owning sampler's side-file."""
+        try:
+            with open(self.sample_file) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return {}
+        if not isinstance(data, dict):
+            return {}
+        return {
+            c.get("index"): c
+            for c in data.get("chips", [])
+            if isinstance(c, dict)
+        }
 
     def _write_drop_file(self, payload: dict) -> None:
         try:
@@ -147,6 +191,44 @@ class MetricsDaemon:
     def stop(self):
         self._stop.set()
 
+    # ------------------------------------------------------------------
+    def run_sampler(self, sample_file: str = SAMPLE_FILE) -> None:
+        """Chip-owning sampler loop: this process holds the (single-client)
+        chip via JAX and drops on-chip counters into the side-file the
+        native hostengine merges — the hostengine/reader split with the
+        chip-owning process decoupled from the serving process."""
+        while not self._stop.is_set():
+            sample = self._sample_duty_cycle()
+            if sample is not None:
+                payload = {
+                    "ts": time.time(),
+                    "chips": [{"index": 0, **sample}],
+                }
+                try:
+                    os.makedirs(os.path.dirname(sample_file), exist_ok=True)
+                    tmp = sample_file + ".tmp"
+                    with open(tmp, "w") as f:
+                        json.dump(payload, f)
+                    os.replace(tmp, sample_file)
+                except OSError:
+                    log.exception("sample-file write failed")
+            self._stop.wait(self.interval_s)
+
+
+def exec_native(binary: str, args) -> int:
+    """Replace this process with the C++ hostengine."""
+    cmd = [
+        binary,
+        "--port", str(args.port),
+        "--dev-root", args.dev_root,
+        "--drop-file", args.drop_file,
+        "--sample-file", args.sample_file,
+        "--interval", str(args.interval),
+    ]
+    log.info("delegating to native hostengine: %s", " ".join(cmd))
+    os.execv(binary, cmd)
+    return 1  # unreachable
+
 
 def main(argv=None) -> int:
     import argparse
@@ -162,13 +244,37 @@ def main(argv=None) -> int:
         action="store_true",
         help="sample on-chip counters (requires exclusive chip access)",
     )
+    p.add_argument(
+        "--sample-file",
+        default=os.environ.get("METRICSD_SAMPLE_FILE", SAMPLE_FILE),
+    )
+    p.add_argument(
+        "--sampler-only",
+        action="store_true",
+        help="run only the chip-owning JAX sampler writing --sample-file "
+        "(pair with the native hostengine serving :5555)",
+    )
+    p.add_argument(
+        "--no-native",
+        action="store_true",
+        help="never delegate serving to the C++ hostengine",
+    )
     args = p.parse_args(argv)
-    MetricsDaemon(
+    daemon = MetricsDaemon(
         dev_root=args.dev_root,
         drop_file=args.drop_file,
-        own_chip=args.own_chip,
+        own_chip=args.own_chip or args.sampler_only,
         interval_s=args.interval,
-    ).serve(port=args.port)
+        sample_file=args.sample_file,
+    )
+    if args.sampler_only:
+        daemon.run_sampler(args.sample_file)
+        return 0
+    if not args.no_native and not args.own_chip:
+        native = find_native_binary()
+        if native:
+            return exec_native(native, args)
+    daemon.serve(port=args.port)
     return 0
 
 

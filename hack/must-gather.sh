@@ -11,7 +11,9 @@ echo "collecting into $OUT"
 $K version -o yaml > "$OUT/version.yaml" 2>&1
 $K get clusterpolicies.tpu.k8s.io -o yaml > "$OUT/clusterpolicy.yaml" 2>&1
 $K get nodes -o yaml > "$OUT/nodes.yaml" 2>&1
-$K get nodes -o custom-columns='NAME:.metadata.name,TPU:.metadata.labels.tpu\.k8s\.io/tpu\.present,GEN:.metadata.labels.tpu\.k8s\.io/tpu\.generation,SLICE:.metadata.labels.tpu\.k8s\.io/tpu\.slice\.config\.state,UPGRADE:.metadata.labels.tpu\.k8s\.io/libtpu-upgrade-state' > "$OUT/node-labels.txt" 2>&1
+$K get nodes -o custom-columns='NAME:.metadata.name,TPU:.metadata.labels.tpu\.k8s\.io/tpu\.present,GEN:.metadata.labels.tpu\.k8s\.io/tpu\.generation,SLICEID:.metadata.labels.tpu\.k8s\.io/tpu\.slice-id,SLICEREADY:.metadata.labels.tpu\.k8s\.io/tpu\.slice\.ready,SLICE:.metadata.labels.tpu\.k8s\.io/tpu\.slice\.config\.state,UPGRADE:.metadata.labels.tpu\.k8s\.io/libtpu-upgrade-state' > "$OUT/node-labels.txt" 2>&1
+$K get clusterpolicies.tpu.k8s.io -o jsonpath='{.items[0].status.slices}' > "$OUT/slice-status.json" 2>&1
+$K -n "$NS" get prometheusrules -o yaml > "$OUT/prometheus-rules.yaml" 2>&1
 $K -n "$NS" get all -o wide > "$OUT/workloads.txt" 2>&1
 $K -n "$NS" get daemonsets -o yaml > "$OUT/daemonsets.yaml" 2>&1
 $K -n "$NS" get configmaps -o yaml > "$OUT/configmaps.yaml" 2>&1

@@ -29,6 +29,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <cmath>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -65,18 +66,62 @@ std::string read_file(const std::string& path, size_t max = 1 << 20) {
   return out;
 }
 
-// Minimal scanner: find `"key":<number>` occurrences in a JSON blob in
-// order. Enough to lift per-chip sampler numbers into Prometheus series
-// without a full JSON parser.
-std::vector<double> scan_numbers(const std::string& json, const std::string& key) {
-  std::vector<double> out;
-  std::string needle = "\"" + key + "\":";
-  size_t pos = 0;
-  while ((pos = json.find(needle, pos)) != std::string::npos) {
-    pos += needle.size();
-    out.push_back(std::atof(json.c_str() + pos));
+// Split a JSON array of flat objects into the objects' substrings (balanced
+// braces; nested objects stay inside their parent). Per-object key lookups
+// below keep chip attribution correct even when a key is present on only
+// some chips — a positional key scan would misalign them.
+std::vector<std::string> split_objects(const std::string& json) {
+  std::vector<std::string> out;
+  int depth = 0;
+  size_t start = 0;
+  bool in_string = false;
+  for (size_t i = 0; i < json.size(); ++i) {
+    char ch = json[i];
+    if (in_string) {
+      if (ch == '\\') ++i;
+      else if (ch == '"') in_string = false;
+      continue;
+    }
+    if (ch == '"') in_string = true;
+    else if (ch == '{') {
+      if (depth == 0) start = i;
+      ++depth;
+    } else if (ch == '}') {
+      if (--depth == 0) out.push_back(json.substr(start, i - start + 1));
+    }
   }
   return out;
+}
+
+// `"key":<number>` lookup inside ONE flat object; nan when absent.
+double find_number(const std::string& obj, const std::string& key) {
+  std::string needle = "\"" + key + "\":";
+  size_t pos = obj.find(needle);
+  if (pos == std::string::npos) return std::nan("");
+  return std::atof(obj.c_str() + pos + needle.size());
+}
+
+// The `"key":[...]` array substring of an object ("" when absent).
+std::string extract_array(const std::string& json, const std::string& key) {
+  std::string needle = "\"" + key + "\":";
+  size_t pos = json.find(needle);
+  if (pos == std::string::npos) return "";
+  pos = json.find('[', pos + needle.size());
+  if (pos == std::string::npos) return "";
+  int depth = 0;
+  bool in_string = false;
+  for (size_t i = pos; i < json.size(); ++i) {
+    char ch = json[i];
+    if (in_string) {
+      if (ch == '\\') ++i;
+      else if (ch == '"') in_string = false;
+      continue;
+    }
+    if (ch == '"') in_string = true;
+    else if (ch == '[') ++depth;
+    else if (ch == ']' && --depth == 0) return json.substr(pos, i - pos + 1);
+  }
+  return "";
 }
 
 struct Snapshot {
@@ -124,29 +169,34 @@ class Collector {
           (double)collections_);
     gauge("tpu_metricsd_last_collect_ts_seconds", "Last collection time", "",
           (double)::time(nullptr));
-    auto numas = scan_numbers(chips, "numa_node");
-    auto indices = scan_numbers(chips, "index");
-    for (size_t i = 0; i < indices.size(); ++i) {
-      std::string label = "chip=\"" + std::to_string((int)indices[i]) + "\"";
+    size_t pos = 0;
+    for (const std::string& chip : split_objects(chips)) {
+      double idx = find_number(chip, "index");
+      int chip_id = std::isnan(idx) ? (int)pos : (int)idx;
+      ++pos;
+      std::string label = "chip=\"" + std::to_string(chip_id) + "\"";
       gauge("tpu_chip_present", "Chip device node visible", label, 1);
-      if (i < numas.size())
-        gauge("tpu_chip_numa_node", "Chip NUMA affinity", label, numas[i]);
+      double numa = find_number(chip, "numa_node");
+      if (!std::isnan(numa))
+        gauge("tpu_chip_numa_node", "Chip NUMA affinity", label, numa);
     }
     if (have_sample) {
       gauge("tpu_metricsd_sample_fresh", "Sampler side-file present", "", 1);
-      auto utils = scan_numbers(sample, "tensorcore_util");
-      auto sample_idx = scan_numbers(sample, "index");
-      for (size_t i = 0; i < utils.size(); ++i) {
-        int chip = i < sample_idx.size() ? (int)sample_idx[i] : (int)i;
-        gauge("tpu_tensorcore_utilization_percent",
-              "TensorCore utilization % (from chip-owning sampler)",
-              "chip=\"" + std::to_string(chip) + "\"", utils[i]);
-      }
-      auto hbm = scan_numbers(sample, "hbm_used");
-      for (size_t i = 0; i < hbm.size(); ++i) {
-        int chip = i < sample_idx.size() ? (int)sample_idx[i] : (int)i;
-        gauge("tpu_hbm_used_bytes", "HBM bytes in use (from sampler)",
-              "chip=\"" + std::to_string(chip) + "\"", hbm[i]);
+      size_t si = 0;
+      for (const std::string& entry : split_objects(extract_array(sample, "chips"))) {
+        double idx = find_number(entry, "index");
+        int chip_id = std::isnan(idx) ? (int)si : (int)idx;
+        ++si;
+        std::string label = "chip=\"" + std::to_string(chip_id) + "\"";
+        double util = find_number(entry, "tensorcore_util");
+        if (!std::isnan(util))
+          gauge("tpu_tensorcore_utilization_percent",
+                "TensorCore utilization % (from chip-owning sampler)", label,
+                util);
+        double hbm = find_number(entry, "hbm_used");
+        if (!std::isnan(hbm))
+          gauge("tpu_hbm_used_bytes", "HBM bytes in use (from sampler)", label,
+                hbm);
       }
     } else {
       gauge("tpu_metricsd_sample_fresh", "Sampler side-file present", "", 0);
@@ -168,12 +218,12 @@ class Collector {
  private:
   void write_drop_file(const std::string& payload) {
     if (drop_file_.empty()) return;
-    std::string dir = drop_file_.substr(0, drop_file_.find_last_of('/'));
-    if (!dir.empty()) {
-      std::string cmd_free_mkdir = dir;  // mkdir -p without system()
-      for (size_t i = 1; i <= cmd_free_mkdir.size(); ++i) {
-        if (i == cmd_free_mkdir.size() || cmd_free_mkdir[i] == '/') {
-          std::string prefix = cmd_free_mkdir.substr(0, i);
+    size_t slash = drop_file_.find_last_of('/');
+    if (slash != std::string::npos && slash > 0) {
+      std::string dir = drop_file_.substr(0, slash);  // mkdir -p, no system()
+      for (size_t i = 1; i <= dir.size(); ++i) {
+        if (i == dir.size() || dir[i] == '/') {
+          std::string prefix = dir.substr(0, i);
           if (!prefix.empty()) ::mkdir(prefix.c_str(), 0755);
         }
       }

@@ -1,0 +1,106 @@
+"""Manager runtime: workqueue coalescing, rate limiting, leader election
+acquire/renew/loss semantics (reference ``main.go:88-159``)."""
+
+import time
+
+from tpu_operator.kube import FakeClient
+from tpu_operator.manager import LeaderElector, Manager, RateLimiter, WorkQueue
+
+NS = "tpu-operator"
+
+
+def test_workqueue_dedup_and_delay():
+    q = WorkQueue()
+    q.add("a", delay=0.2)
+    q.add("a", delay=0.0)  # supersedes the later due time
+    assert len(q) == 1
+    t0 = time.monotonic()
+    assert q.get(timeout=1.0) == "a"
+    assert time.monotonic() - t0 < 0.15
+    assert q.get(timeout=0.05) is None
+
+
+def test_rate_limiter_backoff_and_forget():
+    rl = RateLimiter(base=0.1, cap=3.0)
+    assert rl.when("x") == 0.1
+    assert rl.when("x") == 0.2
+    assert rl.when("x") == 0.4
+    rl.forget("x")
+    assert rl.when("x") == 0.1
+    for _ in range(10):
+        rl.when("x")
+    assert rl.when("x") == 3.0  # capped
+
+
+def test_leader_election_single_holder():
+    client = FakeClient()
+    a = LeaderElector(client, NS, identity="pod-a")
+    b = LeaderElector(client, NS, identity="pod-b")
+    assert a.try_acquire()
+    assert not b.try_acquire()  # unexpired lease held by a
+    assert a.try_acquire()  # renew works
+
+
+def test_leader_election_takeover_on_expiry():
+    client = FakeClient()
+    a = LeaderElector(client, NS, identity="pod-a", lease_seconds=30)
+    assert a.try_acquire()
+    # age the lease beyond its duration
+    lease = client.get("coordination.k8s.io/v1", "Lease", a.name, NS)
+    lease["spec"]["renewTime"] = "2020-01-01T00:00:00.000000Z"
+    client.update(lease)
+    b = LeaderElector(client, NS, identity="pod-b")
+    assert b.try_acquire()
+    lease = client.get("coordination.k8s.io/v1", "Lease", a.name, NS)
+    assert lease["spec"]["holderIdentity"] == "pod-b"
+
+
+def test_manager_stops_on_lost_leadership():
+    client = FakeClient()
+    mgr = Manager(
+        client, NS, metrics_port=0, probe_port=0, leader_election=True
+    )
+    # make the election loop fast
+    elector_holder = {}
+
+    orig_init = LeaderElector.__init__
+
+    def fast_init(self, *a, **kw):
+        orig_init(self, *a, **kw)
+        self.lease_seconds = 3  # renew every ~1s
+        elector_holder["elector"] = self
+
+    LeaderElector.__init__ = fast_init
+    try:
+        mgr.start()
+        deadline = time.time() + 5
+        while "elector" not in elector_holder and time.time() < deadline:
+            time.sleep(0.05)
+        elector = elector_holder["elector"]
+        # steal the lease with a fresh renewTime under another identity
+        from datetime import datetime, timezone
+
+        lease = client.get("coordination.k8s.io/v1", "Lease", elector.name, NS)
+        lease["spec"]["holderIdentity"] = "usurper"
+        lease["spec"]["renewTime"] = datetime.now(timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%S.%fZ"
+        )
+        client.update(lease)
+
+        # keep the stolen lease fresh so expiry can't hand it back; the
+        # manager must notice (2 missed renews ~2s) and stop itself
+        deadline = time.time() + 15
+        while mgr.healthy() and time.time() < deadline:
+            lease = client.get(
+                "coordination.k8s.io/v1", "Lease", elector.name, NS
+            )
+            lease["spec"]["renewTime"] = datetime.now(timezone.utc).strftime(
+                "%Y-%m-%dT%H:%M:%S.%fZ"
+            )
+            lease["spec"]["holderIdentity"] = "usurper"
+            client.update(lease)
+            time.sleep(0.3)
+        assert not mgr.healthy(), "manager kept running after losing lease"
+    finally:
+        LeaderElector.__init__ = orig_init
+        mgr.stop()

@@ -152,8 +152,12 @@ def test_python_launcher_finds_native(monkeypatch):
 
     monkeypatch.setenv("TPU_METRICSD_NATIVE", BIN)
     assert d.find_native_binary() == BIN
+    # invalid explicit override must fall through to the default candidates
+    # (repo-relative native/out build) rather than crash or return it
     monkeypatch.setenv("TPU_METRICSD_NATIVE", "/nonexistent")
+    assert d.find_native_binary() == os.path.abspath(BIN)
     monkeypatch.delenv("TPU_METRICSD_NATIVE")
+    assert d.find_native_binary() == os.path.abspath(BIN)
 
 
 def test_sampler_only_writes_sidefile(tmp_path, monkeypatch):
@@ -307,3 +311,46 @@ def test_python_daemon_merges_sampler_sidefile(tmp_path):
     )
     out = daemon.collect_once()
     assert out["chips"][0]["tensorcore_util"] == 61.0
+
+
+def test_native_per_chip_attribution_with_sparse_keys(daemon):
+    """A key present on only some chips must stay attributed to its chip
+    (positional scans would misalign hbm_used onto chip 0)."""
+    port, paths = daemon
+    with open(paths["sample"], "w") as f:
+        json.dump(
+            {
+                "ts": 1.0,
+                "chips": [
+                    {"index": 0, "tensorcore_util": 50.0},
+                    {"index": 1, "tensorcore_util": 60.0, "hbm_used": 200},
+                ],
+            },
+            f,
+        )
+    deadline = time.time() + 5
+    prom = ""
+    while time.time() < deadline:
+        prom = get(port, "/metrics")
+        if "tpu_hbm_used_bytes" in prom:
+            break
+        time.sleep(0.2)
+    assert 'tpu_hbm_used_bytes{chip="1"} 200' in prom
+    assert 'tpu_hbm_used_bytes{chip="0"}' not in prom
+    assert 'tpu_tensorcore_utilization_percent{chip="0"} 50' in prom
+    assert 'tpu_tensorcore_utilization_percent{chip="1"} 60' in prom
+
+
+def test_native_dropfile_without_directory(dev_root, tmp_path):
+    """--drop-file with no directory component must still publish."""
+    import os as _os
+
+    r = subprocess.run(
+        [BIN, "--dev-root", dev_root, "--once", "--drop-file", "drop-rel.json"],
+        capture_output=True,
+        text=True,
+        cwd=str(tmp_path),
+    )
+    assert r.returncode == 0
+    out = tmp_path / "drop-rel.json"
+    assert out.exists() and json.loads(out.read_text())["chip_count"] == 2

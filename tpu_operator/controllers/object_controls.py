@@ -182,7 +182,33 @@ def service_monitor(n, state_name: str, obj: Obj) -> str:
 
 
 def prometheus_rule(n, state_name: str, obj: Obj) -> str:
-    return _generic_apply(n, state_name, obj)
+    """Alerting rules. The reference ships these OCP-only (monitoring CRDs
+    guaranteed there); vanilla clusters may lack the prometheus-operator
+    CRDs, so only the missing-CRD failure (404 / no matches for kind) is a
+    graceful skip — anything else (RBAC, bad manifest) is NotReady."""
+    from tpu_operator.kube.client import NotFoundError
+
+    try:
+        return _generic_apply(n, state_name, obj)
+    except Exception as e:
+        absent = isinstance(e, NotFoundError) or (
+            "could not find the requested resource" in str(e)
+            or "no matches for kind" in str(e)
+            or "404" in str(e)
+        )
+        if absent:
+            log.warning(
+                "PrometheusRule %s skipped (monitoring CRDs absent): %s",
+                obj["metadata"].get("name"),
+                e,
+            )
+            return State.READY
+        log.error(
+            "PrometheusRule %s apply failed: %s",
+            obj["metadata"].get("name"),
+            e,
+        )
+        return State.NOT_READY
 
 
 def runtime_class(n, state_name: str, obj: Obj) -> str:

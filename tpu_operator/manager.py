@@ -228,10 +228,33 @@ class Manager:
             log.info("waiting for leader lease as %s", elector.identity)
             while not self._stop.is_set() and not elector.try_acquire():
                 time.sleep(2)
-            # keep renewing in the background
+            # keep renewing in the background; losing the lease means
+            # another replica took over — stop reconciling and let the pod
+            # restart into candidate state (controller-runtime exits on lost
+            # leadership for the same reason: two actors reconciling the
+            # same CR race each other)
             def renew():
+                misses = 0
                 while not self._stop.is_set():
-                    elector.try_acquire()
+                    try:
+                        acquired = elector.try_acquire()
+                    except Exception:
+                        # transient apiserver failure: count it like a lost
+                        # renew — persisting past the lease duration must
+                        # stop this replica, never kill the renew thread
+                        log.exception("lease renewal attempt failed")
+                        acquired = False
+                    if acquired:
+                        misses = 0
+                    else:
+                        misses += 1
+                        if misses >= 2:
+                            log.error(
+                                "leader lease lost (holder changed or "
+                                "apiserver unreachable); stopping manager"
+                            )
+                            self.stop()
+                            return
                     time.sleep(max(1, elector.lease_seconds // 3))
 
             t = threading.Thread(target=renew, daemon=True)

@@ -200,9 +200,15 @@ class MetricsDaemon:
         while not self._stop.is_set():
             sample = self._sample_duty_cycle()
             if sample is not None:
+                # the matmul exercises the whole host's chips through one
+                # JAX client; report the sample for every visible chip (the
+                # legacy --own-chip path does the same per-chip fan-out)
+                indices = [
+                    c["index"] for c in tpuinfo.chip_summary(self.dev_root)
+                ] or [0]
                 payload = {
                     "ts": time.time(),
-                    "chips": [{"index": 0, **sample}],
+                    "chips": [{"index": i, **sample} for i in indices],
                 }
                 try:
                     os.makedirs(os.path.dirname(sample_file), exist_ok=True)

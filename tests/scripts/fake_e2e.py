@@ -99,6 +99,72 @@ def main() -> int:
     ds_names = {d["metadata"]["name"] for d in client.list("apps/v1", "DaemonSet", NS)}
     assert "tpu-metrics-exporter" in ds_names
 
+    print("=== slice-readiness (multi-host aggregate: all-hosts-or-nothing)")
+    from tpu_operator.kube.testing import make_tpu_node as _mk
+    from tpu_operator import consts as _c
+
+    for i in range(2):
+        client.create(
+            _mk(
+                f"vp-host-{i}",
+                accelerator="tpu-v5p-slice",
+                topology="2x2x2",
+                extra_labels={
+                    _c.GKE_NODEPOOL_LABEL: "vp-pool",
+                    _c.TFD_SLICE_HOSTS_LABEL: "2",
+                    _c.TFD_WORKER_ID_LABEL: str(i),
+                },
+            )
+        )
+
+    def validator_pod(node, ready):
+        name = f"val-{node}"
+        existing = client.get_or_none("v1", "Pod", name, NS)
+        if existing is not None:
+            client.delete("v1", "Pod", name, NS)
+        client.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": name,
+                    "namespace": NS,
+                    "labels": {"app": "tpu-operator-validator"},
+                },
+                "spec": {"nodeName": node},
+                "status": {
+                    "phase": "Running" if ready else "Pending",
+                    "containerStatuses": [{"ready": ready}],
+                },
+            }
+        )
+
+    validator_pod("vp-host-0", True)
+    validator_pod("vp-host-1", False)  # one host lags: slice must be degraded
+    converge()
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    slices = cp["status"]["slices"]
+    assert "vp-pool" in slices.get("degraded", []), slices
+    n0 = client.get("v1", "Node", "vp-host-0")
+    assert n0["metadata"]["labels"][_c.SLICE_READY_LABEL] == "false", (
+        "a slice with a lagging host must not be ready on ANY member"
+    )
+
+    validator_pod("vp-host-1", True)  # last host validates → slice flips
+    converge()
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    assert "vp-pool" not in cp["status"]["slices"].get("degraded", [])
+    for i in range(2):
+        node = client.get("v1", "Node", f"vp-host-{i}")
+        assert node["metadata"]["labels"][_c.SLICE_READY_LABEL] == "true"
+    print("ok: slice aggregate degraded→ready")
+
+    # clean up the slice nodes so the node-departure phase below still
+    # exercises the zero-TPU-node posture
+    for i in range(2):
+        client.delete("v1", "Node", f"vp-host-{i}")
+        client.delete("v1", "Pod", f"val-vp-host-{i}", NS)
+
     print("=== node-departure (last TPU node removed → 45s NFD-poll posture)")
     client.delete("v1", "Node", "fake-tpu-node-1")
     res = reconciler.reconcile()

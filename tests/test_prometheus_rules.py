@@ -50,18 +50,15 @@ def test_alert_exprs_reference_real_metric_names():
 
     OperatorMetrics()  # ensure collectors registered
     known = {m.name for m in REGISTRY.collect()}
-    # validator node metrics use their own registry namespace; enumerate
-    # from the class definition names instead
-    known |= {
-        "tpu_validator_libtpu_ready",
-        "tpu_validator_runtime_ready",
-        "tpu_validator_plugin_ready",
-        "tpu_validator_jax_ready",
-        "tpu_validator_libtpu_validation",
-        "tpu_validator_tpu_capacity",
-        "tpu_validator_tpu_devices",
-        "tpu_validator_jax_matmul_tflops",
-    }
+    # validator node metrics: enumerate from the actual collectors on a
+    # scratch registry so a gauge rename breaks this test, not the alerts
+    from prometheus_client import CollectorRegistry
+
+    from tpu_operator.validator.metrics import NodeMetrics
+
+    scratch = CollectorRegistry()
+    NodeMetrics(node_name="n", registry=scratch)
+    known |= {m.name for m in scratch.collect()}
     for path in RULE_FILES:
         with open(path) as f:
             obj = yaml.safe_load(f)
@@ -130,3 +127,65 @@ def test_rule_apply_failure_is_graceful():
         object_controls.prometheus_rule(n, "state-operator-metrics", obj)
         == State.READY
     )
+
+
+def test_rule_apply_rbac_failure_is_not_ready():
+    """Non-absence failures (e.g. RBAC) must surface as NotReady."""
+
+    class ForbiddenClient:
+        def get_or_none(self, *a, **k):
+            raise RuntimeError("403: prometheusrules is forbidden")
+
+    class N:
+        client = ForbiddenClient()
+        namespace = NS
+
+    obj = {
+        "apiVersion": "monitoring.coreos.com/v1",
+        "kind": "PrometheusRule",
+        "metadata": {"name": "x", "namespace": ""},
+        "spec": {"groups": []},
+    }
+    n = N()
+    n.cp_obj = {"metadata": {"name": "cp", "uid": "u"}}
+    assert (
+        object_controls.prometheus_rule(n, "state-operator-metrics", obj)
+        == State.NOT_READY
+    )
+
+
+def test_rule_deleted_midflight_is_recreated():
+    """NotFound from a racing delete retries and recreates the rule rather
+    than mislabeling it a missing-CRD skip."""
+    from tpu_operator.kube.client import NotFoundError
+
+    client = FakeClient()
+    calls = {"n": 0}
+    real = client.get_or_none
+
+    def flaky(api, kind, name, ns=""):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise NotFoundError("racing delete")
+        return real(api, kind, name, ns)
+
+    client.get_or_none = flaky
+
+    class N:
+        pass
+
+    n = N()
+    n.client = client
+    n.namespace = NS
+    n.cp_obj = {"metadata": {"name": "cp", "uid": "u"}}
+    obj = {
+        "apiVersion": "monitoring.coreos.com/v1",
+        "kind": "PrometheusRule",
+        "metadata": {"name": "x", "namespace": ""},
+        "spec": {"groups": []},
+    }
+    assert (
+        object_controls.prometheus_rule(n, "state-operator-metrics", obj)
+        == State.READY
+    )
+    assert client.get_or_none("monitoring.coreos.com/v1", "PrometheusRule", "x", NS)

@@ -191,18 +191,23 @@ def prometheus_rule(n, state_name: str, obj: Obj) -> str:
     try:
         return _generic_apply(n, state_name, obj)
     except Exception as e:
-        absent = isinstance(e, NotFoundError) or (
+        maybe_absent = isinstance(e, NotFoundError) or (
             "could not find the requested resource" in str(e)
             or "no matches for kind" in str(e)
-            or "404" in str(e)
         )
-        if absent:
-            log.warning(
-                "PrometheusRule %s skipped (monitoring CRDs absent): %s",
-                obj["metadata"].get("name"),
-                e,
-            )
-            return State.READY
+        if maybe_absent:
+            # a NotFound can also mean the rule object was deleted between
+            # read and update: retry once — that recreates it; a genuinely
+            # missing CRD fails identically again and is skipped
+            try:
+                return _generic_apply(n, state_name, obj)
+            except Exception as e2:
+                log.warning(
+                    "PrometheusRule %s skipped (monitoring CRDs absent): %s",
+                    obj["metadata"].get("name"),
+                    e2,
+                )
+                return State.READY
         log.error(
             "PrometheusRule %s apply failed: %s",
             obj["metadata"].get("name"),

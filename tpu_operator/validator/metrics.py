@@ -38,6 +38,7 @@ class NodeMetrics:
         port: int = 8000,
         install_dir: str = consts.LIBTPU_HOST_DIR,
         dev_root: str = "/dev",
+        registry=None,
     ):
         from prometheus_client import Gauge
 
@@ -47,10 +48,12 @@ class NodeMetrics:
         self.port = port
         self.install_dir = install_dir
         self.dev_root = dev_root
+        self.registry = registry  # None -> default global registry
         self._stop = threading.Event()
 
         ns = "tpu_validator"
-        mk = lambda name, doc: Gauge(f"{ns}_{name}", doc, ["node"])  # noqa: E731
+        kw = {"registry": registry} if registry is not None else {}
+        mk = lambda name, doc: Gauge(f"{ns}_{name}", doc, ["node"], **kw)  # noqa: E731
         # per-status-file readiness (reference metric defs :73-157)
         self.g_libtpu = mk("libtpu_ready", "libtpu validation status file present")
         self.g_runtime = mk("runtime_ready", "runtime validation status file present")

@@ -189,3 +189,35 @@ def test_rule_deleted_midflight_is_recreated():
         == State.READY
     )
     assert client.get_or_none("monitoring.coreos.com/v1", "PrometheusRule", "x", NS)
+
+
+def test_rule_retry_failure_with_different_error_is_not_ready():
+    """NotFound then a non-absence error on retry (e.g. RBAC) must report
+    NotReady, not a graceful CRDs-absent skip."""
+    from tpu_operator.kube.client import NotFoundError
+
+    calls = {"n": 0}
+
+    class FlakyThenForbidden:
+        def get_or_none(self, *a, **k):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise NotFoundError("racing delete")
+            raise RuntimeError("403: prometheusrules is forbidden")
+
+    class N:
+        client = FlakyThenForbidden()
+        namespace = NS
+
+    n = N()
+    n.cp_obj = {"metadata": {"name": "cp", "uid": "u"}}
+    obj = {
+        "apiVersion": "monitoring.coreos.com/v1",
+        "kind": "PrometheusRule",
+        "metadata": {"name": "x", "namespace": ""},
+        "spec": {"groups": []},
+    }
+    assert (
+        object_controls.prometheus_rule(n, "state-operator-metrics", obj)
+        == State.NOT_READY
+    )

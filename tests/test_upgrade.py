@@ -249,3 +249,147 @@ def test_upgrade_reconciler_gates(cluster, monkeypatch):
     cluster.update(cr)
     result = r.reconcile()
     assert result.requeue_after == 120.0
+
+
+def _age_node_state(client, name, seconds):
+    """Backdate the state-entry annotation to simulate an overstayed state."""
+    from datetime import datetime, timedelta, timezone
+
+    node = client.get("v1", "Node", name)
+    then = datetime.now(timezone.utc) - timedelta(seconds=seconds)
+    node["metadata"].setdefault("annotations", {})[
+        consts.UPGRADE_STATE_SINCE_ANNOTATION
+    ] = then.strftime("%Y-%m-%dT%H:%M:%SZ")
+    client.update(node)
+
+
+def test_drain_timeout_marks_failed(cluster):
+    """A node whose drain can't clear inside drain.timeoutSeconds becomes
+    upgrade-failed (terminal, cordoned) instead of wedging forever."""
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    # an unmanaged workload pod blocks drain without force
+    cluster.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "naked", "namespace": "default"},
+            "spec": {
+                "nodeName": "node-1",
+                "containers": [
+                    {"resources": {"limits": {"google.com/tpu": "4"}}}
+                ],
+            },
+        }
+    )
+    policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable="100%",
+        drain=DrainSpec(enable=True, timeout_seconds=300),
+    )
+    pump(mgr, policy, times=6)
+    assert node_state(cluster, "node-1") == us.STATE_DRAIN_REQUIRED
+    _age_node_state(cluster, "node-1", 301)
+    pump(mgr, policy, times=1)
+    assert node_state(cluster, "node-1") == us.STATE_FAILED
+    # stays cordoned for operator intervention
+    assert cluster.get("v1", "Node", "node-1")["spec"]["unschedulable"]
+    # terminal: further pumps don't move it
+    pump(mgr, policy, times=3)
+    assert node_state(cluster, "node-1") == us.STATE_FAILED
+
+
+def test_validation_timeout_marks_failed(cluster):
+    """Validator never converging fails the node after the fixed budget."""
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=1, max_unavailable="100%"
+    )
+    pump(mgr, policy, times=8)  # no validator pod exists -> stuck validating
+    assert node_state(cluster, "node-1") == us.STATE_VALIDATION_REQUIRED
+    _age_node_state(cluster, "node-1", us.VALIDATION_TIMEOUT_S + 1)
+    pump(mgr, policy, times=1)
+    assert node_state(cluster, "node-1") == us.STATE_FAILED
+
+
+def test_wait_for_jobs_timeout_proceeds(cluster):
+    """waitForCompletion.timeoutSeconds exhausted -> stop waiting, move on."""
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    cluster.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": "long-job",
+                "namespace": "default",
+                "labels": {"job-class": "batch"},
+                "ownerReferences": [{"kind": "Job", "name": "j", "uid": "u"}],
+            },
+            "spec": {"nodeName": "node-1"},
+            "status": {"phase": "Running"},
+        }
+    )
+    policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable="100%",
+        wait_for_completion={
+            "podSelector": "job-class=batch",
+            "timeoutSeconds": 600,
+        },
+    )
+    pump(mgr, policy, times=4)
+    assert node_state(cluster, "node-1") == us.STATE_WAIT_FOR_JOBS_REQUIRED
+    _age_node_state(cluster, "node-1", 601)
+    pump(mgr, policy, times=1)
+    assert node_state(cluster, "node-1") in (
+        us.STATE_POD_DELETION_REQUIRED,
+        us.STATE_DRAIN_REQUIRED,
+        us.STATE_POD_RESTART_REQUIRED,
+    )
+
+
+def test_failed_node_reenters_after_label_clear(cluster):
+    """Clearing the state label is the documented recovery path."""
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    provider = mgr.provider
+    node = cluster.get("v1", "Node", "node-1")
+    provider.set_state(node, us.STATE_FAILED)
+    provider.clear_state(node)
+    node = cluster.get("v1", "Node", "node-1")
+    assert consts.UPGRADE_STATE_LABEL not in node["metadata"]["labels"]
+    assert consts.UPGRADE_STATE_SINCE_ANNOTATION not in node["metadata"].get(
+        "annotations", {}
+    )
+    policy = UpgradePolicySpec(auto_upgrade=True, max_unavailable="100%")
+    pump(mgr, policy, times=1)
+    # stale pod -> re-enters at upgrade-required (or beyond)
+    assert node_state(cluster, "node-1") is not None
+
+
+def test_drain_timeout_applies_with_default_policy(cluster):
+    """With drain unconfigured (None) draining is still active, so the
+    DrainSpec default budget must apply — otherwise an undrainable node
+    wedges forever on default config."""
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    cluster.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "naked2", "namespace": "default"},
+            "spec": {
+                "nodeName": "node-1",
+                "containers": [
+                    {"resources": {"limits": {"google.com/tpu": "4"}}}
+                ],
+            },
+        }
+    )
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=1, max_unavailable="100%"
+    )  # drain omitted
+    pump(mgr, policy, times=6)
+    assert node_state(cluster, "node-1") == us.STATE_DRAIN_REQUIRED
+    _age_node_state(cluster, "node-1", 301)  # past DrainSpec default 300s
+    pump(mgr, policy, times=1)
+    assert node_state(cluster, "node-1") == us.STATE_FAILED

@@ -88,6 +88,9 @@ DEVICE_PLUGIN_CONFIG_LABEL = f"{GROUP}/device-plugin.config"
 
 # upgrade FSM label (reference nvidia.com/gpu-driver-upgrade-state)
 UPGRADE_STATE_LABEL = f"{GROUP}/libtpu-upgrade-state"
+# when the node entered its current FSM state (drives drain/validation
+# timeouts -> upgrade-failed)
+UPGRADE_STATE_SINCE_ANNOTATION = f"{GROUP}/libtpu-upgrade-state-since"
 UPGRADE_SKIP_DRAIN_LABEL = f"{GROUP}/libtpu-upgrade-drain.skip"
 UPGRADE_SKIP_LABEL = f"{GROUP}/libtpu-upgrade.skip"
 UPGRADE_ENABLED_ANNOTATION = f"{GROUP}/libtpu-upgrade-enabled"

@@ -188,26 +188,30 @@ def prometheus_rule(n, state_name: str, obj: Obj) -> str:
     graceful skip — anything else (RBAC, bad manifest) is NotReady."""
     from tpu_operator.kube.client import NotFoundError
 
-    try:
-        return _generic_apply(n, state_name, obj)
-    except Exception as e:
-        maybe_absent = isinstance(e, NotFoundError) or (
+    def _looks_absent(e: Exception) -> bool:
+        return isinstance(e, NotFoundError) or (
             "could not find the requested resource" in str(e)
             or "no matches for kind" in str(e)
         )
-        if maybe_absent:
+
+    try:
+        return _generic_apply(n, state_name, obj)
+    except Exception as e:
+        if _looks_absent(e):
             # a NotFound can also mean the rule object was deleted between
             # read and update: retry once — that recreates it; a genuinely
-            # missing CRD fails identically again and is skipped
+            # missing CRD fails the same way again and is skipped
             try:
                 return _generic_apply(n, state_name, obj)
             except Exception as e2:
-                log.warning(
-                    "PrometheusRule %s skipped (monitoring CRDs absent): %s",
-                    obj["metadata"].get("name"),
-                    e2,
-                )
-                return State.READY
+                if _looks_absent(e2):
+                    log.warning(
+                        "PrometheusRule %s skipped (monitoring CRDs absent): %s",
+                        obj["metadata"].get("name"),
+                        e2,
+                    )
+                    return State.READY
+                e = e2  # a different failure surfaced on retry: report it
         log.error(
             "PrometheusRule %s apply failed: %s",
             obj["metadata"].get("name"),

@@ -91,17 +91,55 @@ class NodeStateProvider:
         if labels.get(consts.UPGRADE_STATE_LABEL) == state:
             return
         labels[consts.UPGRADE_STATE_LABEL] = state
+        # stamp state entry time; timed states (drain, validation) fail the
+        # node when they overstay their budget
+        fresh["metadata"].setdefault("annotations", {})[
+            consts.UPGRADE_STATE_SINCE_ANNOTATION
+        ] = _now_iso()
         self.client.update(fresh)
         log.info(
             "node %s upgrade-state -> %s", node["metadata"]["name"], state
         )
 
+    def state_age_s(self, node: Obj) -> float:
+        """Seconds since the node entered its current state, read from the
+        caller's node object (build_state LISTed it this reconcile; only
+        set_state mutates the stamp, and minutes-granularity timeouts don't
+        justify a per-node GET). 0 when unstamped."""
+        since = (
+            node["metadata"].get("annotations", {}) or {}
+        ).get(consts.UPGRADE_STATE_SINCE_ANNOTATION, "")
+        if not since:
+            return 0.0
+        from datetime import datetime, timezone
+
+        try:
+            then = datetime.strptime(since, "%Y-%m-%dT%H:%M:%SZ").replace(
+                tzinfo=timezone.utc
+            )
+        except ValueError:
+            return 0.0
+        return (datetime.now(timezone.utc) - then).total_seconds()
+
     def clear_state(self, node: Obj) -> None:
         fresh = self.client.get("v1", "Node", node["metadata"]["name"])
         labels = fresh["metadata"].setdefault("labels", {})
+        ann = fresh["metadata"].get("annotations", {}) or {}
+        changed = False
         if consts.UPGRADE_STATE_LABEL in labels:
             del labels[consts.UPGRADE_STATE_LABEL]
+            changed = True
+        if consts.UPGRADE_STATE_SINCE_ANNOTATION in ann:
+            del ann[consts.UPGRADE_STATE_SINCE_ANNOTATION]
+            changed = True
+        if changed:
             self.client.update(fresh)
+
+
+def _now_iso() -> str:
+    from datetime import datetime, timezone
+
+    return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 
 
 class CordonManager:
@@ -246,6 +284,12 @@ def parse_max_unavailable(value, total: int) -> int:
         return total
 
 
+# validation has no per-policy knob (the validator either converges or the
+# node is wedged); generous fixed budget ~ the reference's e2e pod-ready
+# ceiling territory
+VALIDATION_TIMEOUT_S = 1800.0
+
+
 class ClusterUpgradeStateManager:
     """Orchestration (reference ``upgrade_state.go:59-110,160-212``)."""
 
@@ -356,7 +400,17 @@ class ClusterUpgradeStateManager:
             waiting = policy.wait_for_completion or {}
             selector = waiting.get("podSelector", "")
             if selector and self._jobs_running(node_name, selector):
-                continue  # stay; re-evaluated next reconcile
+                # waitForCompletion.timeoutSeconds (0/absent = wait forever):
+                # when exhausted, stop waiting and move on — the upgrade has
+                # priority over stragglers (reference wait-for-jobs budget)
+                timeout = float(waiting.get("timeoutSeconds") or 0)
+                if not timeout or self.provider.state_age_s(ns.node) < timeout:
+                    continue  # stay; re-evaluated next reconcile
+                log.warning(
+                    "node %s: wait-for-jobs budget (%ss) exhausted; proceeding",
+                    node_name,
+                    timeout,
+                )
             self.provider.set_state(ns.node, STATE_POD_DELETION_REQUIRED)
 
         for ns in state.node_states.get(STATE_POD_DELETION_REQUIRED, []):
@@ -376,6 +430,16 @@ class ClusterUpgradeStateManager:
             skip_drain = labels.get(consts.UPGRADE_SKIP_DRAIN_LABEL) == "true"
             if skip_drain or self.drain.drain(node_name, policy.drain):
                 self.provider.set_state(ns.node, STATE_POD_RESTART_REQUIRED)
+            elif self._timed_out(ns.node, self._drain_timeout(policy)):
+                # drain could not clear the node inside its budget: terminal
+                # failure, node stays cordoned for operator intervention
+                # (clearing the state label re-enters the FSM)
+                log.error(
+                    "node %s: drain exceeded %.0fs; marking upgrade-failed",
+                    node_name,
+                    self._drain_timeout(policy),
+                )
+                self.provider.set_state(ns.node, STATE_FAILED)
 
         for ns in state.node_states.get(STATE_POD_RESTART_REQUIRED, []):
             # delete the operand pod; the OnDelete DaemonSet restarts it with
@@ -391,10 +455,39 @@ class ClusterUpgradeStateManager:
             node_name = ns.node["metadata"]["name"]
             if self.validation.validate(node_name):
                 self.provider.set_state(ns.node, STATE_UNCORDON_REQUIRED)
+            elif self._timed_out(ns.node, VALIDATION_TIMEOUT_S):
+                log.error(
+                    "node %s: validation not passing after %.0fs; "
+                    "marking upgrade-failed",
+                    node_name,
+                    VALIDATION_TIMEOUT_S,
+                )
+                self.provider.set_state(ns.node, STATE_FAILED)
 
         for ns in state.node_states.get(STATE_UNCORDON_REQUIRED, []):
             self.cordon.uncordon(ns.node["metadata"]["name"])
             self.provider.set_state(ns.node, STATE_DONE)
+
+    def _timed_out(self, node: Obj, timeout_s: float) -> bool:
+        if timeout_s <= 0:
+            return False
+        age = self.provider.state_age_s(node)
+        return age > 0 and age > timeout_s
+
+    @staticmethod
+    def _drain_timeout(policy) -> float:
+        """An unconfigured drain still actively drains (DrainManager treats
+        spec None as enabled-without-force), so it gets the DrainSpec
+        default budget; only an explicitly disabled drain (enable=False,
+        which always 'succeeds') has nothing to time out."""
+        drain = getattr(policy, "drain", None)
+        if drain is None:
+            from tpu_operator.api.v1.clusterpolicy_types import DrainSpec
+
+            return float(DrainSpec().timeout_seconds)
+        if drain.enable is False:
+            return 0.0
+        return float(drain.timeout_seconds or 0)
 
     def _jobs_running(self, node_name: str, selector: str) -> bool:
         sel = {}

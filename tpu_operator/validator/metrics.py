@@ -129,7 +129,10 @@ class NodeMetrics:
         """reference ``Run`` (``validator/metrics.go:304-320``)."""
         from prometheus_client import start_http_server
 
-        start_http_server(self.port)
+        if self.registry is not None:
+            start_http_server(self.port, registry=self.registry)
+        else:
+            start_http_server(self.port)
         threads = [
             threading.Thread(target=self._watch_status_files, daemon=True),
             threading.Thread(target=self._watch_libtpu, daemon=True),

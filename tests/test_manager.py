@@ -104,3 +104,71 @@ def test_manager_stops_on_lost_leadership():
     finally:
         LeaderElector.__init__ = orig_init
         mgr.stop()
+
+
+def test_probe_debug_endpoints():
+    import json
+    import urllib.request
+
+    client = FakeClient()
+    mgr = Manager(client, NS, metrics_port=0, probe_port=0, debug_endpoints=True)
+    # bind the probe server on an ephemeral port manually (probe_port=0
+    # disables it in start()); reuse the handler class directly
+    from http.server import ThreadingHTTPServer
+
+    from tpu_operator.manager import _HealthHandler
+
+    handler = type("H", (_HealthHandler,), {"manager": mgr})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    import threading as _t
+
+    _t.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_port
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ) as r:
+                return r.read().decode()
+
+        assert get("/healthz") == "ok"
+        stacks = get("/debug/stacks")
+        assert "--- thread" in stacks and "MainThread" in stacks
+        mgr.add_reconciler("cp", lambda k: None)
+        variables = json.loads(get("/debug/vars"))
+        assert variables["reconcilers"] == ["cp"]
+        assert variables["threads"] >= 1
+    finally:
+        srv.shutdown()
+        mgr.stop()
+
+
+def test_debug_endpoints_default_off():
+    """Debug surfaces are opt-in: default manager serves 404 on /debug/*."""
+    import urllib.error
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    from tpu_operator.manager import _HealthHandler
+
+    mgr = Manager(FakeClient(), NS, metrics_port=0, probe_port=0)
+    handler = type("H", (_HealthHandler,), {"manager": mgr})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    import threading as _t
+
+    _t.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.server_port}/debug/stacks", timeout=5
+            )
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.server_port}/healthz", timeout=5
+        ) as r:
+            assert r.read() == b"ok"
+    finally:
+        srv.shutdown()
+        mgr.stop()

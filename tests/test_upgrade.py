@@ -393,3 +393,45 @@ def test_drain_timeout_applies_with_default_policy(cluster):
     _age_node_state(cluster, "node-1", 301)  # past DrainSpec default 300s
     pump(mgr, policy, times=1)
     assert node_state(cluster, "node-1") == us.STATE_FAILED
+
+
+def test_unstamped_timed_state_gets_stamped_then_times_out(cluster):
+    """A node already parked in a timed state by an older operator (label
+    present, no since-annotation) must start its clock on first sight and
+    still time out eventually."""
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    cluster.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "naked3", "namespace": "default"},
+            "spec": {
+                "nodeName": "node-1",
+                "containers": [
+                    {"resources": {"limits": {"google.com/tpu": "4"}}}
+                ],
+            },
+        }
+    )
+    # hand-write the label only (pre-upgrade operator state)
+    node = cluster.get("v1", "Node", "node-1")
+    node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] = (
+        us.STATE_DRAIN_REQUIRED
+    )
+    cluster.update(node)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable="100%",
+        drain=DrainSpec(enable=True, timeout_seconds=300),
+    )
+    pump(mgr, policy, times=1)
+    # first sight stamped the clock instead of timing out or wedging
+    node = cluster.get("v1", "Node", "node-1")
+    assert consts.UPGRADE_STATE_SINCE_ANNOTATION in node["metadata"].get(
+        "annotations", {}
+    )
+    assert node_state(cluster, "node-1") == us.STATE_DRAIN_REQUIRED
+    _age_node_state(cluster, "node-1", 301)
+    pump(mgr, policy, times=1)
+    assert node_state(cluster, "node-1") == us.STATE_FAILED

@@ -35,6 +35,12 @@ def build_args(argv=None):
     p.add_argument("--metrics-port", type=int, default=8080)
     p.add_argument("--probe-port", type=int, default=8081)
     p.add_argument("--leader-election", action="store_true")
+    p.add_argument(
+        "--debug-endpoints",
+        action="store_true",
+        default=os.environ.get("TPU_OPERATOR_DEBUG", "") == "true",
+        help="expose /debug/stacks and /debug/vars on the probe port",
+    )
     p.add_argument("--assets", default=None, help="asset dir override")
     p.add_argument(
         "--fake",
@@ -118,6 +124,7 @@ def main(argv=None) -> int:
         metrics_port=args.metrics_port,
         probe_port=args.probe_port,
         leader_election=args.leader_election,
+        debug_endpoints=args.debug_endpoints,
     )
     reconciler = ClusterPolicyReconciler(client, assets_dir=args.assets)
     mgr.add_reconciler(CP_KEY, lambda _key: reconciler.reconcile())

@@ -160,19 +160,64 @@ class LeaderElector:
 
 
 class _HealthHandler(BaseHTTPRequestHandler):
+    """Probe endpoints plus a minimal debug surface (the reference has no
+    pprof; SURVEY.md §5 suggests an optional one — /debug/stacks is the
+    Python equivalent of pprof's goroutine dump, /debug/vars mirrors
+    expvar)."""
+
     manager: "Manager" = None
 
     def do_GET(self):  # noqa: N802
+        if self.path.startswith("/debug/"):
+            # opt-in only: stack traces/internals on a pod-network-reachable
+            # port are an information-disclosure surface
+            if self.manager is None or not self.manager.debug_endpoints:
+                self._respond(404, "debug endpoints disabled\n")
+                return
+        if self.path.startswith("/debug/stacks"):
+            self._respond(200, _dump_stacks(), "text/plain")
+            return
+        if self.path.startswith("/debug/vars"):
+            import json
+
+            m = self.manager
+            body = json.dumps(
+                {
+                    "queue_len": len(m.queue) if m else 0,
+                    "threads": threading.active_count(),
+                    "reconcilers": sorted(m._reconcilers) if m else [],
+                    "last_reconcile_ok": m._last_reconcile_ok if m else None,
+                }
+            )
+            self._respond(200, body, "application/json")
+            return
         healthy = self.manager is None or self.manager.healthy()
-        code = 200 if healthy else 500
-        body = b"ok" if healthy else b"unhealthy"
+        self._respond(
+            200 if healthy else 500, "ok" if healthy else "unhealthy"
+        )
+
+    def _respond(self, code, body, ctype="text/plain"):
+        data = body.encode() if isinstance(body, str) else body
         self.send_response(code)
-        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
         self.end_headers()
-        self.wfile.write(body)
+        self.wfile.write(data)
 
     def log_message(self, *a):  # silence
         pass
+
+
+def _dump_stacks() -> str:
+    import sys
+    import traceback
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+    return "\n".join(out) + "\n"
 
 
 class Manager:
@@ -185,12 +230,14 @@ class Manager:
         metrics_port: int = 8080,
         probe_port: int = 8081,
         leader_election: bool = False,
+        debug_endpoints: bool = False,
     ):
         self.client = client
         self.namespace = namespace
         self.metrics_port = metrics_port
         self.probe_port = probe_port
         self.leader_election = leader_election
+        self.debug_endpoints = debug_endpoints
         self.queue = WorkQueue()
         self.rate_limiter = RateLimiter()
         self._reconcilers = {}

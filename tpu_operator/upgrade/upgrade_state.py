@@ -121,6 +121,26 @@ class NodeStateProvider:
             return 0.0
         return (datetime.now(timezone.utc) - then).total_seconds()
 
+    def stamp_now(self, node: Obj) -> None:
+        """(Re)write the state-entry timestamp for a node whose stamp is
+        missing or unreadable."""
+        try:
+            fresh = self.client.get("v1", "Node", node["metadata"]["name"])
+        except Exception:
+            log.exception(
+                "failed to stamp node %s", node["metadata"]["name"]
+            )
+            return
+        fresh["metadata"].setdefault("annotations", {})[
+            consts.UPGRADE_STATE_SINCE_ANNOTATION
+        ] = _now_iso()
+        try:
+            self.client.update(fresh)
+        except Exception:
+            log.exception(
+                "failed to stamp node %s", node["metadata"]["name"]
+            )
+
     def clear_state(self, node: Obj) -> None:
         fresh = self.client.get("v1", "Node", node["metadata"]["name"])
         labels = fresh["metadata"].setdefault("labels", {})
@@ -404,7 +424,7 @@ class ClusterUpgradeStateManager:
                 # when exhausted, stop waiting and move on — the upgrade has
                 # priority over stragglers (reference wait-for-jobs budget)
                 timeout = float(waiting.get("timeoutSeconds") or 0)
-                if not timeout or self.provider.state_age_s(ns.node) < timeout:
+                if not self._timed_out(ns.node, timeout):
                     continue  # stay; re-evaluated next reconcile
                 log.warning(
                     "node %s: wait-for-jobs budget (%ss) exhausted; proceeding",
@@ -472,7 +492,13 @@ class ClusterUpgradeStateManager:
         if timeout_s <= 0:
             return False
         age = self.provider.state_age_s(node)
-        return age > 0 and age > timeout_s
+        if age <= 0:
+            # no/invalid stamp (node entered this state under an older
+            # operator, or the annotation was hand-edited): start the clock
+            # now so the timeout still eventually fires instead of never
+            self.provider.stamp_now(node)
+            return False
+        return age > timeout_s
 
     @staticmethod
     def _drain_timeout(policy) -> float:

@@ -207,3 +207,87 @@ def test_validator_init_containers_use_validator_image(monkeypatch):
     assert val_inits
     for c in val_inits:
         assert c["image"] == "registry.example/val/tpu-operator-validator:3.3.3"
+
+
+def test_proxy_and_trusted_ca_injection(monkeypatch):
+    """Cluster-wide proxy env + trusted-CA bundle reach every libtpu
+    container (reference ``applyOCPProxySpec`` + trusted-CA mount,
+    ``controllers/object_controls.go:907-1050``)."""
+    cr = load_cr()
+    cr["spec"].setdefault("operator", {})["proxy"] = {
+        "httpsProxy": "https://proxy.corp:3128",
+        "noProxy": "10.0.0.0/8,.googleapis.com",
+        "trustedCaConfigMap": "corp-ca-bundle",
+    }
+    client = reconcile_with(cr, monkeypatch)
+    ds = get_ds(client, "tpu-libtpu-daemonset")
+    pod_spec = ds["spec"]["template"]["spec"]
+    containers = pod_spec.get("initContainers", []) + pod_spec["containers"]
+    for c in containers:
+        env = {e["name"]: e.get("value") for e in c.get("env", [])}
+        assert env.get("HTTPS_PROXY") == "https://proxy.corp:3128"
+        assert env.get("https_proxy") == "https://proxy.corp:3128"
+        assert env.get("NO_PROXY") == "10.0.0.0/8,.googleapis.com"
+        assert "HTTP_PROXY" not in env  # unset values stay unset
+        mounts = {m["name"]: m for m in c.get("volumeMounts", [])}
+        assert mounts["tpu-operator-trusted-ca"]["mountPath"] == (
+            consts.TRUSTED_CA_MOUNT_DIR
+        )
+        assert env.get("TRUSTED_CA_BUNDLE", "").endswith("ca-bundle.crt")
+    vols = {v["name"]: v for v in pod_spec.get("volumes", [])}
+    assert vols["tpu-operator-trusted-ca"]["configMap"]["name"] == "corp-ca-bundle"
+    # other operands don't reach the network: no proxy env there
+    plugin = get_ds(client, "tpu-device-plugin-daemonset")
+    plugin_env = [
+        e["name"]
+        for c in plugin["spec"]["template"]["spec"]["containers"]
+        for e in c.get("env", [])
+    ]
+    assert "HTTPS_PROXY" not in plugin_env
+
+
+def test_libtpu_repo_and_cert_config_mounts(monkeypatch):
+    """Custom artifact-source + CA-cert ConfigMaps mount into the installer
+    (reference driver repoConfig/certConfig, ``object_controls.go:2770-2830``)."""
+    cr = load_cr()
+    cr["spec"]["libtpu"]["repoConfig"] = {"configMapName": "libtpu-mirror"}
+    cr["spec"]["libtpu"]["certConfig"] = {"name": "libtpu-certs"}
+    client = reconcile_with(cr, monkeypatch)
+    ds = get_ds(client, "tpu-libtpu-daemonset")
+    main = next(
+        c
+        for c in ds["spec"]["template"]["spec"]["containers"]
+        if c["name"] == "libtpu-ctr"
+    )
+    mounts = {m["name"]: m["mountPath"] for m in main.get("volumeMounts", [])}
+    assert mounts["libtpu-repo-config"] == consts.LIBTPU_REPO_CONFIG_DIR
+    assert mounts["libtpu-cert-config"] == consts.LIBTPU_CERT_CONFIG_DIR
+    vols = {v["name"]: v["configMap"]["name"] for v in
+            ds["spec"]["template"]["spec"]["volumes"] if "configMap" in v}
+    assert vols["libtpu-repo-config"] == "libtpu-mirror"
+    assert vols["libtpu-cert-config"] == "libtpu-certs"
+
+
+def test_membw_validation_opt_in(monkeypatch):
+    """validator.membw.enabled appends the HBM-bandwidth initContainer after
+    jax-validation; off by default."""
+    cr = load_cr()
+    client = reconcile_with(cr, monkeypatch)
+    ds = get_ds(client, "tpu-operator-validator")
+    names = [c["name"] for c in ds["spec"]["template"]["spec"]["initContainers"]]
+    assert "membw-validation" not in names
+
+    cr = load_cr()
+    cr["spec"]["validator"]["membw"] = {
+        "enabled": True,
+        "env": [{"name": "MEMBW_MIN_UTILIZATION", "value": "0.4"}],
+    }
+    client = reconcile_with(cr, monkeypatch)
+    ds = get_ds(client, "tpu-operator-validator")
+    inits = ds["spec"]["template"]["spec"]["initContainers"]
+    names = [c["name"] for c in inits]
+    assert names.index("membw-validation") == names.index("jax-validation") + 1
+    membw = inits[names.index("membw-validation")]
+    assert membw["args"] == ["tpu-validator --component membw"]
+    env = {e["name"]: e.get("value") for e in membw.get("env", [])}
+    assert env.get("MEMBW_MIN_UTILIZATION") == "0.4"

@@ -238,3 +238,24 @@ def test_cli_component_libtpu(tmp_path, monkeypatch):
         ]
     )
     assert rc == 1
+
+
+def test_validate_membw_cpu(status):
+    info = comp.validate_membw(status, expect_tpu=False, size_mb=2)
+    assert info["ok"] and info["integrity"]
+    assert status.exists("membw-ready")
+
+
+def test_validate_membw_utilization_gate(status, monkeypatch):
+    """Below-threshold bandwidth must fail validation (sick-HBM detection)."""
+    from tpu_operator.workloads import membw as membw_mod
+
+    sick = membw_mod.MemBwResult(
+        ok=True, device_kind="TPU v5 lite", platform="tpu", size_mb=2048,
+        iters=16, elapsed_s=1.0, gbps=100.0, copy_gbps=100.0,
+        stream_gbps=90.0, peak_gbps=819.0, utilization=100.0 / 819.0,
+        integrity=True,
+    )
+    monkeypatch.setattr(membw_mod, "run_membw_probe", lambda **kw: sick)
+    with pytest.raises(comp.ValidationError, match="below"):
+        comp.validate_membw(status, expect_tpu=True, min_utilization=0.5)

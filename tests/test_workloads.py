@@ -77,3 +77,36 @@ def test_burnin_single_device():
     res = run_burnin(n_devices=1, steps=5, batch=8, d_model=16, d_hidden=32)
     assert res.ok, res.error
     assert res.mesh_shape == (1, 1)
+
+
+def test_membw_probe_cpu_interpret():
+    """The pallas copy kernel runs (interpreted) off-TPU: semantics check."""
+    from tpu_operator.workloads.membw import run_membw_probe
+
+    res = run_membw_probe(size_mb=2, iters=2, expect_tpu=False)
+    assert res.ok, res.error
+    assert res.integrity
+    assert res.copy_gbps > 0 and res.stream_gbps > 0
+    assert res.gbps == max(res.copy_gbps, res.stream_gbps)
+
+
+def test_membw_expect_tpu_fails_on_cpu():
+    from tpu_operator.workloads.membw import run_membw_probe
+
+    res = run_membw_probe(size_mb=2, iters=1, expect_tpu=True)
+    assert not res.ok
+    assert "expected TPU" in res.error
+
+
+def test_membw_copy_kernel_exact():
+    """Bit-exactness of the interpreted pallas copy on a full small buffer."""
+    import numpy as np
+
+    from tpu_operator.workloads.membw import LANES, make_copy_fn
+
+    rows = 8
+    fn = make_copy_fn(rows, block_rows=4, interpret=True)
+    x = jax.numpy.arange(rows * LANES, dtype=jax.numpy.float32).reshape(
+        rows, LANES
+    )
+    assert np.array_equal(np.asarray(fn(x)), np.asarray(x))

@@ -223,6 +223,22 @@ class InitContainerSpec(_ImageSpec):
 
 
 @dataclass
+class ProxySpec(SpecBase):
+    """Cluster-wide egress proxy for operands that reach the network
+    (reference ``applyOCPProxySpec``, ``controllers/object_controls.go:907-960``
+    — there read from the OpenShift ``Proxy`` cluster object; here declared
+    on the CR directly since GKE has no such object)."""
+
+    http_proxy: str = ""
+    https_proxy: str = ""
+    no_proxy: str = ""
+    # ConfigMap (operator namespace) holding ``ca-bundle.crt`` with the
+    # proxy's trusted CA chain (reference trusted-CA mount,
+    # ``controllers/object_controls.go:962-1050``)
+    trusted_ca_config_map: str = ""
+
+
+@dataclass
 class OperatorSpec(SpecBase):
     """Operator-level knobs (reference ``OperatorSpec``)."""
 
@@ -232,6 +248,7 @@ class OperatorSpec(SpecBase):
     init_container: InitContainerSpec = field(default_factory=InitContainerSpec)
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
+    proxy: Optional[ProxySpec] = None
 
 
 @dataclass
@@ -313,6 +330,13 @@ class LibtpuSpec(_ImageSpec):
     # drives one DaemonSet per generation (reference per-kernel fan-out,
     # controllers/object_controls.go:3405-3441).
     generation_configs: Dict[str, str] = field(default_factory=dict)
+    # custom artifact-source config mounted into the installer (reference
+    # driver ``repoConfig`` {configMapName}, ``object_controls.go:2770-2800``:
+    # there it is apt/yum repo lists; here libtpu mirror/endpoint config)
+    repo_config: Dict[str, str] = field(default_factory=dict)
+    # extra CA certificates for the installer's download endpoint (reference
+    # driver ``certConfig`` {name}, ``object_controls.go:2802-2830``)
+    cert_config: Dict[str, str] = field(default_factory=dict)
     upgrade_policy: Optional[UpgradePolicySpec] = None
     rolling_update: Optional[RollingUpdateSpec] = None
     startup_probe: Optional[Dict[str, Any]] = None
@@ -497,6 +521,11 @@ class ValidatorSpec(_ImageSpec):
     jax: Optional[Dict[str, Any]] = None
     libtpu: Optional[Dict[str, Any]] = None
     runtime: Optional[Dict[str, Any]] = None
+    # optional deep diagnostic: HBM bandwidth probe ({"enabled": true,
+    # "env": [...]}) appended to the validation chain — the reference's
+    # ``dcgmi diag`` memory-bandwidth analogue, off by default because it
+    # holds the chip for a few extra seconds per validation pass
+    membw: Optional[Dict[str, Any]] = None
 
     ENV_VAR = "TPU_VALIDATOR_IMAGE"
 

@@ -127,6 +127,13 @@ LIBTPU_HOST_DIR = "/home/kubernetes/lib/tpu"
 DEVICE_GLOB = "/dev/accel*"
 VFIO_DIR = "/dev/vfio"
 
+# proxy trusted-CA + libtpu artifact-source mounts (reference trusted-CA
+# mount dir + driver repo/cert config mounts, object_controls.go:962-1050,
+# 2770-2830)
+TRUSTED_CA_MOUNT_DIR = "/etc/pki/tpu-operator/trusted-ca"
+LIBTPU_REPO_CONFIG_DIR = "/etc/libtpu/repo.d"
+LIBTPU_CERT_CONFIG_DIR = "/etc/libtpu/certs.d"
+
 # --- misc --------------------------------------------------------------
 OPERATOR_NAMESPACE_ENV = "OPERATOR_NAMESPACE"
 DEFAULT_NAMESPACE = "tpu-operator"

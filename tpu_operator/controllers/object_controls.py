@@ -478,6 +478,54 @@ def _apply_resources(container: Obj, spec) -> None:
         }
 
 
+def _mount_config_map(
+    ds: Obj, container: Obj, cm_name: str, volume_name: str, mount_path: str
+) -> None:
+    """Idempotently mount a ConfigMap volume into one container."""
+    vols = ds["spec"]["template"]["spec"].setdefault("volumes", [])
+    if not any(v.get("name") == volume_name for v in vols):
+        vols.append({"name": volume_name, "configMap": {"name": cm_name}})
+    mounts = container.setdefault("volumeMounts", [])
+    if not any(m.get("name") == volume_name for m in mounts):
+        mounts.append(
+            {"name": volume_name, "mountPath": mount_path, "readOnly": True}
+        )
+
+
+def _apply_proxy(n, ds: Obj) -> None:
+    """Inject cluster-wide proxy env + trusted-CA bundle into every container
+    of a network-reaching operand (reference ``applyOCPProxySpec``,
+    ``controllers/object_controls.go:907-1050``)."""
+    proxy = n.cp.spec.operator.proxy
+    if proxy is None:
+        return
+    env_pairs = [
+        ("HTTPS_PROXY", proxy.https_proxy),
+        ("HTTP_PROXY", proxy.http_proxy),
+        ("NO_PROXY", proxy.no_proxy),
+    ]
+    for c in _all_containers(ds):
+        for name, value in env_pairs:
+            if value:
+                # both spellings: tooling disagrees on case
+                _set_container_env(c, name, value)
+                _set_container_env(c, name.lower(), value)
+    if proxy.trusted_ca_config_map:
+        for c in _all_containers(ds):
+            _mount_config_map(
+                ds,
+                c,
+                proxy.trusted_ca_config_map,
+                "tpu-operator-trusted-ca",
+                consts.TRUSTED_CA_MOUNT_DIR,
+            )
+            _set_container_env(
+                c,
+                "TRUSTED_CA_BUNDLE",
+                consts.TRUSTED_CA_MOUNT_DIR + "/ca-bundle.crt",
+            )
+
+
 def _transform_validation_init_containers(n, ds: Obj) -> None:
     """Point ``*-validation`` initContainers at the validator image
     (reference ``transformValidatorShared``/initContainer injection,
@@ -520,6 +568,19 @@ def transform_libtpu(n, ds: Obj, generation: Optional[str] = None) -> None:
         main["livenessProbe"] = spec.liveness_probe
     if spec.readiness_probe:
         main["readinessProbe"] = spec.readiness_probe
+    # custom artifact source + CA certs (reference repoConfig/certConfig,
+    # ``controllers/object_controls.go:2770-2830``) and cluster-wide proxy
+    if spec.repo_config.get("configMapName"):
+        _mount_config_map(
+            ds, main, spec.repo_config["configMapName"],
+            "libtpu-repo-config", consts.LIBTPU_REPO_CONFIG_DIR,
+        )
+    if spec.cert_config.get("name"):
+        _mount_config_map(
+            ds, main, spec.cert_config["name"],
+            "libtpu-cert-config", consts.LIBTPU_CERT_CONFIG_DIR,
+        )
+    _apply_proxy(n, ds)
     # libtpu-manager drain knobs from the upgrade policy
     mgr = next(
         (
@@ -603,12 +664,30 @@ def transform_validator(n, ds: Obj, generation: Optional[str] = None) -> None:
     main = _apply_operand_image(n, ds, spec, "tpu-operator-validator")
     _merge_env(main, spec.env)
     _apply_resources(main, spec)
-    for c in ds["spec"]["template"]["spec"].get("initContainers", []):
+    inits = ds["spec"]["template"]["spec"].setdefault("initContainers", [])
+    if (spec.membw or {}).get("enabled") and not any(
+        c["name"] == "membw-validation" for c in inits
+    ):
+        # optional deep diagnostic appended after jax-validation (the chip
+        # is already proven free); dcgmi-diag memory-bandwidth analogue.
+        # The container is cloned from jax-validation — without it (custom
+        # assets) there is nothing sane to clone, so skip.
+        jax_idx = next(
+            (i for i, c in enumerate(inits) if c["name"] == "jax-validation"),
+            None,
+        )
+        if jax_idx is not None:
+            membw_ctr = copy.deepcopy(inits[jax_idx])
+            membw_ctr["name"] = "membw-validation"
+            membw_ctr["args"] = ["tpu-validator --component membw"]
+            inits.insert(jax_idx + 1, membw_ctr)
+    for c in inits:
         component_env = {
             "plugin-validation": spec.plugin,
             "jax-validation": spec.jax,
             "libtpu-validation": spec.libtpu,
             "runtime-validation": spec.runtime,
+            "membw-validation": spec.membw,
         }.get(c["name"])
         for e in (component_env or {}).get("env", []) or []:
             _set_container_env(c, e["name"], e["value"])

@@ -174,9 +174,12 @@ class _HealthHandler(BaseHTTPRequestHandler):
             if self.manager is None or not self.manager.debug_endpoints:
                 self._respond(404, "debug endpoints disabled\n")
                 return
-        if self.path.startswith("/debug/stacks"):
-            self._respond(200, _dump_stacks(), "text/plain")
-            return
+            if self.path.startswith("/debug/stacks"):
+                self._respond(200, _dump_stacks(), "text/plain")
+                return
+            if not self.path.startswith("/debug/vars"):
+                self._respond(404, "no such debug endpoint\n")
+                return
         if self.path.startswith("/debug/vars"):
             import json
 

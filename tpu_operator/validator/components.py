@@ -296,6 +296,40 @@ def validate_ici(
 
 
 # ---------------------------------------------------------------------------
+# membw component (HBM bandwidth probe — DCGM-diagnostic analogue)
+# ---------------------------------------------------------------------------
+
+
+def validate_membw(
+    status: StatusFiles,
+    expect_tpu: bool = True,
+    min_utilization: float = 0.5,
+    size_mb: int = 2048,
+) -> dict:
+    """Deep hardware diagnostic: achieved HBM streaming bandwidth via the
+    pallas DMA memcpy + XLA stream probes (``workloads/membw.py``). A sick
+    HBM stack shows a bandwidth cliff long before it corrupts training —
+    the reference gets this from ``dcgmi diag`` memory-bandwidth runs."""
+    from tpu_operator.workloads.membw import run_membw_probe
+
+    res = run_membw_probe(size_mb=size_mb, expect_tpu=expect_tpu)
+    if not res.ok:
+        raise ValidationError(f"membw probe failed: {res.error}")
+    if (
+        expect_tpu
+        and res.utilization is not None
+        and res.utilization < min_utilization
+    ):
+        raise ValidationError(
+            f"HBM bandwidth {res.gbps:.0f} GB/s is below "
+            f"{min_utilization:.0%} of the {res.peak_gbps:.0f} GB/s spec "
+            f"for {res.device_kind}"
+        )
+    status.write("membw-ready", res.to_dict())
+    return res.to_dict()
+
+
+# ---------------------------------------------------------------------------
 # vfio-pci component (reference validator/main.go:1301-1501, go-nvlib PCI)
 # ---------------------------------------------------------------------------
 

@@ -24,6 +24,7 @@ COMPONENTS = (
     "jax",
     "slice",
     "ici",
+    "membw",
     "vfio-pci",
     "nodestatus",
 )
@@ -71,6 +72,12 @@ def build_parser():
     p.add_argument("--sysfs", default="/sys/bus/pci/devices")
     p.add_argument("--metrics-port", type=int, default=8000)
     p.add_argument("--matmul-size", type=int, default=4096)
+    p.add_argument(
+        "--membw-min-utilization",
+        type=float,
+        default=float(os.environ.get("MEMBW_MIN_UTILIZATION", "0.5")),
+        help="fail membw validation below this fraction of spec HBM bandwidth",
+    )
     p.add_argument(
         "--expect-devices",
         type=int,
@@ -137,6 +144,12 @@ def main(argv=None) -> int:
         elif args.component == "ici":
             info = comp.validate_ici(
                 status, expect_devices=args.expect_devices
+            )
+        elif args.component == "membw":
+            info = comp.validate_membw(
+                status,
+                expect_tpu=not args.allow_cpu,
+                min_utilization=args.membw_min_utilization,
             )
         elif args.component == "vfio-pci":
             info = comp.validate_vfio_pci(status, sysfs=args.sysfs)

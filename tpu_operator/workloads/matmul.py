@@ -23,7 +23,6 @@ TPU-first design notes:
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass
 from typing import Optional
 
@@ -149,14 +148,12 @@ def run_matmul_validation(
             # PJRT platforms (block_until_ready can no-op over a tunnel)
             return float(jnp.sum(x.astype(jnp.float32)))
 
-        # warmup/compile + sync
-        force(fn(a, b))
-        t0 = time.perf_counter()
-        x = a
-        for _ in range(iters):
-            x = fn(x, b)  # serial chain: each dispatch depends on the last
-        force(x)
-        elapsed = time.perf_counter() - t0
+        # serial chain (each dispatch depends on the last), fixed
+        # sync/fetch overhead cancelled — see workloads/timing.py
+        from tpu_operator.workloads.timing import chain_per_iter_seconds
+
+        per_iter = chain_per_iter_seconds(lambda v: fn(v, b), a, force, iters)
+        elapsed = per_iter * iters
 
         flops = 2.0 * size * size * size * depth * iters
         tflops = flops / elapsed / 1e12

@@ -1,0 +1,232 @@
+"""HBM bandwidth probe — a Pallas streaming-copy kernel.
+
+The reference's deep hardware diagnostics live in DCGM (``dcgmi diag`` run
+levels include a memory-bandwidth test; the operator wires DCGM in
+``assets/state-dcgm/`` and ``controllers/object_controls.go:1441-1495``).
+The TPU analogue measures achieved HBM streaming bandwidth and compares it
+against the chip generation's spec sheet — a sick HBM stack shows up as a
+bandwidth cliff long before it corrupts training.
+
+TPU-first design notes:
+* the kernel is a grid-pipelined identity copy: each grid step Pallas
+  DMAs one ``(block_rows, LANES)`` tile HBM→VMEM and writes it back
+  VMEM→HBM, double-buffering automatically, so the measured time is pure
+  HBM streaming (read + write) with compute fully hidden;
+* blocks are f32 ``(32, 16384)`` = 2 MiB — long sequential DMAs that
+  saturate the HBM controller while the pipeline's working set (in + out,
+  double-buffered = 4 blocks = 8 MiB) stays inside the ~16 MiB/core VMEM
+  budget;
+* everything is statically shaped; iterations chain serially under jit
+  dispatches and synchronize with one scalar fetch, the same
+  fixed-overhead-cancelling delta timing as the matmul validation
+  (``workloads/matmul.py``).
+
+Off-TPU the kernel runs in Pallas interpreter mode on tiny shapes — tests
+validate kernel semantics anywhere; the bandwidth number only means
+something on real hardware.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from tpu_operator.workloads.matmul import device_generation
+from tpu_operator.workloads.topology import PEAK_HBM_GBPS
+
+LANES = 16384  # 128 lanes × 128: wide rows so each DMA is long and sequential
+
+
+@dataclass
+class MemBwResult:
+    ok: bool
+    device_kind: str
+    platform: str
+    size_mb: float
+    iters: int
+    elapsed_s: float
+    gbps: float  # best achieved HBM throughput (max of the two probes)
+    copy_gbps: float = 0.0  # pallas DMA-engine memcpy
+    stream_gbps: float = 0.0  # XLA fused elementwise stream
+    peak_gbps: Optional[float] = None
+    utilization: Optional[float] = None
+    integrity: bool = False
+    error: str = ""
+
+    def to_dict(self):
+        return {
+            "ok": self.ok,
+            "device_kind": self.device_kind,
+            "platform": self.platform,
+            "size_mb": round(self.size_mb, 1),
+            "iters": self.iters,
+            "elapsed_s": round(self.elapsed_s, 6),
+            "gbps": round(self.gbps, 1),
+            "copy_gbps": round(self.copy_gbps, 1),
+            "stream_gbps": round(self.stream_gbps, 1),
+            "peak_gbps": self.peak_gbps,
+            "utilization": round(self.utilization, 4)
+            if self.utilization is not None
+            else None,
+            "integrity": self.integrity,
+            "error": self.error,
+        }
+
+
+def make_copy_fn(rows: int, block_rows: int, interpret: bool = False):
+    """Build the jitted streaming copy: ``(rows, LANES)`` f32 moved through
+    VMEM one ``(block_rows, LANES)`` tile per grid step."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if rows % block_rows:
+        raise ValueError(f"rows={rows} not a multiple of block_rows={block_rows}")
+
+    def kernel(in_ref, out_ref):
+        out_ref[...] = in_ref[...]
+
+    def copy(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
+            grid=(rows // block_rows,),
+            in_specs=[pl.BlockSpec((block_rows, LANES), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((block_rows, LANES), lambda i: (i, 0)),
+            interpret=interpret,
+        )(x)
+
+    return jax.jit(copy)
+
+
+def make_dma_copy_fn(rows: int, n_chunks: int = 8):
+    """Build the jitted raw-DMA copy: the whole ``(rows, LANES)`` buffer is
+    moved HBM→HBM by ``n_chunks`` concurrently-outstanding DMAs (one per
+    chunk, per-chunk semaphores), bypassing VMEM entirely — this measures
+    the DMA engines, the closest thing the chip has to ``memcpy``."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if rows % n_chunks:
+        raise ValueError(f"rows={rows} not a multiple of n_chunks={n_chunks}")
+    chunk = rows // n_chunks
+
+    def kernel(in_ref, out_ref):
+        def body(sems):
+            for i in range(n_chunks):  # static unroll: all DMAs in flight
+                pltpu.make_async_copy(
+                    in_ref.at[pl.ds(i * chunk, chunk), :],
+                    out_ref.at[pl.ds(i * chunk, chunk), :],
+                    sems.at[i],
+                ).start()
+            for i in range(n_chunks):
+                pltpu.make_async_copy(
+                    in_ref.at[pl.ds(i * chunk, chunk), :],
+                    out_ref.at[pl.ds(i * chunk, chunk), :],
+                    sems.at[i],
+                ).wait()
+
+        pl.run_scoped(body, sems=pltpu.SemaphoreType.DMA((n_chunks,)))
+
+    def copy(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+            compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        )(x)
+
+    return jax.jit(copy)
+
+
+def run_membw_probe(
+    size_mb: int = 2048,
+    block_rows: int = 32,
+    iters: int = 16,
+    expect_tpu: bool = False,
+) -> MemBwResult:
+    """Measure achieved HBM bandwidth on one chip, two ways:
+
+    * ``copy_gbps`` — the pallas raw-DMA memcpy (DMA engines, HBM→HBM);
+    * ``stream_gbps`` — an XLA fused elementwise pass (read + write through
+      the VPU, the pattern every activation/optimizer op hits).
+
+    ``gbps``/``utilization`` report the better of the two: a healthy stack
+    must sustain near-spec on at least one path, and which one degrades
+    tells you where the sickness is.
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+    except Exception as e:  # pragma: no cover
+        return MemBwResult(False, "", "", size_mb, iters, 0.0, 0.0, error=str(e))
+
+    try:
+        devices = jax.devices()
+        if not devices:
+            raise RuntimeError("jax.devices() is empty")
+        dev = devices[0]
+        platform = dev.platform
+        if expect_tpu and platform != "tpu":
+            raise RuntimeError(f"expected TPU, found platform={platform}")
+        on_tpu = platform == "tpu"
+
+        bytes_per_row = LANES * 4
+        align = 8 * block_rows  # keep rows divisible by block_rows and DMA chunks
+        rows = max(align, (size_mb * (1 << 20)) // bytes_per_row)
+        rows -= rows % align
+        buf_bytes = rows * bytes_per_row
+
+        copy_fn = (
+            make_dma_copy_fn(rows, n_chunks=8)
+            if on_tpu
+            else make_copy_fn(rows, block_rows, interpret=True)
+        )
+        stream_fn = jax.jit(lambda v: v + 1.0)
+        key = jax.random.PRNGKey(7)
+        x = jax.random.normal(key, (rows, LANES), dtype=jnp.float32)
+
+        # integrity: the copy must be bit-exact over the WHOLE buffer — a
+        # corner probe would miss corruption in 7 of the 8 DMA chunks; the
+        # comparison runs on-device and fetches one boolean
+        y = copy_fn(x)
+        integrity = bool(jax.device_get(jnp.array_equal(x, y)))
+        if not integrity:
+            raise RuntimeError("copy integrity check failed: HBM readback mismatch")
+
+        def force(v):
+            return float(jnp.sum(v[0, :128]))
+
+        from tpu_operator.workloads.timing import chain_per_iter_seconds
+
+        moved = 2.0 * buf_bytes  # each pass reads + writes the buffer once
+        copy_per_iter = chain_per_iter_seconds(copy_fn, x, force, iters)
+        copy_gbps = moved / copy_per_iter / 1e9
+        stream_per_iter = chain_per_iter_seconds(stream_fn, x, force, iters)
+        stream_gbps = moved / stream_per_iter / 1e9
+
+        gbps = max(copy_gbps, stream_gbps)
+        gen = device_generation(dev.device_kind)
+        peak = PEAK_HBM_GBPS.get(gen) if gen else None
+        util = gbps / peak if peak else None
+        return MemBwResult(
+            ok=True,
+            device_kind=dev.device_kind,
+            platform=platform,
+            size_mb=buf_bytes / (1 << 20),
+            iters=iters,
+            elapsed_s=(copy_per_iter + stream_per_iter) * iters,
+            gbps=gbps,
+            copy_gbps=copy_gbps,
+            stream_gbps=stream_gbps,
+            peak_gbps=peak,
+            utilization=util,
+            integrity=integrity,
+        )
+    except Exception as e:
+        return MemBwResult(
+            False, "", "", size_mb, iters, 0.0, 0.0, error=str(e)
+        )

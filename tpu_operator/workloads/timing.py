@@ -1,0 +1,56 @@
+"""Fixed-overhead-cancelling throughput timing, shared by the validation
+workloads (``matmul.py``, ``membw.py``).
+
+The only reliable completion barrier on remote/tunneled PJRT platforms is a
+scalar fetch, and that round-trip can rival the measured work itself. Three
+per-iteration estimators are combined:
+
+* the plain mean ``t(iters)/iters`` — includes the overhead, biased high;
+* the zero-length-subtracted mean ``(t(iters) - t(0))/iters`` — the
+  overhead measured directly;
+* the two-length delta ``(t(iters) - t(lo))/(iters - lo)`` — every cost
+  that does not scale with iterations cancelled algebraically.
+
+The median of the three is robust to any single measurement being polluted
+by tunnel jitter, and cannot exceed the plain mean by more than the honest
+overhead correction.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+
+def chain_per_iter_seconds(step: Callable, x, force: Callable, iters: int) -> float:
+    """Seconds per iteration of the serial chain ``v = step(v)``, fixed
+    overhead (dispatch + completion fetch) cancelled.
+
+    ``step`` must make each dispatch depend on the previous one (so device
+    work can't overlap across iterations) and ``force`` must block until
+    ``v`` is fully materialized (e.g. a scalar fetch).
+    """
+
+    def timed(n: int) -> float:
+        t0 = time.perf_counter()
+        v = x
+        for _ in range(n):
+            v = step(v)
+        force(v)
+        return time.perf_counter() - t0
+
+    force(step(x))  # warmup (compile + first execution)
+    t_zero = timed(0)  # pure sync/fetch round-trip
+    t_full = timed(iters)
+    candidates = [t_full / iters]
+    sub0 = (t_full - t_zero) / iters
+    if sub0 > 0:
+        candidates.append(sub0)
+    lo = max(1, iters // 4)
+    if iters > lo:
+        t_lo = timed(lo)
+        delta = (t_full - t_lo) / (iters - lo)
+        if delta > 0:
+            candidates.append(delta)
+    candidates.sort()
+    return candidates[len(candidates) // 2]

@@ -33,6 +33,9 @@ PEAK_BF16_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
 # HBM GiB per chip
 HBM_GB = {"v4": 32, "v5e": 16, "v5p": 95, "v6e": 32}
 
+# HBM bandwidth GB/s per chip (public spec sheets)
+PEAK_HBM_GBPS = {"v4": 1228.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1640.0}
+
 
 def parse_topology(topology: str) -> Tuple[int, ...]:
     """``"2x2x4"`` -> ``(2, 2, 4)``."""

@@ -2,7 +2,8 @@
 
 .PHONY: all native test crd bundle validate lint clean dev-run docker-build
 
-IMAGE ?= gcr.io/tpu-operator/tpu-operator:0.1.0
+include versions.mk
+IMAGE ?= $(REGISTRY)/tpu-operator:$(VERSION)
 
 all: native crd bundle
 
@@ -31,6 +32,9 @@ validate:
 docker-build:
 	docker build -f docker/Dockerfile -t $(IMAGE) .
 	docker build -f docker/Dockerfile.jax-validator -t $(IMAGE)-jax-validator .
+	docker build -f docker/bundle.Dockerfile \
+	  --build-arg VERSION=$(VERSION) --build-arg GIT_COMMIT=$(GIT_COMMIT) \
+	  -t $(REGISTRY)/tpu-operator-bundle:$(VERSION) .
 
 bench:
 	python bench.py

@@ -1,0 +1,23 @@
+# OLM bundle image (reference docker/bundle.Dockerfile): manifests +
+# metadata + scorecard config on a scratch base, addressed by the bundle
+# labels below.
+FROM scratch
+
+ARG VERSION=""
+ARG DEFAULT_CHANNEL=stable
+ARG CHANNELS=stable
+ARG GIT_COMMIT="unknown"
+
+LABEL operators.operatorframework.io.bundle.mediatype.v1=registry+v1
+LABEL operators.operatorframework.io.bundle.manifests.v1=manifests/
+LABEL operators.operatorframework.io.bundle.metadata.v1=metadata/
+LABEL operators.operatorframework.io.bundle.package.v1=tpu-operator
+LABEL operators.operatorframework.io.bundle.channels.v1=${CHANNELS}
+LABEL operators.operatorframework.io.bundle.channel.default.v1=${DEFAULT_CHANNEL}
+LABEL operators.operatorframework.io.test.config.v1=tests/scorecard/
+LABEL operators.operatorframework.io.test.mediatype.v1=scorecard+v1
+LABEL vcs-ref=${GIT_COMMIT}
+
+COPY bundle/manifests /manifests/
+COPY bundle/metadata /metadata/
+COPY bundle/tests/scorecard /tests/scorecard/

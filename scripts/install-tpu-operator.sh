@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Deploy the TPU operator onto the current kube context (reference
+# scripts/install-gpu-operator-nvaie.sh shape: namespace -> registry
+# secret -> helm install with environment-driven overrides).
+#
+# Usage:
+#   ./scripts/install-tpu-operator.sh
+#
+# Environment:
+#   OPERATOR_NAMESPACE   target namespace            (default tpu-operator)
+#   REGISTRY             image registry              (default gcr.io/tpu-operator)
+#   VERSION              operator/operand version    (default chart appVersion)
+#   REGISTRY_SECRET      optional imagePullSecret name to create from
+#                        REGISTRY_JSON_KEY (a docker-registry JSON key file)
+#   LIBTPU_VERSION       optional libtpu installer version override
+#   EXTRA_HELM_ARGS      appended verbatim to helm install
+set -euo pipefail
+
+HERE=$(cd "$(dirname "$0")/.." && pwd)
+CHART="$HERE/deployments/tpu-operator"
+
+OPERATOR_NAMESPACE=${OPERATOR_NAMESPACE:-tpu-operator}
+REGISTRY=${REGISTRY:-gcr.io/tpu-operator}
+
+command -v kubectl >/dev/null || { echo "kubectl required" >&2; exit 1; }
+command -v helm >/dev/null || { echo "helm required" >&2; exit 1; }
+
+# step 1: namespace
+kubectl get namespace "$OPERATOR_NAMESPACE" >/dev/null 2>&1 ||
+  kubectl create namespace "$OPERATOR_NAMESPACE"
+
+# step 2: optional private-registry pull secret
+SECRET_ARGS=()
+if [[ -n "${REGISTRY_SECRET:-}" ]]; then
+  : "${REGISTRY_JSON_KEY:?REGISTRY_SECRET set but REGISTRY_JSON_KEY (key file) missing}"
+  kubectl -n "$OPERATOR_NAMESPACE" create secret docker-registry \
+    "$REGISTRY_SECRET" \
+    --docker-server="${REGISTRY%%/*}" \
+    --docker-username=_json_key \
+    --docker-password="$(cat "$REGISTRY_JSON_KEY")" \
+    --dry-run=client -o yaml | kubectl apply -f -
+  SECRET_ARGS+=(--set "operator.imagePullSecrets[0]=$REGISTRY_SECRET")
+fi
+
+# step 3: helm install/upgrade
+VERSION_ARGS=()
+[[ -n "${VERSION:-}" ]] && VERSION_ARGS+=(--set "operator.version=$VERSION")
+[[ -n "${LIBTPU_VERSION:-}" ]] && VERSION_ARGS+=(--set "libtpu.version=$LIBTPU_VERSION")
+
+# empty-array expansion guarded for bash < 4.4 under `set -u`
+# shellcheck disable=SC2086
+helm upgrade --install tpu-operator "$CHART" \
+  --namespace "$OPERATOR_NAMESPACE" \
+  --set "operator.repository=$REGISTRY" \
+  ${SECRET_ARGS[@]+"${SECRET_ARGS[@]}"} ${VERSION_ARGS[@]+"${VERSION_ARGS[@]}"} \
+  --wait ${EXTRA_HELM_ARGS:-}
+
+echo "tpu-operator deployed to namespace $OPERATOR_NAMESPACE"
+kubectl -n "$OPERATOR_NAMESPACE" get clusterpolicy,daemonsets 2>/dev/null || true

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Tear down the e2e cluster created by cluster-up.sh.
+set -euo pipefail
+
+: "${GCP_PROJECT:?set GCP_PROJECT}"
+CLUSTER_NAME=${CLUSTER_NAME:-tpu-operator-e2e}
+ZONE=${ZONE:-us-central2-b}
+
+gcloud container clusters delete "$CLUSTER_NAME" \
+  --project "$GCP_PROJECT" --zone "$ZONE" --quiet

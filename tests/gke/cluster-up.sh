@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Bring up a GKE cluster with a TPU node pool for real e2e runs — the
+# reference's aws-kube-ci terraform slot (SURVEY.md §2.1 #17: AWS instance
+# bring-up), reshaped for TPUs: GKE node pools are the unit of TPU
+# provisioning, so this drives gcloud instead of terraform.
+#
+# Usage:
+#   GCP_PROJECT=my-proj ./tests/gke/cluster-up.sh
+#
+# Environment:
+#   GCP_PROJECT     (required) GCP project id
+#   CLUSTER_NAME    default tpu-operator-e2e
+#   ZONE            default us-central2-b (v4) — pick a TPU zone
+#   TPU_TOPOLOGY    default 2x2x1  (v4-8 single host)
+#   MACHINE_TYPE    default ct4p-hightpu-4t
+#   NUM_NODES       default 1 (hosts in the slice; >1 => multi-host)
+#   RELEASE_CHANNEL default rapid
+set -euo pipefail
+
+: "${GCP_PROJECT:?set GCP_PROJECT}"
+CLUSTER_NAME=${CLUSTER_NAME:-tpu-operator-e2e}
+ZONE=${ZONE:-us-central2-b}
+TPU_TOPOLOGY=${TPU_TOPOLOGY:-2x2x1}
+MACHINE_TYPE=${MACHINE_TYPE:-ct4p-hightpu-4t}
+NUM_NODES=${NUM_NODES:-1}
+RELEASE_CHANNEL=${RELEASE_CHANNEL:-rapid}
+
+command -v gcloud >/dev/null || { echo "gcloud required" >&2; exit 1; }
+
+echo ">> creating cluster $CLUSTER_NAME in $ZONE"
+gcloud container clusters create "$CLUSTER_NAME" \
+  --project "$GCP_PROJECT" --zone "$ZONE" \
+  --release-channel "$RELEASE_CHANNEL" \
+  --num-nodes 1 --machine-type e2-standard-4
+
+echo ">> adding TPU node pool ($MACHINE_TYPE, topology $TPU_TOPOLOGY)"
+gcloud container node-pools create tpu-pool \
+  --project "$GCP_PROJECT" --zone "$ZONE" --cluster "$CLUSTER_NAME" \
+  --machine-type "$MACHINE_TYPE" \
+  --tpu-topology "$TPU_TOPOLOGY" \
+  --num-nodes "$NUM_NODES"
+
+gcloud container clusters get-credentials "$CLUSTER_NAME" \
+  --project "$GCP_PROJECT" --zone "$ZONE"
+
+echo ">> cluster ready; run: tests/local.sh defaults"

@@ -79,6 +79,12 @@ def build_parser():
         help="fail membw validation below this fraction of spec HBM bandwidth",
     )
     p.add_argument(
+        "--membw-size-mb",
+        type=int,
+        default=int(os.environ.get("MEMBW_SIZE_MB", "0")),
+        help="probe buffer MiB (0 = auto: 2048 on TPU, tiny off-TPU)",
+    )
+    p.add_argument(
         "--expect-devices",
         type=int,
         default=int(os.environ.get("EXPECT_TPU_DEVICES", "0")) or None,
@@ -150,6 +156,7 @@ def main(argv=None) -> int:
                 status,
                 expect_tpu=not args.allow_cpu,
                 min_utilization=args.membw_min_utilization,
+                size_mb=args.membw_size_mb,
             )
         elif args.component == "vfio-pci":
             info = comp.validate_vfio_pci(status, sysfs=args.sysfs)

@@ -53,4 +53,6 @@ def chain_per_iter_seconds(step: Callable, x, force: Callable, iters: int) -> fl
         if delta > 0:
             candidates.append(delta)
     candidates.sort()
-    return candidates[len(candidates) // 2]
+    # lower-middle on even counts: with [plain, sub0] the overhead-corrected
+    # estimate must win, not the overhead-inclusive plain mean
+    return candidates[(len(candidates) - 1) // 2]

@@ -1,0 +1,4 @@
+# Central version pins (reference versions.mk slot).
+VERSION ?= 0.1.0
+REGISTRY ?= gcr.io/tpu-operator
+GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)

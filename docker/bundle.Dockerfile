@@ -17,6 +17,7 @@ LABEL operators.operatorframework.io.bundle.channel.default.v1=${DEFAULT_CHANNEL
 LABEL operators.operatorframework.io.test.config.v1=tests/scorecard/
 LABEL operators.operatorframework.io.test.mediatype.v1=scorecard+v1
 LABEL vcs-ref=${GIT_COMMIT}
+LABEL version=${VERSION}
 
 COPY bundle/manifests /manifests/
 COPY bundle/metadata /metadata/

@@ -9,7 +9,7 @@
 # Environment:
 #   OPERATOR_NAMESPACE   target namespace            (default tpu-operator)
 #   REGISTRY             image registry              (default gcr.io/tpu-operator)
-#   VERSION              operator/operand version    (default chart appVersion)
+#   VERSION              operator image version      (default chart appVersion)
 #   REGISTRY_SECRET      optional imagePullSecret name to create from
 #                        REGISTRY_JSON_KEY (a docker-registry JSON key file)
 #   LIBTPU_VERSION       optional libtpu installer version override
@@ -29,6 +29,15 @@ command -v helm >/dev/null || { echo "helm required" >&2; exit 1; }
 kubectl get namespace "$OPERATOR_NAMESPACE" >/dev/null 2>&1 ||
   kubectl create namespace "$OPERATOR_NAMESPACE"
 
+# every chart section that owns an image (operator Deployment + operands);
+# a registry/pull-secret override must reach all of them or operand pods
+# ImagePullBackOff against the default registry
+IMAGE_SECTIONS=(
+  operatorDeployment libtpu runtime devicePlugin metricsd metricsExporter
+  nodeStatusExporter tfd sliceManager validator vfioManager
+  sandboxDevicePlugin vmManager vmDeviceManager kataManager
+)
+
 # step 2: optional private-registry pull secret
 SECRET_ARGS=()
 if [[ -n "${REGISTRY_SECRET:-}" ]]; then
@@ -39,19 +48,28 @@ if [[ -n "${REGISTRY_SECRET:-}" ]]; then
     --docker-username=_json_key \
     --docker-password="$(cat "$REGISTRY_JSON_KEY")" \
     --dry-run=client -o yaml | kubectl apply -f -
-  SECRET_ARGS+=(--set "operator.imagePullSecrets[0]=$REGISTRY_SECRET")
+  # the Deployment takes k8s-shaped {name: ...}; ClusterPolicy operand
+  # specs take plain secret-name strings
+  SECRET_ARGS+=(--set "operatorDeployment.imagePullSecrets[0].name=$REGISTRY_SECRET")
+  for section in "${IMAGE_SECTIONS[@]:1}"; do
+    SECRET_ARGS+=(--set "$section.imagePullSecrets[0]=$REGISTRY_SECRET")
+  done
 fi
 
 # step 3: helm install/upgrade
+REGISTRY_ARGS=()
+for section in "${IMAGE_SECTIONS[@]}"; do
+  REGISTRY_ARGS+=(--set "$section.repository=$REGISTRY")
+done
 VERSION_ARGS=()
-[[ -n "${VERSION:-}" ]] && VERSION_ARGS+=(--set "operator.version=$VERSION")
+[[ -n "${VERSION:-}" ]] && VERSION_ARGS+=(--set "operatorDeployment.version=$VERSION")
 [[ -n "${LIBTPU_VERSION:-}" ]] && VERSION_ARGS+=(--set "libtpu.version=$LIBTPU_VERSION")
 
 # empty-array expansion guarded for bash < 4.4 under `set -u`
 # shellcheck disable=SC2086
 helm upgrade --install tpu-operator "$CHART" \
   --namespace "$OPERATOR_NAMESPACE" \
-  --set "operator.repository=$REGISTRY" \
+  "${REGISTRY_ARGS[@]}" \
   ${SECRET_ARGS[@]+"${SECRET_ARGS[@]}"} ${VERSION_ARGS[@]+"${VERSION_ARGS[@]}"} \
   --wait ${EXTRA_HELM_ARGS:-}
 

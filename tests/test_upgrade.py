@@ -435,3 +435,84 @@ def test_unstamped_timed_state_gets_stamped_then_times_out(cluster):
     _age_node_state(cluster, "node-1", 301)
     pump(mgr, policy, times=1)
     assert node_state(cluster, "node-1") == us.STATE_FAILED
+
+
+def test_precordoned_node_stays_cordoned_after_upgrade(cluster):
+    """A node the admin cordoned before the upgrade must finish the FSM
+    still cordoned (reference UpgradeInitialStateAnnotation,
+    upgrade_state.go:419-429,869-897)."""
+    node = cluster.get("v1", "Node", "node-2")
+    node.setdefault("spec", {})["unschedulable"] = True
+    cluster.update(node)
+
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=4, max_unavailable="100%"
+    )
+    for _ in range(12):
+        state = mgr.build_state()
+        mgr.apply_state(state, policy)
+        for i in (1, 2, 3, 4):
+            n = f"node-{i}"
+            if cluster.get_or_none("v1", "Pod", f"libtpu-{n}", NS) is None:
+                cluster.create(driver_pod(n, DESIRED_HASH))
+                cluster.create(validator_pod(n))
+
+    for i in (1, 2, 3, 4):
+        assert node_state(cluster, f"node-{i}") == us.STATE_DONE, f"node-{i}"
+    # node-2 kept its admin cordon; the others were uncordoned
+    assert cluster.get("v1", "Node", "node-2")["spec"]["unschedulable"] is True
+    for i in (1, 3, 4):
+        node = cluster.get("v1", "Node", f"node-{i}")
+        assert not node.get("spec", {}).get("unschedulable", False)
+    # tracking annotation is consumed on completion
+    assert consts.UPGRADE_INITIAL_STATE_ANNOTATION not in (
+        cluster.get("v1", "Node", "node-2")["metadata"].get("annotations", {})
+    )
+
+
+def test_cleanup_strips_initial_state_annotation(cluster):
+    node = cluster.get("v1", "Node", "node-1")
+    node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] = us.STATE_DONE
+    node["metadata"].setdefault("annotations", {})[
+        consts.UPGRADE_INITIAL_STATE_ANNOTATION
+    ] = "true"
+    cluster.update(node)
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    mgr.cleanup_state_labels()
+    node = cluster.get("v1", "Node", "node-1")
+    assert consts.UPGRADE_STATE_LABEL not in node["metadata"]["labels"]
+    assert consts.UPGRADE_INITIAL_STATE_ANNOTATION not in node["metadata"].get(
+        "annotations", {}
+    )
+
+
+def test_stale_initial_state_annotation_cleared_on_reentry(cluster):
+    """A leftover initial-state annotation from an aborted upgrade must not
+    suppress uncordon when the node re-enters the FSM schedulable."""
+    node = cluster.get("v1", "Node", "node-3")
+    node["metadata"].setdefault("annotations", {})[
+        consts.UPGRADE_INITIAL_STATE_ANNOTATION
+    ] = "true"
+    cluster.update(node)
+
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=4, max_unavailable="100%"
+    )
+    for _ in range(12):
+        state = mgr.build_state()
+        mgr.apply_state(state, policy)
+        for i in (1, 2, 3, 4):
+            n = f"node-{i}"
+            if cluster.get_or_none("v1", "Pod", f"libtpu-{n}", NS) is None:
+                cluster.create(driver_pod(n, DESIRED_HASH))
+                cluster.create(validator_pod(n))
+
+    assert node_state(cluster, "node-3") == us.STATE_DONE
+    node = cluster.get("v1", "Node", "node-3")
+    # schedulable again: the stale annotation was discarded on entry
+    assert not node.get("spec", {}).get("unschedulable", False)
+    assert consts.UPGRADE_INITIAL_STATE_ANNOTATION not in node["metadata"].get(
+        "annotations", {}
+    )

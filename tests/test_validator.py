@@ -259,3 +259,134 @@ def test_validate_membw_utilization_gate(status, monkeypatch):
     monkeypatch.setattr(membw_mod, "run_membw_probe", lambda **kw: sick)
     with pytest.raises(comp.ValidationError, match="below"):
         comp.validate_membw(status, expect_tpu=True, min_utilization=0.5)
+
+
+# ---------------------------------------------------------------------------
+# sandbox components: workload-config gate, vm-manager, vm-devices
+# (reference validator/main.go:1301-1501)
+# ---------------------------------------------------------------------------
+
+
+def _node(name, workload_config=None):
+    labels = {}
+    if workload_config:
+        labels[consts.WORKLOAD_CONFIG_LABEL] = workload_config
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": labels},
+    }
+
+
+def test_sandbox_gate_skips_container_nodes(status):
+    client = FakeClient([_node("n1")])
+    info = comp.validate_vm_manager(status, client=client, node_name="n1")
+    assert info == {"skipped": True, "workload_config": "container"}
+    # workload type recorded for must-gather / debugging
+    assert status.exists(comp.WORKLOAD_TYPE_STATUS_FILE)
+    assert not status.exists("vm-manager-ready")
+    # vfio-pci and vm-devices skip the same way
+    assert comp.validate_vfio_pci(status, client=client, node_name="n1")["skipped"]
+    assert comp.validate_vm_devices(status, client=client, node_name="n1")["skipped"]
+
+
+def test_validate_vm_manager(tmp_path, status):
+    client = FakeClient([_node("n1", consts.WORKLOAD_VM_PASSTHROUGH)])
+    dev = tmp_path / "dev"
+    (dev / "vfio").mkdir(parents=True)
+    # control node missing
+    with pytest.raises(ValidationError, match="vfio control node"):
+        comp.validate_vm_manager(
+            status, client=client, node_name="n1", dev_root=str(dev)
+        )
+    (dev / "vfio" / "vfio").touch()
+    # control node but no groups
+    with pytest.raises(ValidationError, match="IOMMU groups"):
+        comp.validate_vm_manager(
+            status, client=client, node_name="n1", dev_root=str(dev)
+        )
+    (dev / "vfio" / "0").touch()
+    info = comp.validate_vm_manager(
+        status, client=client, node_name="n1", dev_root=str(dev)
+    )
+    assert len(info["groups"]) == 1
+    assert status.exists("vm-manager-ready")
+
+
+def test_validate_vm_devices(tmp_path, status):
+    client = FakeClient([_node("n1", consts.WORKLOAD_VM_PASSTHROUGH)])
+    dev = tmp_path / "dev"
+    (dev / "vfio").mkdir(parents=True)
+    group = dev / "vfio" / "0"
+    group.touch()
+    state_file = tmp_path / "vm-devices.json"
+    # no state file -> fails after retries
+    with pytest.raises(ValidationError, match="no vm device state"):
+        comp.validate_vm_devices(
+            status,
+            client=client,
+            node_name="n1",
+            dev_root=str(dev),
+            state_file=str(state_file),
+            retries=1,
+        )
+    # state file listing a dead group -> fails
+    state_file.write_text(
+        json.dumps(
+            {"config": "default", "devices": [{"id": 0, "vfio_group": "/nope"}]}
+        )
+    )
+    with pytest.raises(ValidationError, match="vfio groups missing"):
+        comp.validate_vm_devices(
+            status,
+            client=client,
+            node_name="n1",
+            dev_root=str(dev),
+            state_file=str(state_file),
+            retries=1,
+        )
+    state_file.write_text(
+        json.dumps(
+            {
+                "config": "default",
+                "devices": [{"id": 0, "vfio_group": str(group)}],
+            }
+        )
+    )
+    info = comp.validate_vm_devices(
+        status,
+        client=client,
+        node_name="n1",
+        dev_root=str(dev),
+        state_file=str(state_file),
+        retries=1,
+    )
+    assert info == {"config": "default", "devices": 1}
+    assert status.exists("vm-devices-ready")
+
+
+def test_vm_device_manager_to_validator_roundtrip(tmp_path, status):
+    """The state file written by the vm-device-manager operand is exactly
+    what the vm-devices validator consumes."""
+    from tpu_operator.operands import vm_manager as vmm
+
+    client = FakeClient([_node("n1", consts.WORKLOAD_VM_PASSTHROUGH)])
+    dev = tmp_path / "dev"
+    (dev / "vfio").mkdir(parents=True)
+    (dev / "vfio" / "vfio").touch()
+    (dev / "vfio" / "7").touch()
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text("vm-device-configs:\n  default: {}\n")
+    state_file = tmp_path / "state" / "vm-devices.json"
+    vmm.apply_vm_device_config(
+        str(cfg), "default", dev_root=str(dev), state_file=str(state_file)
+    )
+    info = comp.validate_vm_devices(
+        status,
+        client=client,
+        node_name="n1",
+        dev_root=str(dev),
+        state_file=str(state_file),
+        retries=1,
+    )
+    assert info["devices"] == 1

@@ -93,6 +93,12 @@ UPGRADE_STATE_LABEL = f"{GROUP}/libtpu-upgrade-state"
 UPGRADE_STATE_SINCE_ANNOTATION = f"{GROUP}/libtpu-upgrade-state-since"
 UPGRADE_SKIP_DRAIN_LABEL = f"{GROUP}/libtpu-upgrade-drain.skip"
 UPGRADE_SKIP_LABEL = f"{GROUP}/libtpu-upgrade.skip"
+# node was already cordoned when the upgrade began; uncordon is skipped so
+# the node leaves the FSM in the state the operator found it (reference
+# UpgradeInitialStateAnnotationKeyFmt, upgrade consts.go:27-28)
+UPGRADE_INITIAL_STATE_ANNOTATION = (
+    f"{GROUP}/libtpu-upgrade.node-initial-state.unschedulable"
+)
 UPGRADE_ENABLED_ANNOTATION = f"{GROUP}/libtpu-upgrade-enabled"
 
 # feature-discovery published labels (GFD analogue)

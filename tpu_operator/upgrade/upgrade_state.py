@@ -141,6 +141,28 @@ class NodeStateProvider:
                 "failed to stamp node %s", node["metadata"]["name"]
             )
 
+    def set_annotation(self, node: Obj, key: str, value: Optional[str]) -> None:
+        """Set (or, with ``value=None``, remove) a node annotation (reference
+        ``ChangeNodeUpgradeAnnotation``, value "null" = delete)."""
+        fresh = self.client.get("v1", "Node", node["metadata"]["name"])
+        ann = fresh["metadata"].setdefault("annotations", {})
+        if value is None:
+            if key not in ann:
+                return
+            del ann[key]
+        else:
+            if ann.get(key) == value:
+                return
+            ann[key] = value
+        self.client.update(fresh)
+        # keep the caller's in-hand object coherent for later steps this
+        # reconcile
+        node["metadata"].setdefault("annotations", {})
+        if value is None:
+            node["metadata"]["annotations"].pop(key, None)
+        else:
+            node["metadata"]["annotations"][key] = value
+
     def clear_state(self, node: Obj) -> None:
         fresh = self.client.get("v1", "Node", node["metadata"]["name"])
         labels = fresh["metadata"].setdefault("labels", {})
@@ -149,9 +171,13 @@ class NodeStateProvider:
         if consts.UPGRADE_STATE_LABEL in labels:
             del labels[consts.UPGRADE_STATE_LABEL]
             changed = True
-        if consts.UPGRADE_STATE_SINCE_ANNOTATION in ann:
-            del ann[consts.UPGRADE_STATE_SINCE_ANNOTATION]
-            changed = True
+        for key in (
+            consts.UPGRADE_STATE_SINCE_ANNOTATION,
+            consts.UPGRADE_INITIAL_STATE_ANNOTATION,
+        ):
+            if key in ann:
+                del ann[key]
+                changed = True
         if changed:
             self.client.update(fresh)
 
@@ -346,6 +372,34 @@ class ClusterUpgradeStateManager:
                 if labels.get(consts.UPGRADE_SKIP_LABEL) == "true":
                     continue
                 if pod is not None and self._pod_is_stale(pod, desired_hashes):
+                    try:
+                        if node.get("spec", {}).get("unschedulable", False):
+                            # remember the node entered the FSM already
+                            # cordoned so completion leaves it cordoned
+                            # (reference upgrade_state.go:419-429)
+                            self.provider.set_annotation(
+                                node,
+                                consts.UPGRADE_INITIAL_STATE_ANNOTATION,
+                                "true",
+                            )
+                        else:
+                            # a leftover annotation from an aborted earlier
+                            # upgrade must not suppress this cycle's uncordon
+                            self.provider.set_annotation(
+                                node,
+                                consts.UPGRADE_INITIAL_STATE_ANNOTATION,
+                                None,
+                            )
+                    except Exception:
+                        # transient API failure on one node must not abort
+                        # the whole upgrade pass; the node re-enters next
+                        # reconcile with its annotation reconsidered
+                        log.exception(
+                            "node %s: failed to record initial cordon state; "
+                            "deferring FSM entry",
+                            node_name,
+                        )
+                        continue
                     current = STATE_UPGRADE_REQUIRED
                     self.provider.set_state(node, current)
                 elif pod is not None:
@@ -474,7 +528,7 @@ class ClusterUpgradeStateManager:
         for ns in state.node_states.get(STATE_VALIDATION_REQUIRED, []):
             node_name = ns.node["metadata"]["name"]
             if self.validation.validate(node_name):
-                self.provider.set_state(ns.node, STATE_UNCORDON_REQUIRED)
+                self._to_uncordon_or_done(ns.node)
             elif self._timed_out(ns.node, VALIDATION_TIMEOUT_S):
                 log.error(
                     "node %s: validation not passing after %.0fs; "
@@ -487,6 +541,31 @@ class ClusterUpgradeStateManager:
         for ns in state.node_states.get(STATE_UNCORDON_REQUIRED, []):
             self.cordon.uncordon(ns.node["metadata"]["name"])
             self.provider.set_state(ns.node, STATE_DONE)
+
+    def _to_uncordon_or_done(self, node: Obj) -> None:
+        """A node that was cordoned before the upgrade began skips uncordon
+        and finishes in the state the operator found it (reference
+        ``updateNodeToUncordonOrDoneState``, ``upgrade_state.go:869-897``)."""
+        ann = node["metadata"].get("annotations", {}) or {}
+        if consts.UPGRADE_INITIAL_STATE_ANNOTATION in ann:
+            log.info(
+                "node %s was unschedulable when the upgrade began; skipping uncordon",
+                node["metadata"]["name"],
+            )
+            self.provider.set_state(node, STATE_DONE)
+            try:
+                self.provider.set_annotation(
+                    node, consts.UPGRADE_INITIAL_STATE_ANNOTATION, None
+                )
+            except Exception:
+                # node is Done and still cordoned, so a lingering annotation
+                # stays truthful; build_state reconsiders it on re-entry
+                log.exception(
+                    "node %s: failed to clear initial-state annotation",
+                    node["metadata"]["name"],
+                )
+        else:
+            self.provider.set_state(node, STATE_UNCORDON_REQUIRED)
 
     def _timed_out(self, node: Obj, timeout_s: float) -> bool:
         if timeout_s <= 0:

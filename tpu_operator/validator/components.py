@@ -347,9 +347,17 @@ GOOGLE_PCI_VENDOR = "0x1ae0"
 
 
 def validate_vfio_pci(
-    status: StatusFiles, sysfs: str = "/sys/bus/pci/devices"
+    status: StatusFiles,
+    sysfs: str = "/sys/bus/pci/devices",
+    client=None,
+    node_name: str = "",
 ) -> dict:
-    """Every Google PCI accelerator function must be bound to vfio-pci."""
+    """Every Google PCI accelerator function must be bound to vfio-pci.
+    With a client, nodes not configured for vm-passthrough skip the check
+    (reference ``VfioPCI.validate``, ``validator/main.go:1301-1340``)."""
+    skipped = workload_config_gate(status, client, node_name)
+    if skipped is not None:
+        return skipped
     bound, unbound = [], []
     if not os.path.isdir(sysfs):
         raise ValidationError(f"no sysfs PCI tree at {sysfs}")
@@ -371,4 +379,120 @@ def validate_vfio_pci(
         raise ValidationError("no Google PCI accelerator functions found")
     info = {"bound": bound}
     status.write("vfio-pci-ready", info)
+    return info
+
+
+# ---------------------------------------------------------------------------
+# sandbox workload-config gate + vm-manager / vm-devices components
+# (reference validator/main.go:1301-1501: each sandbox component reads the
+# node's workload config, records it in a status file, and no-ops on nodes
+# configured for a different workload)
+# ---------------------------------------------------------------------------
+
+WORKLOAD_TYPE_STATUS_FILE = "workload-type"
+
+
+def workload_config_gate(
+    status: StatusFiles, client, node_name: str
+) -> Optional[dict]:
+    """Record the node's workload config; return a skip-info dict when the
+    node is not a vm-passthrough host (sandbox components then succeed as
+    no-ops, reference ``VfioPCI.validate``/``VGPUManager.validate``)."""
+    if client is None or not node_name:
+        # no API access (dev run outside a pod): gate disabled, validate
+        return None
+    node = None
+    err = None
+    for _ in range(3):
+        # freshly-applied RBAC may still be propagating when the first
+        # initContainer starts; transient API errors get a bounded retry and
+        # then the structured failure path, not a raw traceback
+        try:
+            node = client.get("v1", "Node", node_name)
+            break
+        except Exception as e:  # noqa: BLE001 - any API failure retries
+            err = e
+            time.sleep(WAIT_SLEEP_S)
+    if node is None:
+        raise ValidationError(f"cannot read node {node_name}: {err}")
+    # single owner of the label -> config mapping (validates values, warns
+    # and coerces unknowns to "container")
+    from tpu_operator.controllers.state_manager import node_workload_config
+
+    cfg = node_workload_config(node)
+    status.write(WORKLOAD_TYPE_STATUS_FILE, {"config": cfg})
+    if cfg != consts.WORKLOAD_VM_PASSTHROUGH:
+        log.info("workload config %r: sandbox validation not required", cfg)
+        return {"skipped": True, "workload_config": cfg}
+    return None
+
+
+def validate_vm_manager(
+    status: StatusFiles,
+    client=None,
+    node_name: str = "",
+    dev_root: str = "/dev",
+) -> dict:
+    """The vm-manager operand prepared a usable passthrough host: vfio
+    control node present plus at least one IOMMU group (reference
+    vgpu-manager validation, ``validator/main.go:1359-1445``)."""
+    skipped = workload_config_gate(status, client, node_name)
+    if skipped is not None:
+        return skipped
+    status.remove("vm-manager-ready")
+    control = os.path.join(dev_root, "vfio", "vfio")
+    if not os.path.exists(control):
+        raise ValidationError(
+            f"vfio control node missing at {control} (vfio modules loaded?)"
+        )
+    groups = [
+        g
+        for g in sorted(glob.glob(os.path.join(dev_root, "vfio", "*")))
+        if os.path.basename(g) != "vfio"
+    ]
+    if not groups:
+        raise ValidationError(f"no vfio IOMMU groups under {dev_root}/vfio")
+    info = {"groups": groups}
+    status.write("vm-manager-ready", info)
+    return info
+
+
+def validate_vm_devices(
+    status: StatusFiles,
+    client=None,
+    node_name: str = "",
+    dev_root: str = "/dev",
+    state_file: str = "/run/tpu/vm-devices.json",
+    retries: int = WAIT_RETRIES,
+) -> dict:
+    """The vm-device-manager materialized VM-attachable devices: its state
+    file lists ≥1 device and every recorded vfio group node exists
+    (reference vgpu-devices validation, ``validator/main.go:1447-1501``)."""
+    skipped = workload_config_gate(status, client, node_name)
+    if skipped is not None:
+        return skipped
+    status.remove("vm-devices-ready")
+    state = None
+    for _ in range(retries):
+        try:
+            with open(state_file) as f:
+                state = json.load(f)
+            break
+        except (OSError, ValueError):
+            log.info("waiting for vm device state file %s", state_file)
+            time.sleep(WAIT_SLEEP_S)
+    if state is None:
+        raise ValidationError(f"no vm device state at {state_file}")
+    devices = state.get("devices") or []
+    if not devices:
+        raise ValidationError(f"{state_file} lists no VM devices")
+    missing = [
+        d.get("vfio_group", "")
+        for d in devices
+        if not os.path.exists(d.get("vfio_group", ""))
+    ]
+    if missing:
+        raise ValidationError(f"vfio groups missing for VM devices: {missing}")
+    info = {"config": state.get("config", ""), "devices": len(devices)}
+    status.write("vm-devices-ready", info)
     return info

@@ -26,6 +26,8 @@ COMPONENTS = (
     "ici",
     "membw",
     "vfio-pci",
+    "vm-manager",
+    "vm-devices",
     "nodestatus",
 )
 
@@ -70,6 +72,10 @@ def build_parser():
     )
     p.add_argument("--dev-root", default="/dev")
     p.add_argument("--sysfs", default="/sys/bus/pci/devices")
+    p.add_argument(
+        "--vm-state-file",
+        default=os.environ.get("VM_DEVICE_STATE_FILE", "/run/tpu/vm-devices.json"),
+    )
     p.add_argument("--metrics-port", type=int, default=8000)
     p.add_argument("--matmul-size", type=int, default=4096)
     p.add_argument(
@@ -101,6 +107,16 @@ def make_client():
     from tpu_operator.kube.rest import RestClient
 
     return RestClient()
+
+
+def _client_or_none(log):
+    """Sandbox components degrade to label-gate-less validation when no
+    in-cluster API is reachable (dev runs outside a pod)."""
+    try:
+        return make_client()
+    except Exception:
+        log.warning("no in-cluster client; workload-config gate disabled")
+        return None
 
 
 def main(argv=None) -> int:
@@ -159,7 +175,27 @@ def main(argv=None) -> int:
                 size_mb=args.membw_size_mb,
             )
         elif args.component == "vfio-pci":
-            info = comp.validate_vfio_pci(status, sysfs=args.sysfs)
+            info = comp.validate_vfio_pci(
+                status,
+                sysfs=args.sysfs,
+                client=_client_or_none(log),
+                node_name=args.node_name,
+            )
+        elif args.component == "vm-manager":
+            info = comp.validate_vm_manager(
+                status,
+                client=_client_or_none(log),
+                node_name=args.node_name,
+                dev_root=args.dev_root,
+            )
+        elif args.component == "vm-devices":
+            info = comp.validate_vm_devices(
+                status,
+                client=_client_or_none(log),
+                node_name=args.node_name,
+                dev_root=args.dev_root,
+                state_file=args.vm_state_file,
+            )
         elif args.component == "nodestatus":
             from tpu_operator.validator.metrics import NodeMetrics
 

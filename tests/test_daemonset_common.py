@@ -291,3 +291,32 @@ def test_membw_validation_opt_in(monkeypatch):
     assert membw["args"] == ["tpu-validator --component membw"]
     env = {e["name"]: e.get("value") for e in membw.get("env", [])}
     assert env.get("MEMBW_MIN_UTILIZATION") == "0.4"
+
+
+def test_ringattn_validation_opt_in(monkeypatch):
+    """validator.ringattn.enabled appends the context-parallel probe after
+    the other diagnostics; off by default; ordering jax → membw → ringattn
+    when both are on."""
+    cr = load_cr()
+    client = reconcile_with(cr, monkeypatch)
+    ds = get_ds(client, "tpu-operator-validator")
+    names = [c["name"] for c in ds["spec"]["template"]["spec"]["initContainers"]]
+    assert "ringattn-validation" not in names
+
+    cr = load_cr()
+    cr["spec"]["validator"]["membw"] = {"enabled": True}
+    cr["spec"]["validator"]["ringattn"] = {
+        "enabled": True,
+        "env": [{"name": "RINGATTN_SEQ_LEN", "value": "4096"}],
+    }
+    client = reconcile_with(cr, monkeypatch)
+    ds = get_ds(client, "tpu-operator-validator")
+    inits = ds["spec"]["template"]["spec"]["initContainers"]
+    names = [c["name"] for c in inits]
+    jax_idx = names.index("jax-validation")
+    assert names.index("membw-validation") == jax_idx + 1
+    assert names.index("ringattn-validation") == jax_idx + 2
+    ra = inits[names.index("ringattn-validation")]
+    assert ra["args"] == ["tpu-validator --component ringattn"]
+    env = {e["name"]: e.get("value") for e in ra.get("env", [])}
+    assert env.get("RINGATTN_SEQ_LEN") == "4096"

@@ -526,6 +526,11 @@ class ValidatorSpec(_ImageSpec):
     # ``dcgmi diag`` memory-bandwidth analogue, off by default because it
     # holds the chip for a few extra seconds per validation pass
     membw: Optional[Dict[str, Any]] = None
+    # optional long-context probe: blockwise ring attention over an ``sp``
+    # mesh axis checked against full attention ({"enabled": true, "env":
+    # [...]}); proves the context-parallel path on multi-chip hosts, off by
+    # default for the same chip-holding reason as membw
+    ringattn: Optional[Dict[str, Any]] = None
 
     ENV_VAR = "TPU_VALIDATOR_IMAGE"
 

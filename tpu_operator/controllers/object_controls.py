@@ -665,22 +665,36 @@ def transform_validator(n, ds: Obj, generation: Optional[str] = None) -> None:
     _merge_env(main, spec.env)
     _apply_resources(main, spec)
     inits = ds["spec"]["template"]["spec"].setdefault("initContainers", [])
-    if (spec.membw or {}).get("enabled") and not any(
-        c["name"] == "membw-validation" for c in inits
+    # optional deep diagnostics appended after jax-validation (the chip is
+    # already proven free): membw = dcgmi-diag memory-bandwidth analogue,
+    # ringattn = context-parallel long-context probe. Containers are cloned
+    # from jax-validation — without it (custom assets) there is nothing
+    # sane to clone, so skip.
+    for comp_name, comp_spec in (
+        ("membw", spec.membw),
+        ("ringattn", spec.ringattn),
     ):
-        # optional deep diagnostic appended after jax-validation (the chip
-        # is already proven free); dcgmi-diag memory-bandwidth analogue.
-        # The container is cloned from jax-validation — without it (custom
-        # assets) there is nothing sane to clone, so skip.
-        jax_idx = next(
-            (i for i, c in enumerate(inits) if c["name"] == "jax-validation"),
-            None,
-        )
-        if jax_idx is not None:
-            membw_ctr = copy.deepcopy(inits[jax_idx])
-            membw_ctr["name"] = "membw-validation"
-            membw_ctr["args"] = ["tpu-validator --component membw"]
-            inits.insert(jax_idx + 1, membw_ctr)
+        ctr_name = f"{comp_name}-validation"
+        if (comp_spec or {}).get("enabled") and not any(
+            c["name"] == ctr_name for c in inits
+        ):
+            jax_idx = next(
+                (i for i, c in enumerate(inits) if c["name"] == "jax-validation"),
+                None,
+            )
+            if jax_idx is not None:
+                ctr = copy.deepcopy(inits[jax_idx])
+                ctr["name"] = ctr_name
+                ctr["args"] = [f"tpu-validator --component {comp_name}"]
+                # chain order: jax → membw → ringattn (each insert lands
+                # directly after the previously injected diagnostic)
+                insert_at = jax_idx + 1
+                while insert_at < len(inits) and inits[insert_at]["name"] in (
+                    "membw-validation",
+                    "ringattn-validation",
+                ):
+                    insert_at += 1
+                inits.insert(insert_at, ctr)
     for c in inits:
         component_env = {
             "plugin-validation": spec.plugin,
@@ -688,6 +702,7 @@ def transform_validator(n, ds: Obj, generation: Optional[str] = None) -> None:
             "libtpu-validation": spec.libtpu,
             "runtime-validation": spec.runtime,
             "membw-validation": spec.membw,
+            "ringattn-validation": spec.ringattn,
         }.get(c["name"])
         for e in (component_env or {}).get("env", []) or []:
             _set_container_env(c, e["name"], e["value"])

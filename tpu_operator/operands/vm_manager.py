@@ -37,20 +37,28 @@ log = logging.getLogger("tpu-vm-manager")
 # ---------------------------------------------------------------------------
 
 
-def vm_manager_ready(
-    dev_root: str = "/dev", status: StatusFiles = None
-) -> int:
-    groups = [
+def vfio_iommu_groups(dev_root: str = "/dev") -> list:
+    """Sorted VM-attachable IOMMU group nodes under ``dev_root``/vfio —
+    everything except the ``vfio`` control node. Single owner of the scan:
+    the operand readiness probe, the device-config applier, and the
+    validator all must agree on the device set."""
+    return sorted(
         g
         for g in glob.glob(os.path.join(dev_root, "vfio", "*"))
         if os.path.basename(g) != "vfio"
-    ]
+    )
+
+
+def vm_manager_ready(
+    dev_root: str = "/dev", status: StatusFiles = None
+) -> int:
+    groups = vfio_iommu_groups(dev_root)
     control = os.path.join(dev_root, "vfio", "vfio")
     if not os.path.exists(control):
         log.error("vfio control node missing at %s (vfio modules loaded?)", control)
         return 1
     if status is not None:
-        status.write("vm-manager-ready", {"groups": sorted(groups)})
+        status.write("vm-manager-ready", {"groups": groups})
     log.info("vm host ready: %d vfio groups", len(groups))
     return 0
 
@@ -73,11 +81,7 @@ def apply_vm_device_config(
     configs = doc.get("vm-device-configs", {})
     if config_name not in configs:
         raise ValueError(f"unknown vm-device config {config_name!r}")
-    groups = sorted(
-        g
-        for g in glob.glob(os.path.join(dev_root, "vfio", "*"))
-        if os.path.basename(g) != "vfio"
-    )
+    groups = vfio_iommu_groups(dev_root)
     devices = [
         {"id": i, "vfio_group": g, "resource": "google.com/tpu-vm"}
         for i, g in enumerate(groups)

@@ -296,6 +296,32 @@ def validate_ici(
 
 
 # ---------------------------------------------------------------------------
+# ringattn component (context-parallel long-context probe)
+# ---------------------------------------------------------------------------
+
+
+def validate_ringattn(
+    status: StatusFiles,
+    expect_devices: Optional[int] = None,
+    seq_len: int = 2048,
+) -> dict:
+    """Long-context readiness: blockwise ring attention over an ``sp`` mesh
+    axis (K/V blocks rotated via ppermute, online-softmax accumulation),
+    checked bit-for-bit-close against single-pass full attention. Proves
+    the slice can run sequence/context-parallel workloads, which the
+    aggregate burn-in's dp/tp collectives don't exercise."""
+    from tpu_operator.workloads.ringattn import run_ringattn
+
+    res = run_ringattn(n_devices=expect_devices, seq_len=seq_len)
+    if not res.ok:
+        raise ValidationError(
+            f"ring-attention probe failed: {res.error or 'divergence'}"
+        )
+    status.write("ringattn-ready", res.to_dict())
+    return res.to_dict()
+
+
+# ---------------------------------------------------------------------------
 # membw component (HBM bandwidth probe — DCGM-diagnostic analogue)
 # ---------------------------------------------------------------------------
 
@@ -358,6 +384,10 @@ def validate_vfio_pci(
     skipped = workload_config_gate(status, client, node_name)
     if skipped is not None:
         return skipped
+    # clear any stale barrier first: on revalidation failure the
+    # sandbox-device-plugin's wait gate must re-block rather than ride a
+    # ready file from a previous (since-invalidated) pass
+    status.remove("vfio-pci-ready")
     bound, unbound = [], []
     if not os.path.isdir(sysfs):
         raise ValidationError(f"no sysfs PCI tree at {sysfs}")
@@ -403,7 +433,8 @@ def workload_config_gate(
         return None
     node = None
     err = None
-    for _ in range(3):
+    attempts = 3
+    for i in range(attempts):
         # freshly-applied RBAC may still be propagating when the first
         # initContainer starts; transient API errors get a bounded retry and
         # then the structured failure path, not a raw traceback
@@ -412,7 +443,8 @@ def workload_config_gate(
             break
         except Exception as e:  # noqa: BLE001 - any API failure retries
             err = e
-            time.sleep(WAIT_SLEEP_S)
+            if i < attempts - 1:
+                time.sleep(WAIT_SLEEP_S)
     if node is None:
         raise ValidationError(f"cannot read node {node_name}: {err}")
     # single owner of the label -> config mapping (validates values, warns
@@ -445,11 +477,9 @@ def validate_vm_manager(
         raise ValidationError(
             f"vfio control node missing at {control} (vfio modules loaded?)"
         )
-    groups = [
-        g
-        for g in sorted(glob.glob(os.path.join(dev_root, "vfio", "*")))
-        if os.path.basename(g) != "vfio"
-    ]
+    from tpu_operator.operands.vm_manager import vfio_iommu_groups
+
+    groups = vfio_iommu_groups(dev_root)
     if not groups:
         raise ValidationError(f"no vfio IOMMU groups under {dev_root}/vfio")
     info = {"groups": groups}

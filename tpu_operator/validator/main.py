@@ -24,6 +24,7 @@ COMPONENTS = (
     "jax",
     "slice",
     "ici",
+    "ringattn",
     "membw",
     "vfio-pci",
     "vm-manager",
@@ -78,6 +79,12 @@ def build_parser():
     )
     p.add_argument("--metrics-port", type=int, default=8000)
     p.add_argument("--matmul-size", type=int, default=4096)
+    p.add_argument(
+        "--ringattn-seq-len",
+        type=int,
+        default=int(os.environ.get("RINGATTN_SEQ_LEN", "2048")),
+        help="total sequence length for the context-parallel probe",
+    )
     p.add_argument(
         "--membw-min-utilization",
         type=float,
@@ -166,6 +173,12 @@ def main(argv=None) -> int:
         elif args.component == "ici":
             info = comp.validate_ici(
                 status, expect_devices=args.expect_devices
+            )
+        elif args.component == "ringattn":
+            info = comp.validate_ringattn(
+                status,
+                expect_devices=args.expect_devices,
+                seq_len=args.ringattn_seq_len,
             )
         elif args.component == "membw":
             info = comp.validate_membw(

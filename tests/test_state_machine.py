@@ -120,6 +120,37 @@ def test_init_labels_tpu_nodes(ctrl):
     assert ctrl.runtime == "containerd"
 
 
+def test_non_gke_nfd_detection(monkeypatch):
+    """Nodes without GKE labels are detected via NFD: the built-in PCI
+    vendor label or the chart's NodeFeatureRule label
+    (templates/nodefeaturerules.yaml)."""
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+    nfd_node = make_cpu_node("bare-metal-1")
+    nfd_node["metadata"]["labels"][consts.NFD_TPU_PCI_LABEL] = "true"
+    rule_node = make_cpu_node("bare-metal-2")
+    rule_node["metadata"]["labels"][consts.NFD_RULE_TPU_PCI_LABEL] = "true"
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            nfd_node,
+            rule_node,
+            make_cpu_node("cpu-node-1"),
+        ]
+    )
+    client.create(load_sample_cr())
+    c = ClusterPolicyController(client, assets_dir=ASSETS)
+    c.init(client.get("tpu.k8s.io/v1", "ClusterPolicy", "cluster-policy"))
+    assert c.has_tpu_nodes and c.tpu_node_count == 2
+    for name in ("bare-metal-1", "bare-metal-2"):
+        labels = client.get("v1", "Node", name)["metadata"]["labels"]
+        assert labels[consts.TPU_PRESENT_LABEL] == "true"
+        assert labels[consts.DEPLOY_LABEL_PREFIX + "libtpu"] == "true"
+        # no GKE accelerator label -> generation unknown, no generation label
+        assert f"{consts.GROUP}/tpu.generation" not in labels
+    cpu = client.get("v1", "Node", "cpu-node-1")
+    assert consts.TPU_PRESENT_LABEL not in cpu["metadata"]["labels"]
+
+
 def test_all_17_states_load(ctrl):
     assert ctrl.state_names == STATE_ORDER
     assert len(ctrl.state_names) == 17

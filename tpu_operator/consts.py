@@ -25,6 +25,9 @@ GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"  # e.g. 2x4
 GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"  # all hosts of one multi-host slice share a pool
 # NFD fallback: Google PCI vendor id 1ae0 present on the node
 NFD_TPU_PCI_LABEL = "feature.node.kubernetes.io/pci-1ae0.present"
+# emitted by the chart's TPU NodeFeatureRule (vendor 1ae0 + accelerator
+# class 1200) for non-GKE clusters — see templates/nodefeaturerules.yaml
+NFD_RULE_TPU_PCI_LABEL = "tpu.k8s.io/tpu.pci.present"
 NFD_KERNEL_LABEL = "feature.node.kubernetes.io/kernel-version.full"
 NFD_OS_LABEL = "feature.node.kubernetes.io/system-os_release.ID"
 NFD_OS_VERSION_LABEL = "feature.node.kubernetes.io/system-os_release.VERSION_ID"

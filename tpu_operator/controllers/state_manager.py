@@ -82,6 +82,8 @@ def has_tpu_labels(node: Obj) -> bool:
         return True
     if labels.get(consts.NFD_TPU_PCI_LABEL) == "true":
         return True
+    if labels.get(consts.NFD_RULE_TPU_PCI_LABEL) == "true":
+        return True
     return False
 
 

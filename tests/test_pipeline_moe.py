@@ -1,0 +1,85 @@
+"""Pipeline-parallel and expert-parallel probes on the virtual CPU mesh."""
+
+import numpy as np
+
+from tpu_operator.workloads.moe import run_moe
+from tpu_operator.workloads.pipeline import run_pipeline
+
+
+def test_pipeline_matches_sequential_8_stages():
+    res = run_pipeline(n_devices=8, n_micro=8, micro_batch=2, d_model=64)
+    assert res.ok, res.error
+    assert res.n_stages == 8
+    assert res.ticks == 8 + 8 - 1
+    assert res.max_abs_err <= 1e-4
+
+
+def test_pipeline_more_micro_than_stages():
+    # n_micro > n_stages: the steady-state region actually fills
+    res = run_pipeline(n_devices=4, n_micro=12, micro_batch=2, d_model=32)
+    assert res.ok, res.error
+    assert res.ticks == 12 + 4 - 1
+
+
+def test_pipeline_single_stage():
+    res = run_pipeline(n_devices=1, n_micro=4, micro_batch=2, d_model=32)
+    assert res.ok, res.error
+    assert res.n_stages == 1
+
+
+def test_pipeline_too_many_devices():
+    res = run_pipeline(n_devices=99)
+    assert not res.ok and "need 99 devices" in res.error
+
+
+def test_moe_matches_dense_8_experts():
+    # default capacity is drop-free (tokens_per_device) for any routing
+    res = run_moe(n_devices=8, tokens_per_device=32, d_model=32)
+    assert res.ok, res.error
+    assert res.n_experts == 8
+    assert res.tokens == 8 * 32
+    assert res.capacity == 32
+    assert res.dropped == 0
+    assert res.max_abs_err <= 1e-4
+
+
+def test_moe_capacity_overflow_detected():
+    # capacity_factor far below 1 with few experts guarantees overflow on
+    # some device; the probe must fail loudly, not silently drop tokens
+    res = run_moe(n_devices=2, tokens_per_device=64, d_model=16,
+                  capacity_factor=0.2)
+    assert not res.ok
+    assert res.dropped > 0
+    assert "dropped" in res.error
+
+
+def test_moe_validator_defaults_drop_free_at_8_devices():
+    # regression: the validator's default config must never drop tokens on
+    # healthy hardware — mean-based capacity budgets overflowed the
+    # binomial routing tail at >=8 devices
+    res = run_moe(n_devices=8)
+    assert res.ok, res.error
+    assert res.dropped == 0
+    assert res.capacity == 64  # drop-free: tokens_per_device
+
+
+def test_moe_single_expert_degenerate():
+    res = run_moe(n_devices=1, tokens_per_device=16, d_model=16)
+    assert res.ok, res.error
+    assert np.isfinite(res.max_abs_err)
+
+
+def test_validator_pipeline_component(tmp_path):
+    from tpu_operator.validator.components import StatusFiles, validate_pipeline
+
+    status = StatusFiles(str(tmp_path))
+    info = validate_pipeline(status, expect_devices=4)
+    assert info["ok"] and status.exists("pipeline-ready")
+
+
+def test_validator_moe_component(tmp_path):
+    from tpu_operator.validator.components import StatusFiles, validate_moe
+
+    status = StatusFiles(str(tmp_path))
+    info = validate_moe(status, expect_devices=4)
+    assert info["ok"] and status.exists("moe-ready")

@@ -322,6 +322,50 @@ def validate_ringattn(
 
 
 # ---------------------------------------------------------------------------
+# pipeline component (pipeline-parallel probe)
+# ---------------------------------------------------------------------------
+
+
+def validate_pipeline(
+    status: StatusFiles, expect_devices: Optional[int] = None
+) -> dict:
+    """Pipeline-parallel readiness: GPipe-style microbatch pipeline (stage
+    weights sharded over ``pp``, activations streamed stage-to-stage via
+    ppermute inside one jitted scan), checked against sequential
+    application of all stages on one device."""
+    from tpu_operator.workloads.pipeline import run_pipeline
+
+    res = run_pipeline(n_devices=expect_devices)
+    if not res.ok:
+        raise ValidationError(
+            f"pipeline probe failed: {res.error or 'divergence'}"
+        )
+    status.write("pipeline-ready", res.to_dict())
+    return res.to_dict()
+
+
+# ---------------------------------------------------------------------------
+# moe component (expert-parallel all_to_all probe)
+# ---------------------------------------------------------------------------
+
+
+def validate_moe(
+    status: StatusFiles, expect_devices: Optional[int] = None
+) -> dict:
+    """Expert-parallel readiness: top-1-gated MoE layer with all_to_all
+    token dispatch/combine (the only standard parallelism exercising the
+    all-to-all ICI pattern), checked against dense per-token expert
+    application; capacity overflow fails loudly."""
+    from tpu_operator.workloads.moe import run_moe
+
+    res = run_moe(n_devices=expect_devices)
+    if not res.ok:
+        raise ValidationError(f"moe probe failed: {res.error or 'divergence'}")
+    status.write("moe-ready", res.to_dict())
+    return res.to_dict()
+
+
+# ---------------------------------------------------------------------------
 # membw component (HBM bandwidth probe — DCGM-diagnostic analogue)
 # ---------------------------------------------------------------------------
 

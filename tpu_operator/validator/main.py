@@ -25,6 +25,8 @@ COMPONENTS = (
     "slice",
     "ici",
     "ringattn",
+    "pipeline",
+    "moe",
     "membw",
     "vfio-pci",
     "vm-manager",
@@ -179,6 +181,14 @@ def main(argv=None) -> int:
                 status,
                 expect_devices=args.expect_devices,
                 seq_len=args.ringattn_seq_len,
+            )
+        elif args.component == "pipeline":
+            info = comp.validate_pipeline(
+                status, expect_devices=args.expect_devices
+            )
+        elif args.component == "moe":
+            info = comp.validate_moe(
+                status, expect_devices=args.expect_devices
             )
         elif args.component == "membw":
             info = comp.validate_membw(

@@ -1,0 +1,252 @@
+"""Expert-parallel (ep) probe: MoE token dispatch/combine via ``all_to_all``.
+
+The last mesh axis the slice validation suite must prove: expert
+parallelism, where each device hosts one expert and tokens are routed
+between devices. This is the only standard parallelism whose collective is
+``all_to_all`` — the burn-in (psum/all-gather), ring probes (ppermute) and
+pipeline probe (chained ppermute) never exercise it, yet it is the
+all-to-all ICI traffic pattern that stresses every link pair at once
+rather than neighbors only.
+
+The probe runs a top-1-gated mixture-of-experts layer: a deterministic
+router picks an expert per token; tokens are packed into per-expert
+capacity slots, exchanged with ``jax.lax.all_to_all``, transformed by the
+resident expert MLP, exchanged back, and unpacked. Validation is exact
+against the dense reference (every token pushed through its chosen expert
+on one device). Routing bugs, slot-packing bugs, or a link corrupting
+payloads all surface as divergence; overflowing tokens are counted and
+must be zero at the probe's default drop-free capacity.
+
+TPU-first notes: one jitted program; fixed capacity ⇒ static shapes (the
+XLA-friendly MoE formulation — no dynamic token counts); dispatch/combine
+are one-hot matmuls that land on the MXU; ``shard_map`` gives the
+per-device view so the two ``all_to_all`` calls are explicit.
+
+Used by ``tpu-validator --component moe`` and the multi-chip dryrun.
+Reference parity: none (SURVEY.md §2.4 — fabric validation is TPU-native
+surplus).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class MoEResult:
+    ok: bool
+    n_experts: int
+    tokens: int
+    capacity: int
+    dropped: int
+    max_abs_err: float
+    elapsed_s: float
+    error: str = ""
+
+    def to_dict(self):
+        return {
+            "ok": self.ok,
+            "n_experts": self.n_experts,
+            "tokens": self.tokens,
+            "capacity": self.capacity,
+            "dropped": self.dropped,
+            "max_abs_err": round(self.max_abs_err, 8),
+            "elapsed_s": round(self.elapsed_s, 6),
+            "error": self.error,
+        }
+
+
+def _expert_mlp(x, w):
+    import jax
+    import jax.numpy as jnp
+
+    # HIGHEST precision: on TPU, f32 dots otherwise run as bf16 MXU passes,
+    # and probe-vs-reference rounding at different shapes would swamp the
+    # tolerance — this is a correctness probe, not a throughput one
+    return jax.nn.gelu(
+        jnp.dot(
+            x,
+            w,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+    )
+
+
+def build_moe(
+    n_devices: Optional[int] = None,
+    tokens_per_device: int = 64,
+    d_model: int = 64,
+    capacity_factor: Optional[float] = None,
+):
+    """Returns (mesh, jitted MoE layer fn, (x, wg, we), capacity).
+
+    ``x``: [n_tokens, d_model] tokens sharded over ``ep``.
+    ``wg``: [d_model, n_experts] router weights, replicated.
+    ``we``: [n_experts, d_model, d_model] expert weights sharded over ``ep``.
+    fn returns (y sharded like x, keep mask, dropped-token count).
+
+    ``capacity_factor=None`` (the default) sizes each per-(source, expert)
+    slot budget at ``tokens_per_device`` — drop-free for ANY routing, since
+    a source can never send more tokens than it holds. A health probe must
+    not fail on healthy hardware, and mean-based budgets (factor ×
+    tokens/n) deterministically overflow the binomial routing tail once
+    tokens_per_device/n is small. Pass a numeric factor only to exercise
+    the overflow-detection path.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise RuntimeError(f"need {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    n = len(devices)
+    mesh = Mesh(np.asarray(devices), axis_names=("ep",))
+
+    # per-(device, destination-expert) slot budget; ×n devices sending
+    # means each expert can receive up to n*capacity tokens per step
+    if capacity_factor is None:
+        capacity = tokens_per_device
+    else:
+        capacity = max(4, int(capacity_factor * tokens_per_device / n))
+    capacity = min(capacity, tokens_per_device)
+
+    key = jax.random.PRNGKey(11)
+    kx, kg, ke = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (n * tokens_per_device, d_model), jnp.float32)
+    wg = jax.random.normal(kg, (d_model, n), jnp.float32)
+    we = jax.random.normal(ke, (n, d_model, d_model), jnp.float32) * (
+        1.0 / d_model**0.5
+    )
+    x = jax.device_put(x, NamedSharding(mesh, P("ep", None)))
+    wg = jax.device_put(wg, NamedSharding(mesh, P(None, None)))
+    we = jax.device_put(we, NamedSharding(mesh, P("ep", None, None)))
+
+    def moe(xs, wgr, wes):
+        # xs: [t, d] local tokens; wes: [1, d, d] resident expert weights
+        t = xs.shape[0]
+        logits = jnp.dot(
+            xs,
+            wgr,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        choice = jnp.argmax(logits, axis=-1)  # [t] expert id per token
+        # position of each token within its expert's slot budget
+        onehot = jax.nn.one_hot(choice, n, dtype=jnp.int32)  # [t, e]
+        # slot = how many earlier tokens (inclusive) chose the same expert,
+        # minus one; zero in the non-chosen columns so the row-sum is the
+        # chosen expert's slot id
+        pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot  # [t, e]
+        slot = jnp.sum(pos, axis=-1)  # [t] slot id within chosen expert
+        keep = slot < capacity
+        dropped = jnp.sum(~keep)
+        # dispatch tensor: [e, capacity, d] — token payloads packed into
+        # (destination expert, slot); one-hot matmul keeps it MXU-shaped
+        disp = jnp.zeros((n, capacity, xs.shape[1]), jnp.float32)
+        e_idx = jnp.where(keep, choice, 0)
+        s_idx = jnp.where(keep, slot, 0)
+        payload = jnp.where(keep[:, None], xs, 0.0)
+        disp = disp.at[e_idx, s_idx].add(payload)
+        # exchange: after all_to_all over ep, device e holds the slots every
+        # peer packed for expert e → [n_sources, capacity, d]
+        recv = jax.lax.all_to_all(disp, "ep", split_axis=0, concat_axis=0, tiled=True)
+        y = _expert_mlp(recv.reshape(n * capacity, -1), wes[0])
+        y = y.reshape(n, capacity, -1)
+        # return trip: send each source its transformed slots back
+        back = jax.lax.all_to_all(y, "ep", split_axis=0, concat_axis=0, tiled=True)
+        # unpack: token i reads (choice i, slot i) from its own view
+        out = back[e_idx, s_idx]
+        out = jnp.where(keep[:, None], out, 0.0)  # dropped tokens: zeros
+        return out, keep, jax.lax.psum(dropped, "ep")
+
+    fn = jax.jit(
+        shard_map(
+            moe,
+            mesh=mesh,
+            in_specs=(P("ep", None), P(None, None), P("ep", None, None)),
+            out_specs=(P("ep", None), P("ep"), P()),
+        )
+    )
+    return mesh, fn, (x, wg, we), capacity
+
+
+def run_moe(
+    n_devices: Optional[int] = None,
+    tokens_per_device: int = 64,
+    d_model: int = 64,
+    capacity_factor: Optional[float] = None,
+    tol: float = 1e-4,
+) -> MoEResult:
+    import time
+
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+
+        mesh, fn, (x, wg, we), capacity = build_moe(
+            n_devices=n_devices,
+            tokens_per_device=tokens_per_device,
+            d_model=d_model,
+            capacity_factor=capacity_factor,
+        )
+        n = mesh.devices.size
+        t0 = time.perf_counter()
+        out, keep, dropped = fn(x, wg, we)
+        out.block_until_ready()
+        elapsed = time.perf_counter() - t0
+        dropped = int(dropped)
+        keep = np.asarray(keep)
+        # dense reference: each token through its argmax expert; dropped
+        # tokens (zeroed in the probe output) are excluded so the numerical
+        # check stays orthogonal to the capacity check
+        xn = np.asarray(x)
+        choice = np.argmax(xn @ np.asarray(wg), axis=-1)
+        wen = np.asarray(we)
+        # grouped by expert: n batched MXU-shaped calls instead of one
+        # un-jitted per-token dispatch each
+        ref = np.zeros_like(xn)
+        for e in range(mesh.devices.size):
+            sel = choice == e
+            if sel.any():
+                ref[sel] = np.asarray(
+                    _expert_mlp(jnp.asarray(xn[sel]), jnp.asarray(wen[e]))
+                )
+        diff = np.abs(np.asarray(out) - ref)[keep]
+        max_err = float(np.max(diff)) if diff.size else 0.0
+        errors = []
+        if dropped:
+            errors.append(f"{dropped} tokens dropped (capacity too low)")
+        if max_err > tol:
+            errors.append(f"divergence {max_err:.6f} > {tol}")
+        return MoEResult(
+            ok=not errors,
+            n_experts=n,
+            tokens=xn.shape[0],
+            capacity=capacity,
+            dropped=dropped,
+            max_abs_err=max_err,
+            elapsed_s=elapsed,
+            error="; ".join(errors),
+        )
+    except Exception as e:
+        return MoEResult(
+            ok=False,
+            n_experts=0,
+            tokens=0,
+            capacity=0,
+            dropped=0,
+            max_abs_err=float("nan"),
+            elapsed_s=0.0,
+            error=str(e),
+        )

@@ -1,0 +1,223 @@
+"""Pipeline-parallel (pp) probe: GPipe-style microbatch pipeline over ICI.
+
+Completes the mesh-axis coverage of the slice validation workloads: the
+burn-in proves dp/tp, the ring/ringattn probes prove the sp ring — this
+probe proves the *pipeline* pattern, where the model's layers are sharded
+across devices and activations stream stage-to-stage over ICI. Each device
+holds one MLP block ("stage"); microbatches enter at stage 0, and every
+tick each stage processes its resident microbatch and hands the activation
+to its successor with ``jax.lax.ppermute``. After ``n_micro + n_stages - 1``
+ticks every microbatch has traversed every stage — the classic GPipe
+schedule with bubbles at head and tail.
+
+Validation is exact: the pipelined output must match applying all stages
+sequentially on one device (f32, tight tolerance).
+
+TPU-first notes: the whole schedule is ONE jitted program — the tick loop
+is a device-side ``lax.scan``; stage weights live sharded over the ``pp``
+axis (each device's shard_map block sees only its own stage's weights);
+activations are static-shaped so each ``ppermute`` lowers onto a physical
+ICI hop; outputs are collected with a stage-masked ``psum`` rather than a
+gather, keeping the program collective-only.
+
+Used by ``tpu-validator --component pipeline`` and the multi-chip dryrun.
+Reference parity: none (the NVIDIA operator validates with vectorAdd
+only); mandated by the slice/topology story (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class PipelineResult:
+    ok: bool
+    n_stages: int
+    n_micro: int
+    ticks: int
+    max_abs_err: float
+    elapsed_s: float
+    error: str = ""
+
+    def to_dict(self):
+        return {
+            "ok": self.ok,
+            "n_stages": self.n_stages,
+            "n_micro": self.n_micro,
+            "ticks": self.ticks,
+            "max_abs_err": round(self.max_abs_err, 8),
+            "elapsed_s": round(self.elapsed_s, 6),
+            "error": self.error,
+        }
+
+
+def _stage_block(x, w):
+    """One pipeline stage: gelu MLP block (matmul → MXU). HIGHEST precision
+    so the probe-vs-sequential-reference comparison is not dominated by the
+    TPU's default bf16 f32-matmul passes."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.nn.gelu(
+        jnp.dot(
+            x,
+            w,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+    )
+
+
+def build_pipeline(
+    n_devices: Optional[int] = None,
+    n_micro: int = 8,
+    micro_batch: int = 4,
+    d_model: int = 128,
+):
+    """Returns (mesh, jitted pipeline fn, (x, w)).
+
+    ``x``: [n_micro, micro_batch, d_model] replicated inputs.
+    ``w``: [n_stages, d_model, d_model] stage weights sharded over ``pp``.
+    The fn returns [n_micro, micro_batch, d_model] outputs (replicated).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise RuntimeError(f"need {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    n = len(devices)
+    mesh = Mesh(np.asarray(devices), axis_names=("pp",))
+
+    key = jax.random.PRNGKey(3)
+    kx, kw = jax.random.split(key)
+    x = jax.random.normal(kx, (n_micro, micro_batch, d_model), jnp.float32)
+    # orthogonal-ish small weights keep activations O(1) through n stages
+    w = jax.random.normal(kw, (n, d_model, d_model), jnp.float32) * (
+        1.0 / d_model**0.5
+    )
+    x = jax.device_put(x, NamedSharding(mesh, P(None, None, None)))
+    w = jax.device_put(w, NamedSharding(mesh, P("pp", None, None)))
+
+    ticks = n_micro + n - 1
+    fwd_perm = [(i, i + 1) for i in range(n - 1)]  # no wraparound: a chain
+
+    def pipe(xs, ws):
+        # xs: [n_micro, mb, d] (replicated into each shard);
+        # ws: [1, d, d] — this device's stage weights
+        stage = jax.lax.axis_index("pp")
+        w_mine = ws[0]
+
+        def vary(v):
+            try:
+                return jax.lax.pcast(v, ("pp",), to="varying")
+            except (AttributeError, TypeError):  # pragma: no cover
+                return jax.lax.pvary(v, ("pp",))
+
+        out0 = vary(jnp.zeros_like(xs))
+        recv0 = vary(jnp.zeros(xs.shape[1:], xs.dtype))
+
+        def tick(carry, t):
+            recv, outs = carry
+            # stage 0 injects microbatch t (clamped; bubble ticks masked out
+            # downstream by the write-index guard)
+            inj = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, xs.shape[0] - 1), keepdims=False
+            )
+            inp = jnp.where(stage == 0, inj, recv)
+            act = _stage_block(inp, w_mine)
+            # microbatch id resident at this stage this tick; valid only in
+            # the diagonal window of the schedule
+            mb_id = t - stage
+            is_last = stage == n - 1
+            valid = is_last & (mb_id >= 0) & (mb_id < xs.shape[0])
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs,
+                jnp.where(valid, act, jax.lax.dynamic_index_in_dim(
+                    outs, jnp.clip(mb_id, 0, xs.shape[0] - 1), keepdims=False
+                )),
+                jnp.clip(mb_id, 0, xs.shape[0] - 1),
+                axis=0,
+            )
+            nxt = jax.lax.ppermute(act, axis_name="pp", perm=fwd_perm)
+            return (nxt, outs), None
+
+        (_, outs), _ = jax.lax.scan(
+            tick, (recv0, out0), jnp.arange(ticks)
+        )
+        # only the last stage holds real outputs; psum over the chain
+        # replicates them (all other stages contribute zeros)
+        outs = jnp.where(stage == n - 1, outs, jnp.zeros_like(outs))
+        return jax.lax.psum(outs, "pp")
+
+    fn = jax.jit(
+        shard_map(
+            pipe,
+            mesh=mesh,
+            in_specs=(P(None, None, None), P("pp", None, None)),
+            out_specs=P(None, None, None),
+        )
+    )
+    return mesh, fn, (x, w)
+
+
+def run_pipeline(
+    n_devices: Optional[int] = None,
+    n_micro: int = 8,
+    micro_batch: int = 4,
+    d_model: int = 128,
+    tol: float = 1e-4,
+) -> PipelineResult:
+    import time
+
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+
+        mesh, fn, (x, w) = build_pipeline(
+            n_devices=n_devices,
+            n_micro=n_micro,
+            micro_batch=micro_batch,
+            d_model=d_model,
+        )
+        n = mesh.devices.size
+        t0 = time.perf_counter()
+        out = fn(x, w)
+        out.block_until_ready()
+        elapsed = time.perf_counter() - t0
+        # sequential reference: all stages applied in order on one device
+        ref = np.asarray(x)
+        wn = np.asarray(w)
+        for s in range(n):
+            ref = np.asarray(_stage_block(jnp.asarray(ref), jnp.asarray(wn[s])))
+        max_err = float(np.max(np.abs(np.asarray(out) - ref)))
+        return PipelineResult(
+            ok=max_err <= tol,
+            n_stages=n,
+            n_micro=n_micro,
+            ticks=n_micro + n - 1,
+            max_abs_err=max_err,
+            elapsed_s=elapsed,
+            error="" if max_err <= tol else f"divergence {max_err:.6f} > {tol}",
+        )
+    except Exception as e:
+        return PipelineResult(
+            ok=False,
+            n_stages=0,
+            n_micro=n_micro,
+            ticks=0,
+            max_abs_err=float("nan"),
+            elapsed_s=0.0,
+            error=str(e),
+        )

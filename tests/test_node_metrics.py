@@ -1,0 +1,43 @@
+"""Node-status exporter: status-file gauges, including diagnostic probes."""
+
+import threading
+
+from tpu_operator import consts
+from tpu_operator.validator.components import StatusFiles
+from tpu_operator.validator.metrics import NodeMetrics
+
+
+def _run_one_watch_pass(nm):
+    nm.WATCH_STATUS_S = 0.01
+    t = threading.Thread(target=nm._watch_status_files, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.15)
+    nm._stop.set()
+    t.join(timeout=5)
+
+
+def test_status_file_gauges(tmp_path):
+    from prometheus_client import CollectorRegistry
+
+    reg = CollectorRegistry()
+    status = StatusFiles(str(tmp_path))
+    status.write(consts.STATUS_FILE_JAX, {"tflops": 123.4})
+    status.write("ringattn-ready", {"ok": True})
+    status.write("moe-ready", {"ok": True})
+    nm = NodeMetrics(node_name="n1", status=status, registry=reg)
+    _run_one_watch_pass(nm)
+
+    def g(name, **labels):
+        return reg.get_sample_value(name, labels)
+
+    assert g("tpu_validator_jax_ready", node="n1") == 1
+    assert g("tpu_validator_libtpu_ready", node="n1") == 0
+    assert g("tpu_validator_jax_matmul_tflops", node="n1") == 123.4
+    assert g("tpu_validator_probe_ready", node="n1", probe="ringattn") == 1
+    assert g("tpu_validator_probe_ready", node="n1", probe="moe") == 1
+    assert g("tpu_validator_probe_ready", node="n1", probe="pipeline") == 0
+    assert g("tpu_validator_probe_ready", node="n1", probe="membw") == 0
+    assert g("tpu_validator_probe_ready", node="n1", probe="slice") == 0
+    assert g("tpu_validator_probe_ready", node="n1", probe="ici") == 0

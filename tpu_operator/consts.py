@@ -130,6 +130,16 @@ STATUS_FILE_RUNTIME = "runtime-ready"
 STATUS_FILE_PLUGIN = "plugin-ready"
 STATUS_FILE_JAX = "jax-ready"
 STATUS_FILE_SLICE = "slice-ready"
+# diagnostic probes (opt-in / on-demand): surfaced by the node-status
+# exporter as tpu_validator_probe_ready{probe=...}
+PROBE_STATUS_FILES = (
+    "slice-ready",
+    "ici-ready",
+    "ringattn-ready",
+    "pipeline-ready",
+    "moe-ready",
+    "membw-ready",
+)
 STATUS_FILE_LIBTPU_CTR = ".libtpu-ctr-ready"  # startupProbe barrier
 
 LIBTPU_HOST_DIR = "/home/kubernetes/lib/tpu"

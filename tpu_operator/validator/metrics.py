@@ -67,6 +67,15 @@ class NodeMetrics:
         self.g_jax_tflops = mk(
             "jax_matmul_tflops", "TFLOPS recorded by the last jax validation"
         )
+        # one labeled series per diagnostic probe (slice/ici/ringattn/
+        # pipeline/moe/membw) — 1 when its status file is present; probes
+        # are opt-in, so 0 just means "not run on this node"
+        self.g_probe = Gauge(
+            f"{ns}_probe_ready",
+            "diagnostic probe status file present",
+            ["node", "probe"],
+            **kw,
+        )
 
     # ------------------------------------------------------------------
     def _watch_status_files(self):
@@ -79,6 +88,11 @@ class NodeMetrics:
         while not self._stop.is_set():
             for name, gauge in files.items():
                 gauge.labels(node=self.node_name).set(
+                    1 if self.status.exists(name) else 0
+                )
+            for name in consts.PROBE_STATUS_FILES:
+                probe = name.removesuffix("-ready")
+                self.g_probe.labels(node=self.node_name, probe=probe).set(
                     1 if self.status.exists(name) else 0
                 )
             # surface the recorded TFLOPS from the jax status payload

@@ -293,6 +293,63 @@ def test_membw_validation_opt_in(monkeypatch):
     assert env.get("MEMBW_MIN_UTILIZATION") == "0.4"
 
 
+def test_workload_pod_image_env_injected(monkeypatch):
+    """The jax/plugin validation containers carry the CR-configured
+    validator image + pull credentials for the workload pods they spawn
+    (reference ValidatorImage*/PullSecrets env injection,
+    object_controls.go:1906-1912)."""
+    cr = load_cr()
+    cr["spec"]["validator"] = {
+        "repository": "registry.example/v",
+        "version": "1.2.3",
+        "imagePullPolicy": "Always",
+        "imagePullSecrets": ["sec-a", "sec-b"],
+    }
+    client = reconcile_with(cr, monkeypatch)
+    ds = get_ds(client, "tpu-operator-validator")
+    inits = {c["name"]: c for c in ds["spec"]["template"]["spec"]["initContainers"]}
+    for name in ("jax-validation", "plugin-validation"):
+        env = {e["name"]: e.get("value") for e in inits[name].get("env", [])}
+        assert env["JAX_WORKLOAD_IMAGE"] == (
+            "registry.example/v/tpu-operator-validator:1.2.3"
+        )
+        assert env["JAX_WORKLOAD_PULL_POLICY"] == "Always"
+        assert env["JAX_WORKLOAD_PULL_SECRETS"] == "sec-a,sec-b"
+    # not injected into non-spawning validation containers
+    env = {e["name"] for e in inits["libtpu-validation"].get("env", [])}
+    assert "JAX_WORKLOAD_IMAGE" not in env
+
+
+def test_workload_pod_spec_honors_pull_env(monkeypatch):
+    from tpu_operator.validator.workload_pods import jax_workload_pod
+
+    monkeypatch.setenv("JAX_WORKLOAD_IMAGE", "r.example/v:9")
+    monkeypatch.setenv("JAX_WORKLOAD_PULL_POLICY", "Always")
+    monkeypatch.setenv("JAX_WORKLOAD_PULL_SECRETS", "s1,s2")
+    pod = jax_workload_pod("node-a", "ns1")
+    ctr = pod["spec"]["containers"][0]
+    assert ctr["image"] == "r.example/v:9"
+    assert ctr["imagePullPolicy"] == "Always"
+    assert pod["spec"]["imagePullSecrets"] == [{"name": "s1"}, {"name": "s2"}]
+
+
+def test_daemonsets_labels_cannot_override_selector_keys(monkeypatch):
+    """User daemonsets.labels must not override 'app' or
+    'app.kubernetes.io/part-of' — DaemonSet pod selectors are immutable and
+    an override would orphan the pods (reference
+    applyCommonDaemonsetMetadata, object_controls.go:702-716)."""
+    cr = load_cr()
+    cr["spec"]["daemonsets"] = {
+        "labels": {"app": "evil", "team": "ml", "app.kubernetes.io/part-of": "x"}
+    }
+    client = reconcile_with(cr, monkeypatch)
+    ds = get_ds(client, "tpu-device-plugin-daemonset")
+    labels = ds["spec"]["template"]["metadata"]["labels"]
+    assert labels["team"] == "ml"
+    assert labels["app"] != "evil"
+    assert labels.get("app.kubernetes.io/part-of") != "x"
+
+
 def test_ringattn_validation_opt_in(monkeypatch):
     """validator.ringattn.enabled appends the context-parallel probe after
     the other diagnostics; off by default; ordering jax → membw → ringattn

@@ -391,7 +391,17 @@ def _apply_common_daemonset_config(n, ds: Obj) -> None:
     tmpl = ds["spec"]["template"]
     pod_spec = tmpl["spec"]
     if dspec.labels:
-        tmpl["metadata"].setdefault("labels", {}).update(dspec.labels)
+        # "app" and "app.kubernetes.io/part-of" stay operator-owned:
+        # DaemonSet pod selectors are immutable, so a user override would
+        # orphan the pods (reference applyCommonDaemonsetMetadata,
+        # controllers/object_controls.go:702-716)
+        tmpl["metadata"].setdefault("labels", {}).update(
+            {
+                k: v
+                for k, v in dspec.labels.items()
+                if k not in ("app", "app.kubernetes.io/part-of")
+            }
+        )
     if dspec.annotations:
         tmpl["metadata"].setdefault("annotations", {}).update(dspec.annotations)
     if dspec.tolerations:
@@ -706,6 +716,24 @@ def transform_validator(n, ds: Obj, generation: Optional[str] = None) -> None:
         }.get(c["name"])
         for e in (component_env or {}).get("env", []) or []:
             _set_container_env(c, e["name"], e["value"])
+        if c["name"] in ("plugin-validation", "jax-validation"):
+            # workload-pod spin-off config: the spawned pod must use the
+            # CR-configured validator image + pull credentials, not a
+            # baked-in default (reference injects ValidatorImage*/
+            # PullSecrets env for the cuda/plugin workload pods,
+            # controllers/object_controls.go:1906-1912)
+            image = spec.image_path()
+            if image:
+                _set_container_env(c, "JAX_WORKLOAD_IMAGE", image)
+                _set_container_env(
+                    c, "JAX_WORKLOAD_PULL_POLICY", spec.pull_policy()
+                )
+            if spec.image_pull_secrets:
+                _set_container_env(
+                    c,
+                    "JAX_WORKLOAD_PULL_SECRETS",
+                    ",".join(spec.image_pull_secrets),
+                )
 
 
 @_register("tpu-metricsd")

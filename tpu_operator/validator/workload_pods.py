@@ -41,6 +41,18 @@ PLUGIN_SMOKE_SCRIPT = (
 def _workload_pod(
     name: str, node_name: str, namespace: str, script: str, image: str
 ) -> dict:
+    import os
+
+    # pull policy/secrets follow the validator's own (injected by
+    # transform_validator; reference sets ValidatorImage*/PullSecrets env on
+    # the cuda/plugin validation containers for the same spin-off purpose,
+    # controllers/object_controls.go:1906-1912)
+    pull_policy = os.environ.get("JAX_WORKLOAD_PULL_POLICY", "IfNotPresent")
+    pull_secrets = [
+        {"name": s}
+        for s in os.environ.get("JAX_WORKLOAD_PULL_SECRETS", "").split(",")
+        if s
+    ]
     return {
         "apiVersion": "v1",
         "kind": "Pod",
@@ -60,10 +72,12 @@ def _workload_pod(
                     "effect": "NoSchedule",
                 }
             ],
+            "imagePullSecrets": pull_secrets,
             "containers": [
                 {
                     "name": name,
                     "image": image,
+                    "imagePullPolicy": pull_policy,
                     "command": ["python3", "-c", script],
                     "resources": {
                         "limits": {consts.TPU_RESOURCE: "1"},

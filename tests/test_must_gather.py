@@ -1,0 +1,55 @@
+"""must-gather.sh smoke test with a stub kubectl (component #16 — the one
+in-repo component the reference leaves untested; we don't)."""
+
+import os
+import stat
+import subprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "hack", "must-gather.sh")
+
+STUB = """#!/usr/bin/env bash
+# records each invocation; emits canned output ('echo "$@"' would eat a
+# leading -n flag, so printf)
+printf '%s\\n' "$*" >> "$STUB_LOG"
+case "$*" in
+  *"get pods -o name"*) echo "pod/tpu-operator-abc"; echo "pod/tpu-libtpu-xyz" ;;
+  *logs*) echo "log line" ;;
+  *) echo "kind: List" ;;
+esac
+"""
+
+
+def test_must_gather_collects(tmp_path):
+    kubectl = tmp_path / "kubectl"
+    kubectl.write_text(STUB)
+    kubectl.chmod(kubectl.stat().st_mode | stat.S_IEXEC)
+    out = tmp_path / "bundle"
+    log = tmp_path / "calls.log"
+    env = dict(
+        os.environ,
+        KUBECTL=str(kubectl),
+        ARTIFACT_DIR=str(out),
+        OPERATOR_NAMESPACE="tpu-ns",
+        STUB_LOG=str(log),
+    )
+    res = subprocess.run(
+        ["bash", SCRIPT], env=env, capture_output=True, text=True, timeout=60
+    )
+    assert res.returncode == 0, res.stderr
+    for f in (
+        "version.yaml",
+        "clusterpolicy.yaml",
+        "nodes.yaml",
+        "node-labels.txt",
+        "slice-status.json",
+        "daemonsets.yaml",
+        "events.txt",
+    ):
+        assert (out / f).exists(), f
+    # per-pod logs from the stubbed pod list
+    assert (out / "pod-logs" / "tpu-operator-abc.log").exists()
+    assert (out / "pod-logs" / "tpu-libtpu-xyz.log").exists()
+    calls = log.read_text()
+    assert "-n tpu-ns get daemonsets -o yaml" in calls
+    assert "--all-containers" in calls

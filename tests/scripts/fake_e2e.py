@@ -179,6 +179,41 @@ def main() -> int:
     res = converge()
     assert res.ready, f"did not recover on node arrival: {res}"
 
+    print("=== parallelism-probes (ici/ringattn/pipeline/moe on a virtual mesh)")
+    import jax
+
+    if len(jax.devices()) < 8 or jax.devices()[0].platform != "cpu":
+        # fake e2e must not grab real hardware; force the 8-device CPU mesh
+        # (same re-forcing the dryrun does when a sitecustomize bound the
+        # real platform first)
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+
+    import tempfile
+
+    from tpu_operator.validator import main as vmain
+
+    probe_dir = tempfile.mkdtemp(prefix="fake-e2e-val-")
+    for component in ("ici", "ringattn", "pipeline", "moe"):
+        rc = vmain.main(
+            [
+                "--component",
+                component,
+                "--output-dir",
+                probe_dir,
+                "--expect-devices",
+                "8",
+                "--ringattn-seq-len",
+                "256",
+            ]
+        )
+        assert rc == 0, f"{component} probe failed"
+        assert os.path.exists(os.path.join(probe_dir, f"{component}-ready"))
+    print("ok: all parallelism probes passed on the 8-device mesh")
+
     print("=== uninstall (delete CR → operands garbage-collected by ownerRef)")
     client.delete(CP, "ClusterPolicy", "cluster-policy")
     # fake client implements ownerRef cascade like the API server's GC

@@ -531,6 +531,12 @@ class ValidatorSpec(_ImageSpec):
     # [...]}); proves the context-parallel path on multi-chip hosts, off by
     # default for the same chip-holding reason as membw
     ringattn: Optional[Dict[str, Any]] = None
+    # optional ICI ring probe: per-link integrity + bandwidth via ppermute
+    ici: Optional[Dict[str, Any]] = None
+    # optional pipeline-parallel probe: GPipe microbatch schedule over pp
+    pipeline: Optional[Dict[str, Any]] = None
+    # optional expert-parallel probe: MoE all_to_all dispatch/combine
+    moe: Optional[Dict[str, Any]] = None
 
     ENV_VAR = "TPU_VALIDATOR_IMAGE"
 

@@ -676,14 +676,19 @@ def transform_validator(n, ds: Obj, generation: Optional[str] = None) -> None:
     _apply_resources(main, spec)
     inits = ds["spec"]["template"]["spec"].setdefault("initContainers", [])
     # optional deep diagnostics appended after jax-validation (the chip is
-    # already proven free): membw = dcgmi-diag memory-bandwidth analogue,
-    # ringattn = context-parallel long-context probe. Containers are cloned
-    # from jax-validation — without it (custom assets) there is nothing
-    # sane to clone, so skip.
-    for comp_name, comp_spec in (
+    # already proven free): membw = dcgmi-diag memory-bandwidth analogue;
+    # ringattn/ici/pipeline/moe = parallelism-axis probes. Containers are
+    # cloned from jax-validation — without it (custom assets) there is
+    # nothing sane to clone, so skip.
+    optional_diags = (
         ("membw", spec.membw),
         ("ringattn", spec.ringattn),
-    ):
+        ("ici", spec.ici),
+        ("pipeline", spec.pipeline),
+        ("moe", spec.moe),
+    )
+    diag_ctr_names = tuple(f"{name}-validation" for name, _ in optional_diags)
+    for comp_name, comp_spec in optional_diags:
         ctr_name = f"{comp_name}-validation"
         if (comp_spec or {}).get("enabled") and not any(
             c["name"] == ctr_name for c in inits
@@ -696,12 +701,12 @@ def transform_validator(n, ds: Obj, generation: Optional[str] = None) -> None:
                 ctr = copy.deepcopy(inits[jax_idx])
                 ctr["name"] = ctr_name
                 ctr["args"] = [f"tpu-validator --component {comp_name}"]
-                # chain order: jax → membw → ringattn (each insert lands
-                # directly after the previously injected diagnostic)
+                # chain order: jax → diagnostics in optional_diags order
+                # (each insert lands after the previously injected one)
                 insert_at = jax_idx + 1
-                while insert_at < len(inits) and inits[insert_at]["name"] in (
-                    "membw-validation",
-                    "ringattn-validation",
+                while (
+                    insert_at < len(inits)
+                    and inits[insert_at]["name"] in diag_ctr_names
                 ):
                     insert_at += 1
                 inits.insert(insert_at, ctr)
@@ -713,6 +718,9 @@ def transform_validator(n, ds: Obj, generation: Optional[str] = None) -> None:
             "runtime-validation": spec.runtime,
             "membw-validation": spec.membw,
             "ringattn-validation": spec.ringattn,
+            "ici-validation": spec.ici,
+            "pipeline-validation": spec.pipeline,
+            "moe-validation": spec.moe,
         }.get(c["name"])
         for e in (component_env or {}).get("env", []) or []:
             _set_container_env(c, e["name"], e["value"])

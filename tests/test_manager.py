@@ -172,3 +172,14 @@ def test_debug_endpoints_default_off():
     finally:
         srv.shutdown()
         mgr.stop()
+
+
+def test_main_once_mode(monkeypatch):
+    """--once runs one converge pass and exits: 0 when Ready (with the
+    kubelet sim), 2 when the fake DaemonSets never report ready."""
+    from tpu_operator.main import main
+
+    monkeypatch.setenv("OPERATOR_NAMESPACE", "tpu-operator")
+    monkeypatch.setenv("UNIT_TEST", "true")
+    assert main(["--fake", "--simulate-kubelet", "--once"]) == 0
+    assert main(["--fake", "--once"]) == 2

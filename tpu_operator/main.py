@@ -54,6 +54,13 @@ def build_args(argv=None):
         "their pods, so the cluster converges to Ready",
     )
     p.add_argument("--log-level", default="INFO")
+    p.add_argument(
+        "--once",
+        action="store_true",
+        help="run a single reconcile pass of both controllers and exit "
+        "(exit 0 when the ClusterPolicy is Ready, 2 otherwise) — for CI "
+        "and scripted smoke checks",
+    )
     return p.parse_args(argv)
 
 
@@ -133,6 +140,22 @@ def main(argv=None) -> int:
 
     upgrade = UpgradeReconciler(client, namespace)
     mgr.add_reconciler(UPGRADE_KEY, lambda _key: upgrade.reconcile())
+
+    if args.once:
+        if args.fake and args.simulate_kubelet:
+            from tpu_operator.kube.testing import simulate_kubelet_once
+
+            # converge like the fake e2e: reconcile + kubelet sim rounds
+            for _ in range(30):
+                res = reconciler.reconcile()
+                simulate_kubelet_once(client, namespace)
+                if res.ready:
+                    break
+        else:
+            res = reconciler.reconcile()
+        upgrade.reconcile()
+        log.info("single pass done: ready=%s", res.ready)
+        return 0 if res.ready else 2
 
     # watches feed the workqueue (reference watch wiring,
     # controllers/clusterpolicy_controller.go:317-344)

@@ -160,3 +160,31 @@ def test_node_event_predicates():
     new2 = make_tpu_node("n1")
     new2["metadata"]["labels"][consts.DEPLOY_LABEL_PREFIX + "libtpu"] = "false"
     assert node_event_needs_reconcile("MODIFIED", tpu, new2)
+
+
+def test_step_exception_records_failure_metric(env, monkeypatch):
+    """An exception inside a state step propagates (the manager requeues
+    with backoff) but first lands in the reconcile metrics as a failed run
+    (reference reconciliation_status=-1 semantics)."""
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            make_tpu_node("tpu-node-1"),
+        ]
+    )
+    client.create(load_cr())
+    r = ClusterPolicyReconciler(client, assets_dir=ASSETS)
+    recorded = []
+    monkeypatch.setattr(
+        r.metrics, "observe_reconcile", lambda v: recorded.append(v)
+    )
+
+    def boom():
+        raise RuntimeError("control exploded")
+
+    monkeypatch.setattr(r.ctrl, "step", boom)
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="control exploded"):
+        r.reconcile()
+    assert recorded[-1] == -1

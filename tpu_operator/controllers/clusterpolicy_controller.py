@@ -89,17 +89,23 @@ class ClusterPolicyReconciler:
         overall = State.READY
         not_ready_states = []
         self.ctrl.idx = 0
-        while not self.ctrl.last():
-            state_name = self.ctrl.state_names[self.ctrl.idx]
-            status = self.ctrl.step()
-            self.metrics.set_state(
-                state_name,
-                {State.READY: 1, State.NOT_READY: 0}.get(status, -1),
-            )
-            if status == State.NOT_READY:
-                overall = State.NOT_READY
-                not_ready_states.append(state_name)
-                log.info("state %s not ready; will requeue", state_name)
+        try:
+            while not self.ctrl.last():
+                state_name = self.ctrl.state_names[self.ctrl.idx]
+                status = self.ctrl.step()
+                self.metrics.set_state(
+                    state_name,
+                    {State.READY: 1, State.NOT_READY: 0}.get(status, -1),
+                )
+                if status == State.NOT_READY:
+                    overall = State.NOT_READY
+                    not_ready_states.append(state_name)
+                    log.info("state %s not ready; will requeue", state_name)
+        except Exception:
+            # record the failure before the manager's rate-limited requeue
+            # (reference sets reconciliation_status=-1 on errored runs)
+            self.metrics.observe_reconcile(-1)
+            raise
 
         slice_summary = self._aggregate_slices()
 

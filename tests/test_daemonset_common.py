@@ -293,6 +293,34 @@ def test_membw_validation_opt_in(monkeypatch):
     assert env.get("MEMBW_MIN_UTILIZATION") == "0.4"
 
 
+def test_libtpu_manager_drain_env_injected(monkeypatch):
+    """upgradePolicy.drain knobs land on the libtpu-manager initContainer as
+    the reference's k8s-driver-manager env set
+    (assets/state-driver/0500_daemonset.yaml:77-86)."""
+    cr = load_cr()
+    cr["spec"].setdefault("libtpu", {})["upgradePolicy"] = {
+        "autoUpgrade": True,
+        "drain": {
+            "enable": True,
+            "force": True,
+            "podSelector": "drain=me",
+            "timeoutSeconds": 120,
+        },
+    }
+    client = reconcile_with(cr, monkeypatch)
+    ds = get_ds(client, "tpu-libtpu-daemonset")
+    mgr = next(
+        c
+        for c in ds["spec"]["template"]["spec"]["initContainers"]
+        if c["name"] == "libtpu-manager"
+    )
+    env = {e["name"]: e.get("value") for e in mgr.get("env", [])}
+    assert env["ENABLE_AUTO_DRAIN"] == "true"
+    assert env["DRAIN_USE_FORCE"] == "true"
+    assert env["DRAIN_POD_SELECTOR_LABEL"] == "drain=me"
+    assert env["DRAIN_TIMEOUT_SECONDS"] == "120"
+
+
 def test_workload_pod_image_env_injected(monkeypatch):
     """The jax/plugin validation containers carry the CR-configured
     validator image + pull credentials for the workload pods they spawn

@@ -422,3 +422,63 @@ def test_vfio_plugin_servicer(tmp_path):
     resp = servicer.Allocate(req, None)
     paths = [d.host_path for d in resp.container_responses[0].devices]
     assert paths == ["/dev/vfio/7", "/dev/vfio/vfio"]
+
+
+def test_libtpu_manager_auto_drain_disabled(tmp_path):
+    """ENABLE_AUTO_DRAIN=false clears barriers but leaves workloads alone."""
+    status = StatusFiles(str(tmp_path / "val"))
+    status.write("libtpu-ready")
+    client = FakeClient()
+    client.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": "train",
+                "namespace": "default",
+                "ownerReferences": [{"kind": "Job", "name": "j", "uid": "u"}],
+            },
+            "spec": {
+                "nodeName": "n1",
+                "containers": [{"resources": {"limits": {"google.com/tpu": "4"}}}],
+            },
+        }
+    )
+    rc = libtpu_manager.uninstall_libtpu(client, "n1", status, evict=False)
+    assert rc == 0
+    assert not status.exists("libtpu-ready")
+    assert client.get_or_none("v1", "Pod", "train", "default") is not None
+
+
+def test_libtpu_manager_pod_selector_evicts_extra_pods(tmp_path):
+    """DRAIN_POD_SELECTOR_LABEL widens eviction to matching non-TPU pods on
+    the node (reference k8s-driver-manager knob)."""
+    status = StatusFiles(str(tmp_path / "val"))
+    client = FakeClient()
+
+    def pod(name, labels=None, node="n1", tpu=False):
+        res = {"limits": {"google.com/tpu": "1"}} if tpu else {}
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "namespace": "default",
+                "labels": labels or {},
+                "ownerReferences": [{"kind": "Job", "name": "j", "uid": "u"}],
+            },
+            "spec": {"nodeName": node, "containers": [{"resources": res}]},
+        }
+
+    client.create(pod("tpu-train", tpu=True))
+    client.create(pod("sidecar", labels={"drain": "me", "tier": "aux"}))
+    client.create(pod("bystander", labels={"tier": "aux"}))
+    client.create(pod("other-node", labels={"drain": "me"}, node="n2"))
+    rc = libtpu_manager.uninstall_libtpu(
+        client, "n1", status, pod_selector="drain=me"
+    )
+    assert rc == 0
+    assert client.get_or_none("v1", "Pod", "tpu-train", "default") is None
+    assert client.get_or_none("v1", "Pod", "sidecar", "default") is None
+    assert client.get_or_none("v1", "Pod", "bystander", "default") is not None
+    assert client.get_or_none("v1", "Pod", "other-node", "default") is not None

@@ -603,8 +603,24 @@ def transform_libtpu(n, ds: Obj, generation: Optional[str] = None) -> None:
     if mgr is not None:
         mgr["image"] = spec.image_path()
         pol = spec.upgrade_policy
-        if pol and pol.drain and pol.drain.force:
-            _set_container_env(mgr, "DRAIN_USE_FORCE", "true")
+        drain = pol.drain if pol else None
+        if drain:
+            # full drain knob set (reference k8s-driver-manager env,
+            # assets/state-driver/0500_daemonset.yaml:77-86)
+            if drain.enable is not None:
+                _set_container_env(
+                    mgr, "ENABLE_AUTO_DRAIN", "true" if drain.enable else "false"
+                )
+            if drain.force:
+                _set_container_env(mgr, "DRAIN_USE_FORCE", "true")
+            if drain.pod_selector:
+                _set_container_env(
+                    mgr, "DRAIN_POD_SELECTOR_LABEL", drain.pod_selector
+                )
+            if drain.timeout_seconds:
+                _set_container_env(
+                    mgr, "DRAIN_TIMEOUT_SECONDS", str(drain.timeout_seconds)
+                )
     # rolling-update override
     if spec.rolling_update and ds["spec"]["updateStrategy"]["type"] == "RollingUpdate":
         ds["spec"]["updateStrategy"]["rollingUpdate"] = {

@@ -22,12 +22,27 @@ from tpu_operator.validator.components import StatusFiles
 log = logging.getLogger("tpu-libtpu-manager")
 
 
+def _matches_selector(pod: dict, selector: str) -> bool:
+    """k=v[,k=v...] label match (reference DRAIN_POD_SELECTOR_LABEL)."""
+    labels = pod.get("metadata", {}).get("labels", {}) or {}
+    for clause in selector.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        k, _, v = clause.partition("=")
+        if labels.get(k.strip()) != v.strip():
+            return False
+    return True
+
+
 def uninstall_libtpu(
     client,
     node_name: str,
     status: StatusFiles,
     force: bool = False,
     eviction_timeout_s: float = 300.0,
+    evict: bool = True,
+    pod_selector: str = "",
 ) -> int:
     from tpu_operator.upgrade.upgrade_state import PodManager
 
@@ -42,10 +57,38 @@ def uninstall_libtpu(
     ):
         status.remove(name)
 
-    # 2. evict TPU workload pods still holding the chip
+    if not evict:
+        # ENABLE_AUTO_DRAIN=false: the admin owns workload eviction; we only
+        # cleared the barriers (reference k8s-driver-manager gate)
+        log.warning("auto-drain disabled; not evicting TPU pods")
+        return 0
+
+    # 2. evict TPU workload pods still holding the chip (plus any pods
+    #    matching the configured drain selector)
     if client is not None and node_name:
         pm = PodManager(client, "")
-        pods = pm.tpu_pods_on_node(node_name)
+
+        def pods_to_evict():
+            pods = pm.tpu_pods_on_node(node_name)
+            if pod_selector:
+                seen = {
+                    (p["metadata"].get("namespace"), p["metadata"]["name"])
+                    for p in pods
+                }
+                for pod in pm.client.list("v1", "Pod"):
+                    key = (
+                        pod["metadata"].get("namespace"),
+                        pod["metadata"]["name"],
+                    )
+                    if (
+                        pod.get("spec", {}).get("nodeName") == node_name
+                        and key not in seen
+                        and _matches_selector(pod, pod_selector)
+                    ):
+                        pods.append(pod)
+            return pods
+
+        pods = pods_to_evict()
         if pods:
             log.info("evicting %d TPU pods from %s", len(pods), node_name)
             pm.delete_pods(pods, force=force)
@@ -58,7 +101,7 @@ def uninstall_libtpu(
             # (re)created since the last pass — those get evicted again.
             deadline = time.monotonic() + eviction_timeout_s
             while True:
-                pods_now = pm.tpu_pods_on_node(node_name)
+                pods_now = pods_to_evict()
                 if not pods_now:
                     break
                 undeleted = [
@@ -106,6 +149,20 @@ def main(argv=None) -> int:
         action="store_true",
         default=os.environ.get("DRAIN_USE_FORCE", "") == "true",
     )
+    p.add_argument(
+        "--timeout-seconds",
+        type=float,
+        default=float(os.environ.get("DRAIN_TIMEOUT_SECONDS", "300")),
+    )
+    p.add_argument(
+        "--pod-selector",
+        default=os.environ.get("DRAIN_POD_SELECTOR_LABEL", ""),
+    )
+    p.add_argument(
+        "--no-evict",
+        action="store_true",
+        default=os.environ.get("ENABLE_AUTO_DRAIN", "true") == "false",
+    )
     args = p.parse_args(argv)
     status = StatusFiles(args.output_dir)
 
@@ -120,7 +177,15 @@ def main(argv=None) -> int:
     if args.command == "preflight":
         # nothing to prepare on TPU hosts (no kernel, no mofed); succeed
         return 0
-    return uninstall_libtpu(client, args.node_name, status, force=args.force)
+    return uninstall_libtpu(
+        client,
+        args.node_name,
+        status,
+        force=args.force,
+        eviction_timeout_s=args.timeout_seconds,
+        evict=not args.no_evict,
+        pod_selector=args.pod_selector,
+    )
 
 
 if __name__ == "__main__":

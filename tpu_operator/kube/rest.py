@@ -17,6 +17,7 @@ from __future__ import annotations
 import json
 import os
 import ssl
+import time
 from http.client import HTTPSConnection
 from typing import Dict, List, Optional
 from urllib.parse import quote, urlencode
@@ -24,6 +25,10 @@ from urllib.parse import quote, urlencode
 from tpu_operator.kube.client import Client, ConflictError, NotFoundError, Obj
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class TransientAPIError(RuntimeError):
+    """429 / 5xx from the API server — retryable for idempotent reads."""
 
 # kind -> (plural, namespaced)
 KIND_TABLE: Dict[str, tuple] = {
@@ -109,8 +114,37 @@ class RestClient(Client):
             return ""
 
     # -- low-level -------------------------------------------------------
+    def _make_conn(self, timeout: float = 30):
+        """Connection factory (separated so tests can point the client at a
+        plain-HTTP stub API server)."""
+        return HTTPSConnection(
+            self.host, self.port, context=self._ctx, timeout=timeout
+        )
+
+    GET_RETRIES = 3  # idempotent reads only; mutations are retried by the
+    GET_RETRY_BACKOFF_S = 0.5  # reconcile loop's rate-limited requeue
+
     def _request(self, method: str, path: str, body: Optional[Obj] = None) -> Obj:
-        conn = HTTPSConnection(self.host, self.port, context=self._ctx, timeout=30)
+        attempts = self.GET_RETRIES if method == "GET" else 1
+        last_err: Optional[Exception] = None
+        for attempt in range(attempts):
+            if attempt:
+                time.sleep(self.GET_RETRY_BACKOFF_S * (2 ** (attempt - 1)))
+            try:
+                return self._request_once(method, path, body)
+            except (NotFoundError, ConflictError):
+                raise  # semantic statuses, not transient
+            except (OSError, TransientAPIError) as e:
+                # connection refused/reset, 429, 5xx: the API server (or a
+                # lagging webhook) hiccupped — worth a bounded retry for an
+                # idempotent read
+                last_err = e
+            except RuntimeError:
+                raise  # other 4xx: retrying cannot help
+        raise last_err  # type: ignore[misc]
+
+    def _request_once(self, method: str, path: str, body: Optional[Obj]) -> Obj:
+        conn = self._make_conn()
         headers = {
             "Accept": "application/json",
             "Content-Type": "application/json",
@@ -127,6 +161,10 @@ class RestClient(Client):
                 raise NotFoundError(path)
             if resp.status == 409:
                 raise ConflictError(path)
+            if resp.status == 429 or resp.status >= 500:
+                raise TransientAPIError(
+                    f"{method} {path} -> {resp.status}: {data[:512]!r}"
+                )
             if resp.status >= 400:
                 raise RuntimeError(
                     f"{method} {path} -> {resp.status}: {data[:512]!r}"
@@ -290,9 +328,7 @@ class RestClient(Client):
         if rv:
             params["resourceVersion"] = rv
         path += "?" + urlencode(params)
-        conn = HTTPSConnection(
-            self.host, self.port, context=self._ctx, timeout=timeout_s + 30
-        )
+        conn = self._make_conn(timeout=timeout_s + 30)
         try:
             headers = {"Accept": "application/json"}
             token = self._token()

@@ -1,0 +1,57 @@
+"""Manifest loader behaviors (reference ``controllers/resource_manager.go``)."""
+
+import pytest
+
+from tpu_operator.controllers.resource_manager import (
+    add_resources_controls,
+    get_assets_from,
+)
+
+
+@pytest.fixture()
+def state_dir(tmp_path):
+    (tmp_path / "0100_sa.yaml").write_text(
+        "apiVersion: v1\nkind: ServiceAccount\nmetadata: {name: sa}\n"
+    )
+    (tmp_path / "0500_ds.yaml").write_text(
+        "apiVersion: apps/v1\nkind: DaemonSet\nmetadata: {name: ds}\n"
+        "---\n"
+        "apiVersion: v1\nkind: ConfigMap\nmetadata: {name: cm}\n"
+    )
+    (tmp_path / "0300_openshift_scc.yaml").write_text(
+        "kind: SecurityContextConstraints\nmetadata: {name: scc}\n"
+    )
+    (tmp_path / "notes.txt").write_text("not yaml")
+    (tmp_path / "subdir").mkdir()
+    return tmp_path
+
+
+def test_sorted_walk_and_openshift_skip(state_dir):
+    files = get_assets_from(str(state_dir), openshift=False)
+    names = [f.rsplit("/", 1)[1] for f in files]
+    assert names == ["0100_sa.yaml", "0500_ds.yaml"]  # sorted, scc skipped
+    files = get_assets_from(str(state_dir), openshift=True)
+    names = [f.rsplit("/", 1)[1] for f in files]
+    assert names == ["0100_sa.yaml", "0300_openshift_scc.yaml", "0500_ds.yaml"]
+
+
+def test_controls_in_file_order_with_multidoc(state_dir):
+    res, controls = add_resources_controls(str(state_dir))
+    assert [c for c, _ in controls] == ["service_account", "daemonset", "config_map"]
+    assert res.first("DaemonSet")["metadata"]["name"] == "ds"
+    assert res.of("ConfigMap")[0]["metadata"]["name"] == "cm"
+    assert res.of("Service") == []
+    with pytest.raises(KeyError):
+        res.first("Service")
+
+
+def test_unknown_kind_rejected(tmp_path):
+    (tmp_path / "0100_x.yaml").write_text("kind: FancyNewKind\nmetadata: {name: x}\n")
+    with pytest.raises(ValueError, match="unhandled kind"):
+        add_resources_controls(str(tmp_path))
+
+
+def test_document_without_kind_rejected(tmp_path):
+    (tmp_path / "0100_x.yaml").write_text("metadata: {name: x}\n")
+    with pytest.raises(ValueError, match="without kind"):
+        add_resources_controls(str(tmp_path))

@@ -1,0 +1,69 @@
+"""/dev/char symlink workaround (reference createDevCharSymlinks slot)."""
+
+import os
+import stat
+
+import pytest
+
+from tpu_operator.operands import devchar
+
+
+def _try_mknod(path, major, minor):
+    try:
+        os.mknod(path, 0o600 | stat.S_IFCHR, os.makedev(major, minor))
+        return True
+    except (OSError, PermissionError):
+        return False
+
+
+def test_char_scan_and_symlinks(tmp_path, monkeypatch):
+    dev = tmp_path / "dev"
+    (dev / "vfio").mkdir(parents=True)
+    # regular files must be ignored (not char devices)
+    (dev / "accel9").write_text("")
+    made_real = _try_mknod(str(dev / "accel0"), 240, 0) and _try_mknod(
+        str(dev / "vfio" / "7"), 241, 7
+    )
+    if not made_real:
+        # sandbox without CAP_MKNOD: inject the scan result instead
+        monkeypatch.setattr(
+            devchar,
+            "_char_devices",
+            lambda dev_root="/dev": [
+                (str(dev / "accel0"), 240, 0),
+                (str(dev / "vfio" / "7"), 241, 7),
+            ],
+        )
+    char_dir = tmp_path / "char"
+    created = devchar.create_dev_char_symlinks(str(dev), str(char_dir))
+    assert sorted(os.path.basename(c) for c in created) == ["240:0", "241:7"]
+    assert os.readlink(char_dir / "240:0") == str(dev / "accel0")
+    # idempotent: second run creates nothing
+    assert devchar.create_dev_char_symlinks(str(dev), str(char_dir)) == []
+    if made_real:
+        # the regular file was not linked
+        assert not (char_dir / "0:0").exists()
+
+
+def test_stale_link_repointed(tmp_path, monkeypatch):
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    monkeypatch.setattr(
+        devchar,
+        "_char_devices",
+        lambda dev_root="/dev": [(str(dev / "accel0"), 240, 0)],
+    )
+    char_dir = tmp_path / "char"
+    char_dir.mkdir()
+    os.symlink("/nonexistent/old", char_dir / "240:0")
+    created = devchar.create_dev_char_symlinks(str(dev), str(char_dir))
+    assert created == [str(char_dir / "240:0")]
+    assert os.readlink(char_dir / "240:0") == str(dev / "accel0")
+
+
+def test_no_devices_is_noop(tmp_path):
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    char_dir = tmp_path / "char"
+    assert devchar.create_dev_char_symlinks(str(dev), str(char_dir)) == []
+    assert not char_dir.exists()  # not even created

@@ -1,0 +1,78 @@
+"""/dev/char symlinks for TPU device nodes.
+
+The reference's driver validation creates ``/dev/char/<major>:<minor>``
+symlinks for every NVIDIA node (``createDevCharSymlinks``,
+``validator/main.go:681-708``): systemd rebuilds cgroup device allow-lists
+from ``/dev/char`` on daemon-reload, and a device node without its char
+symlink silently loses container access. TPU hosts hit the same systemd
+behavior for ``/dev/accel*`` and ``/dev/vfio/*`` nodes, so the libtpu
+validation applies the same workaround (gated by
+``DISABLE_DEV_CHAR_SYMLINK_CREATION`` like the reference).
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+import stat
+from typing import List, Tuple
+
+log = logging.getLogger("tpu-validator")
+
+DISABLE_ENV = "DISABLE_DEV_CHAR_SYMLINK_CREATION"
+DEV_CHAR_PATH = "/dev/char"
+DEVICE_GLOBS = ("accel*", "vfio/*", "vfio/vfio")
+
+
+def _char_devices(dev_root: str = "/dev") -> List[Tuple[str, int, int]]:
+    """(path, major, minor) for every TPU-relevant char device node."""
+    out = []
+    seen = set()
+    for pattern in DEVICE_GLOBS:
+        for path in sorted(glob.glob(os.path.join(dev_root, pattern))):
+            if path in seen:
+                continue
+            seen.add(path)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            if not stat.S_ISCHR(st.st_mode):
+                continue
+            rdev = st.st_rdev
+            out.append((path, os.major(rdev), os.minor(rdev)))
+    return out
+
+
+def create_dev_char_symlinks(
+    dev_root: str = "/dev", dev_char_path: str = DEV_CHAR_PATH
+) -> List[str]:
+    """Best-effort: a failure to link must not fail validation (the bug
+    only bites on systemd daemon-reload; the node is otherwise usable).
+    Returns the list of created link paths."""
+    created = []
+    devices = _char_devices(dev_root)
+    if not devices:
+        return created
+    try:
+        os.makedirs(dev_char_path, exist_ok=True)
+    except OSError:
+        log.warning("cannot create %s; skipping dev-char symlinks", dev_char_path)
+        return created
+    for path, major, minor in devices:
+        link = os.path.join(dev_char_path, f"{major}:{minor}")
+        try:
+            if os.path.islink(link):
+                if os.readlink(link) == path:
+                    continue
+                os.unlink(link)  # repoint a stale link
+            elif os.path.exists(link):
+                continue  # a real node already provides the mapping
+            os.symlink(path, link)
+            created.append(link)
+        except OSError as e:
+            log.warning("dev-char symlink %s -> %s failed: %s", link, path, e)
+    if created:
+        log.info("created %d /dev/char symlinks", len(created))
+    return created

@@ -113,6 +113,14 @@ def validate_libtpu(
     if not os.path.exists(lib) and not versioned:
         raise ValidationError(f"libtpu.so not found under {install_dir}")
     info = {"devices": devices, "libtpu": lib if os.path.exists(lib) else versioned}
+    from tpu_operator.operands import devchar
+
+    if os.environ.get(devchar.DISABLE_ENV) != "true":
+        # systemd cgroup device-filter workaround (reference
+        # createDevCharSymlinks, validator/main.go:681-708)
+        created = devchar.create_dev_char_symlinks(dev_root)
+        if created:
+            info["devCharSymlinks"] = len(created)
     try:
         from tpu_operator.native import tpuinfo
 

@@ -46,6 +46,19 @@ kubectl patch clusterpolicies.tpu.k8s.io cluster-policy --type merge \
   -p '{"spec":{"metricsExporter":{"enabled":true}}}'
 check_clusterpolicy_ready
 
+echo "=== deep-diagnostics (opt-in ringattn probe rolls the validator)"
+kubectl patch clusterpolicies.tpu.k8s.io cluster-policy --type merge \
+  -p '{"spec":{"validator":{"ringattn":{"enabled":true}}}}'
+sleep 15
+check_clusterpolicy_ready
+kubectl -n "$TEST_NAMESPACE" get ds tpu-operator-validator \
+  -o jsonpath='{.spec.template.spec.initContainers[*].name}' | \
+  grep -q ringattn-validation || \
+  { echo "ringattn initContainer missing after enable" >&2; exit 1; }
+kubectl patch clusterpolicies.tpu.k8s.io cluster-policy --type merge \
+  -p '{"spec":{"validator":{"ringattn":null}}}'
+check_clusterpolicy_ready
+
 echo "=== uninstall"
 helm uninstall tpu-operator --namespace "$TEST_NAMESPACE"
 echo "E2E PASSED"

@@ -161,3 +161,30 @@ def test_slice_env_from_labels():
         "TPU_WORKER_ID": "3",
         "TPU_SLICE_HOSTS": "4",
     }
+
+
+def test_manager_reregisters_on_kubelet_restart(tmp_path, dev_root):
+    """A recreated kubelet.sock (kubelet restart) must restart and
+    re-register every plugin server — the kubelet forgot all registrations
+    and wiped our serving sockets."""
+    from tpu_operator.plugin.manager import PluginManager
+
+    sock_dir = tmp_path / "kubelet"
+    sock_dir.mkdir()
+    (sock_dir / "kubelet.sock").write_text("")
+    mgr = PluginManager(
+        socket_dir=str(sock_dir),
+        partition_file=str(tmp_path / "none.json"),
+        servicer_kw={"dev_root": dev_root},
+    )
+    assert mgr.sync() is True  # first pass creates servers
+    first = dict(mgr.servers)
+    assert mgr.sync() is False  # steady state: nothing to do
+
+    (sock_dir / "kubelet.sock").unlink()
+    (sock_dir / "kubelet.sock").write_text("")  # new inode = restart
+    assert mgr.sync() is True
+    assert mgr.servers.keys() == first.keys()
+    assert all(mgr.servers[r] is not first[r] for r in first)  # new servers
+    assert mgr.sync() is False  # stable again
+    mgr.stop()

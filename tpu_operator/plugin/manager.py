@@ -145,6 +145,22 @@ class PluginManager:
         self.servers: Dict[str, DevicePluginServer] = {}
         self._stop = threading.Event()
         self._last_sig = None
+        self._kubelet_id = self._kubelet_socket_id()
+
+    def _kubelet_socket_id(self):
+        """Identity of the kubelet registration socket. A changed inode
+        means the kubelet restarted: it wiped the device-plugins dir (our
+        serving sockets are gone from the filesystem) and forgot every
+        registration — plugins must restart and re-register or the node's
+        TPU capacity silently drops to zero."""
+        try:
+            st = os.stat(os.path.join(self.socket_dir, "kubelet.sock"))
+            # ctime too: a freed inode number is often reused immediately,
+            # but recreation always bumps ctime (an over-trigger just costs
+            # one harmless re-registration)
+            return (st.st_dev, st.st_ino, st.st_ctime_ns)
+        except OSError:
+            return None
 
     # ------------------------------------------------------------------
     def _partition_state(self) -> Optional[dict]:
@@ -200,6 +216,12 @@ class PluginManager:
     def sync(self, register: bool = False) -> bool:
         """Reconcile running servers against desired resources; returns True
         when the server set changed."""
+        kubelet_id = self._kubelet_socket_id()
+        if kubelet_id != self._kubelet_id:
+            self._kubelet_id = kubelet_id
+            if kubelet_id is not None:
+                log.info("kubelet socket changed; restarting + re-registering")
+                self._last_sig = None  # force a full restart below
         desired = self.desired_resources()
         sig = json.dumps(desired, sort_keys=True)
         if sig == self._last_sig:

@@ -290,20 +290,41 @@ def sandbox_main(argv=None) -> int:
     p.add_argument("--socket-dir", default=KUBELET_SOCKET_DIR)
     p.add_argument("--dev-root", default="/dev")
     args = p.parse_args(argv)
-    servicer = VfioPluginServicer(
-        args.vm_state_file, dev_root=args.dev_root, cdi_enabled=False
-    )
-    server = DevicePluginServer(
-        servicer, socket_dir=args.socket_dir, socket_name="tpu-vm.sock"
-    )
-    server.start()
-    try:
-        server.register_with_kubelet()
-    except Exception:
-        log.exception("kubelet registration failed; serving anyway")
+    def make_server():
+        servicer = VfioPluginServicer(
+            args.vm_state_file, dev_root=args.dev_root, cdi_enabled=False
+        )
+        server = DevicePluginServer(
+            servicer, socket_dir=args.socket_dir, socket_name="tpu-vm.sock"
+        )
+        server.start()
+        try:
+            server.register_with_kubelet()
+        except Exception:
+            log.exception("kubelet registration failed; serving anyway")
+        return server
+
+    def kubelet_id():
+        try:
+            st = os.stat(os.path.join(args.socket_dir, "kubelet.sock"))
+            return (st.st_dev, st.st_ino, st.st_ctime_ns)
+        except OSError:
+            return None
+
+    server = make_server()
+    last_id = kubelet_id()
     try:
         while True:
             time.sleep(5)
+            now_id = kubelet_id()
+            if now_id != last_id:
+                last_id = now_id
+                if now_id is not None:
+                    # kubelet restarted: it wiped our socket and forgot the
+                    # registration (same contract as PluginManager.sync)
+                    log.info("kubelet socket changed; re-registering")
+                    server.stop()
+                    server = make_server()
     except KeyboardInterrupt:
         server.stop()
     return 0

@@ -22,18 +22,14 @@ log = logging.getLogger("tpu-validator")
 
 DISABLE_ENV = "DISABLE_DEV_CHAR_SYMLINK_CREATION"
 DEV_CHAR_PATH = "/dev/char"
-DEVICE_GLOBS = ("accel*", "vfio/*", "vfio/vfio")
+DEVICE_GLOBS = ("accel*", "vfio/*")
 
 
 def _char_devices(dev_root: str = "/dev") -> List[Tuple[str, int, int]]:
     """(path, major, minor) for every TPU-relevant char device node."""
     out = []
-    seen = set()
     for pattern in DEVICE_GLOBS:
         for path in sorted(glob.glob(os.path.join(dev_root, pattern))):
-            if path in seen:
-                continue
-            seen.add(path)
             try:
                 st = os.stat(path)
             except OSError:

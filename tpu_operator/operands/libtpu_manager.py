@@ -68,25 +68,21 @@ def uninstall_libtpu(
     if client is not None and node_name:
         pm = PodManager(client, "")
 
+        from tpu_operator.upgrade.upgrade_state import pod_requests_tpu
+
         def pods_to_evict():
-            pods = pm.tpu_pods_on_node(node_name)
-            if pod_selector:
-                seen = {
-                    (p["metadata"].get("namespace"), p["metadata"]["name"])
-                    for p in pods
-                }
-                for pod in pm.client.list("v1", "Pod"):
-                    key = (
-                        pod["metadata"].get("namespace"),
-                        pod["metadata"]["name"],
-                    )
-                    if (
-                        pod.get("spec", {}).get("nodeName") == node_name
-                        and key not in seen
-                        and _matches_selector(pod, pod_selector)
-                    ):
-                        pods.append(pod)
-            return pods
+            # one LIST, filtered locally both ways — this runs every 2 s for
+            # up to the whole drain timeout, so a second cluster-wide LIST
+            # per pass would double the API load for nothing
+            return [
+                pod
+                for pod in pm.client.list("v1", "Pod")
+                if pod.get("spec", {}).get("nodeName") == node_name
+                and (
+                    pod_requests_tpu(pod)
+                    or (pod_selector and _matches_selector(pod, pod_selector))
+                )
+            ]
 
         pods = pods_to_evict()
         if pods:

@@ -128,6 +128,21 @@ class VfioPluginServicer(TPUDevicePluginServicer):
         return resp
 
 
+def kubelet_socket_id(socket_dir: str):
+    """Identity of the kubelet registration socket. A change means the
+    kubelet restarted: it wiped the device-plugins dir (our serving sockets
+    are gone from the filesystem) and forgot every registration — plugins
+    must restart and re-register or the node's TPU capacity silently drops
+    to zero. ctime is part of the key because a freed inode number is often
+    reused immediately, while recreation always bumps ctime (an
+    over-trigger just costs one harmless re-registration)."""
+    try:
+        st = os.stat(os.path.join(socket_dir, "kubelet.sock"))
+        return (st.st_dev, st.st_ino, st.st_ctime_ns)
+    except OSError:
+        return None
+
+
 class PluginManager:
     def __init__(
         self,
@@ -148,19 +163,7 @@ class PluginManager:
         self._kubelet_id = self._kubelet_socket_id()
 
     def _kubelet_socket_id(self):
-        """Identity of the kubelet registration socket. A changed inode
-        means the kubelet restarted: it wiped the device-plugins dir (our
-        serving sockets are gone from the filesystem) and forgot every
-        registration — plugins must restart and re-register or the node's
-        TPU capacity silently drops to zero."""
-        try:
-            st = os.stat(os.path.join(self.socket_dir, "kubelet.sock"))
-            # ctime too: a freed inode number is often reused immediately,
-            # but recreation always bumps ctime (an over-trigger just costs
-            # one harmless re-registration)
-            return (st.st_dev, st.st_ino, st.st_ctime_ns)
-        except OSError:
-            return None
+        return kubelet_socket_id(self.socket_dir)
 
     # ------------------------------------------------------------------
     def _partition_state(self) -> Optional[dict]:
@@ -298,33 +301,39 @@ def sandbox_main(argv=None) -> int:
             servicer, socket_dir=args.socket_dir, socket_name="tpu-vm.sock"
         )
         server.start()
+        registered = False
         try:
             server.register_with_kubelet()
+            registered = True
         except Exception:
-            log.exception("kubelet registration failed; serving anyway")
-        return server
+            log.exception("kubelet registration failed; will retry")
+        return server, registered
 
-    def kubelet_id():
-        try:
-            st = os.stat(os.path.join(args.socket_dir, "kubelet.sock"))
-            return (st.st_dev, st.st_ino, st.st_ctime_ns)
-        except OSError:
-            return None
-
-    server = make_server()
-    last_id = kubelet_id()
+    server, registered = make_server()
+    last_id = kubelet_socket_id(args.socket_dir)
     try:
         while True:
             time.sleep(5)
-            now_id = kubelet_id()
+            now_id = kubelet_socket_id(args.socket_dir)
             if now_id != last_id:
                 last_id = now_id
+                registered = True  # no socket yet -> nothing to register with
                 if now_id is not None:
                     # kubelet restarted: it wiped our socket and forgot the
                     # registration (same contract as PluginManager.sync)
                     log.info("kubelet socket changed; re-registering")
                     server.stop()
-                    server = make_server()
+                    server, registered = make_server()
+            elif not registered and now_id is not None:
+                # a registration that failed transiently (e.g. the kubelet's
+                # plugin manager was still initializing) keeps retrying —
+                # otherwise the node's capacity stays at zero until the NEXT
+                # kubelet restart
+                try:
+                    server.register_with_kubelet()
+                    registered = True
+                except Exception:
+                    log.exception("kubelet registration retry failed")
     except KeyboardInterrupt:
         server.stop()
     return 0

@@ -1,23 +1,25 @@
-# OLM bundle image (reference docker/bundle.Dockerfile): manifests +
-# metadata + scorecard config on a scratch base, addressed by the bundle
-# labels below.
+# OLM bundle image: the operator-framework registry+v1 layout (manifests/,
+# metadata/, scorecard tests/) on a scratch base. The label set below is
+# the operator-framework bundle contract — opm and the scorecard resolve
+# the bundle's package, channels and test config from these, so their keys
+# and values are fixed by the spec, not by us.
 FROM scratch
 
-ARG VERSION=""
-ARG DEFAULT_CHANNEL=stable
-ARG CHANNELS=stable
-ARG GIT_COMMIT="unknown"
+ARG VERSION="" \
+    DEFAULT_CHANNEL=stable \
+    CHANNELS=stable \
+    GIT_COMMIT="unknown"
 
-LABEL operators.operatorframework.io.bundle.mediatype.v1=registry+v1
-LABEL operators.operatorframework.io.bundle.manifests.v1=manifests/
-LABEL operators.operatorframework.io.bundle.metadata.v1=metadata/
-LABEL operators.operatorframework.io.bundle.package.v1=tpu-operator
-LABEL operators.operatorframework.io.bundle.channels.v1=${CHANNELS}
-LABEL operators.operatorframework.io.bundle.channel.default.v1=${DEFAULT_CHANNEL}
-LABEL operators.operatorframework.io.test.config.v1=tests/scorecard/
-LABEL operators.operatorframework.io.test.mediatype.v1=scorecard+v1
-LABEL vcs-ref=${GIT_COMMIT}
-LABEL version=${VERSION}
+LABEL operators.operatorframework.io.bundle.mediatype.v1=registry+v1 \
+      operators.operatorframework.io.bundle.manifests.v1=manifests/ \
+      operators.operatorframework.io.bundle.metadata.v1=metadata/ \
+      operators.operatorframework.io.bundle.package.v1=tpu-operator \
+      operators.operatorframework.io.bundle.channels.v1=${CHANNELS} \
+      operators.operatorframework.io.bundle.channel.default.v1=${DEFAULT_CHANNEL} \
+      operators.operatorframework.io.test.config.v1=tests/scorecard/ \
+      operators.operatorframework.io.test.mediatype.v1=scorecard+v1 \
+      vcs-ref=${GIT_COMMIT} \
+      version=${VERSION}
 
 COPY bundle/manifests /manifests/
 COPY bundle/metadata /metadata/

@@ -294,6 +294,14 @@ def test_drain_timeout_marks_failed(cluster):
     assert node_state(cluster, "node-1") == us.STATE_FAILED
     # stays cordoned for operator intervention
     assert cluster.get("v1", "Node", "node-1")["spec"]["unschedulable"]
+    # a Warning Event names the cause on the node
+    events = [
+        e
+        for e in cluster.list("v1", "Event", NS)
+        if e.get("reason") == "UpgradeDrainTimeout"
+        and e.get("involvedObject", {}).get("name") == "node-1"
+    ]
+    assert events and events[0]["type"] == "Warning"
     # terminal: further pumps don't move it
     pump(mgr, policy, times=3)
     assert node_state(cluster, "node-1") == us.STATE_FAILED

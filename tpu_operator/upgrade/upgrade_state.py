@@ -514,6 +514,13 @@ class ClusterUpgradeStateManager:
                     self._drain_timeout(policy),
                 )
                 self.provider.set_state(ns.node, STATE_FAILED)
+                self._record_failure(
+                    ns.node,
+                    "UpgradeDrainTimeout",
+                    f"libtpu upgrade drain exceeded "
+                    f"{self._drain_timeout(policy):.0f}s; node stays cordoned "
+                    f"(clear {consts.UPGRADE_STATE_LABEL} to retry)",
+                )
 
         for ns in state.node_states.get(STATE_POD_RESTART_REQUIRED, []):
             # delete the operand pod; the OnDelete DaemonSet restarts it with
@@ -537,10 +544,26 @@ class ClusterUpgradeStateManager:
                     VALIDATION_TIMEOUT_S,
                 )
                 self.provider.set_state(ns.node, STATE_FAILED)
+                self._record_failure(
+                    ns.node,
+                    "UpgradeValidationTimeout",
+                    f"libtpu validation not passing {VALIDATION_TIMEOUT_S:.0f}s "
+                    f"after upgrade; node stays cordoned "
+                    f"(clear {consts.UPGRADE_STATE_LABEL} to retry)",
+                )
 
         for ns in state.node_states.get(STATE_UNCORDON_REQUIRED, []):
             self.cordon.uncordon(ns.node["metadata"]["name"])
             self.provider.set_state(ns.node, STATE_DONE)
+
+    def _record_failure(self, node: Obj, reason: str, message: str) -> None:
+        """Warning Event on the Node for terminal upgrade failures, so the
+        cause shows in `kubectl describe node` without log spelunking."""
+        from tpu_operator.kube.events import TYPE_WARNING, record_event
+
+        record_event(
+            self.client, self.namespace, node, TYPE_WARNING, reason, message
+        )
 
     def _to_uncordon_or_done(self, node: Obj) -> None:
         """A node that was cordoned before the upgrade began skips uncordon

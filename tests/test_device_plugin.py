@@ -188,3 +188,22 @@ def test_manager_reregisters_on_kubelet_restart(tmp_path, dev_root):
     assert all(mgr.servers[r] is not first[r] for r in first)  # new servers
     assert mgr.sync() is False  # stable again
     mgr.stop()
+
+
+def test_manager_retries_failed_registration(tmp_path, dev_root):
+    """A sync pass whose kubelet registration fails must leave the
+    signature unset so the next pass retries (capacity would otherwise
+    stay zero until the resource set changes)."""
+    from tpu_operator.plugin.manager import PluginManager
+
+    sock_dir = tmp_path / "kubelet"
+    sock_dir.mkdir()  # no kubelet.sock: registration will fail
+    mgr = PluginManager(
+        socket_dir=str(sock_dir),
+        partition_file=str(tmp_path / "none.json"),
+        servicer_kw={"dev_root": dev_root},
+    )
+    assert mgr.sync(register=True) is True
+    assert mgr._last_sig is None  # failure recorded: retry next pass
+    assert mgr.sync(register=True) is True  # retried, still failing
+    mgr.stop()

@@ -112,6 +112,213 @@ def test_preferred_allocation_is_ici_contiguous(plugin):
     assert topo.contiguous(coords, "2x4", "v5e")
 
 
+def test_preferred_allocation_honors_must_include(plugin):
+    """must_include_deviceIDs land in the answer without duplicates and
+    without giving up ICI contiguity when a covering block exists."""
+    from tpu_operator.workloads import topology as topo
+
+    _, _, stub = plugin
+    for must, size in [([5], 2), ([0, 1], 4), ([7], 4), ([2, 6], 4)]:
+        req = pb2.GetPreferredAllocationRequest()
+        creq = req.container_requests.add()
+        creq.available_deviceIDs.extend([str(i) for i in range(8)])
+        creq.must_include_deviceIDs.extend(str(i) for i in must)
+        creq.allocation_size = size
+        resp = stub.GetPreferredAllocation(req)
+        ids = [int(i) for i in resp.container_responses[0].deviceIDs]
+        assert len(ids) == size, (must, size, ids)
+        assert len(set(ids)) == size, (must, size, ids)  # no dupes
+        assert set(must) <= set(ids), (must, size, ids)
+        coords = [topo.index_to_coord(i, (2, 4)) for i in ids]
+        assert topo.contiguous(coords, "2x4", "v5e"), (must, size, ids)
+
+
+def test_preferred_allocation_must_include_property(plugin):
+    """Property sweep: every (available, must, size) combination returns a
+    valid, deduped superset of must with exactly `size` chips."""
+    import itertools
+
+    _, _, stub = plugin
+    for avail in [list(range(8)), [0, 2, 3, 5, 6, 7]]:
+        for must_n, size in itertools.product([0, 1, 2], [1, 2, 4]):
+            if must_n > size:
+                continue
+            must = avail[-must_n:] if must_n else []
+            req = pb2.GetPreferredAllocationRequest()
+            creq = req.container_requests.add()
+            creq.available_deviceIDs.extend(str(i) for i in avail)
+            creq.must_include_deviceIDs.extend(str(i) for i in must)
+            creq.allocation_size = size
+            resp = stub.GetPreferredAllocation(req)
+            ids = [int(i) for i in resp.container_responses[0].deviceIDs]
+            assert len(ids) == size
+            assert len(set(ids)) == size
+            assert set(must) <= set(ids)
+            assert set(ids) <= set(avail)
+
+
+def test_list_and_watch_only_sends_on_change(plugin, dev_root):
+    """The stream must NOT re-send an unchanged device list every poll
+    tick — only the initial list and change-driven updates."""
+    import queue
+
+    servicer, _, stub = plugin  # poll_interval_s=0.2
+    msgs = queue.Queue()
+    stream = stub.ListAndWatch(pb2.Empty())
+
+    def pump():
+        try:
+            for m in stream:
+                msgs.put(m)
+        except grpc.RpcError:
+            pass
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    first = msgs.get(timeout=2)
+    assert len(first.devices) == 8
+    # several poll ticks with no change: nothing else arrives
+    import time
+
+    time.sleep(1.0)
+    assert msgs.empty()
+    # a chip dying triggers exactly one re-send
+    os.unlink(os.path.join(dev_root, "accel7"))
+    second = msgs.get(timeout=2)
+    assert len(second.devices) == 7
+    time.sleep(0.5)
+    assert msgs.empty()
+    stream.cancel()
+
+
+def test_list_and_watch_concurrent_streams_both_see_changes(plugin, dev_root):
+    """Two live streams (zombie-after-reconnect scenario) must BOTH
+    receive every change — a shared consumed event would starve one."""
+    import queue
+
+    servicer, _, stub = plugin
+    queues = [queue.Queue(), queue.Queue()]
+    streams = [stub.ListAndWatch(pb2.Empty()) for _ in queues]
+
+    def pump(s, q):
+        try:
+            for m in s:
+                q.put(m)
+        except grpc.RpcError:
+            pass
+
+    for s, q in zip(streams, queues):
+        threading.Thread(target=pump, args=(s, q), daemon=True).start()
+    for q in queues:
+        assert len(q.get(timeout=2).devices) == 8
+    os.unlink(os.path.join(dev_root, "accel0"))
+    servicer.refresh_devices()
+    for q in queues:
+        assert len(q.get(timeout=2).devices) == 7
+    for s in streams:
+        s.cancel()
+
+
+def test_preferred_allocation_ignores_out_of_range_and_unoffered(plugin):
+    """A stale 9th device id must not disable topology-aware placement,
+    and a must-include id that wasn't offered is never recommended."""
+    from tpu_operator.workloads import topology as topo
+
+    _, _, stub = plugin
+    req = pb2.GetPreferredAllocationRequest()
+    creq = req.container_requests.add()
+    creq.available_deviceIDs.extend([str(i) for i in range(9)])  # 8 is bogus
+    creq.allocation_size = 4
+    resp = stub.GetPreferredAllocation(req)
+    ids = [int(i) for i in resp.container_responses[0].deviceIDs]
+    assert 8 not in ids
+    coords = [topo.index_to_coord(i, (2, 4)) for i in ids]
+    assert topo.contiguous(coords, "2x4", "v5e"), ids
+
+    req = pb2.GetPreferredAllocationRequest()
+    creq = req.container_requests.add()
+    creq.available_deviceIDs.extend(["0", "1", "2", "3"])
+    creq.must_include_deviceIDs.extend(["7"])  # never offered
+    creq.allocation_size = 2
+    resp = stub.GetPreferredAllocation(req)
+    ids = [int(i) for i in resp.container_responses[0].deviceIDs]
+    assert 7 not in ids and len(ids) == 2 and set(ids) <= {0, 1, 2, 3}
+
+    # fallback path (size too big for the valid chips): still no bogus ids
+    req = pb2.GetPreferredAllocationRequest()
+    creq = req.container_requests.add()
+    creq.available_deviceIDs.extend(
+        [str(i) for i in range(7)] + ["8"]  # chip 7 gone, stale id 8
+    )
+    creq.allocation_size = 8
+    resp = stub.GetPreferredAllocation(req)
+    ids = [int(i) for i in resp.container_responses[0].deviceIDs]
+    assert 8 not in ids, ids
+    assert ids == list(range(7)), ids  # honest short answer, not a lie
+
+
+def test_preferred_allocation_non_tiling_sizes(plugin):
+    """Sizes that don't tile the topology (3, 5, 6 on 2x4) must still
+    return a valid connected-when-possible set, not crash the RPC."""
+    _, _, stub = plugin
+    for size in [3, 5, 6, 7]:
+        req = pb2.GetPreferredAllocationRequest()
+        creq = req.container_requests.add()
+        creq.available_deviceIDs.extend([str(i) for i in range(8)])
+        creq.allocation_size = size
+        resp = stub.GetPreferredAllocation(req)
+        ids = [int(i) for i in resp.container_responses[0].deviceIDs]
+        assert len(ids) == size and len(set(ids)) == size, (size, ids)
+
+
+def test_list_and_watch_releases_dead_peer(dev_root):
+    """A stream whose peer vanished (kubelet redial) must exit on the
+    next poll tick instead of pinning a gRPC worker thread forever."""
+
+    class DeadContext:
+        def is_active(self):
+            return False
+
+    servicer = TPUDevicePluginServicer(dev_root=dev_root, poll_interval_s=0.1)
+    gen = servicer.ListAndWatch(None, DeadContext())
+    assert len(next(gen).devices) == 8  # initial send still happens
+    with pytest.raises(StopIteration):
+        next(gen)  # first timed-out wait notices the dead peer
+    servicer.stop()
+
+
+def test_malformed_topology_label_disables_topology_not_rpcs(dev_root):
+    """A garbage gke-tpu-topology node label must degrade to naive
+    allocation, not crash every GetPreferredAllocation RPC."""
+    servicer = TPUDevicePluginServicer(
+        dev_root=dev_root, generation="v5e", host_topology="2x4x"
+    )
+    assert servicer.host_topology == ""  # disabled at init
+    req = pb2.GetPreferredAllocationRequest()
+    creq = req.container_requests.add()
+    creq.available_deviceIDs.extend([str(i) for i in range(8)])
+    creq.allocation_size = 4
+    resp = servicer.GetPreferredAllocation(req, None)
+    ids = [int(i) for i in resp.container_responses[0].deviceIDs]
+    assert ids == [0, 1, 2, 3]
+    servicer.stop()
+
+
+def test_mark_unhealthy_sticky_across_refresh(plugin):
+    """A prober-forced Unhealthy flag must survive re-enumeration (the
+    device node still exists — existence is not liveness) until
+    mark_healthy clears it."""
+    servicer, _, _ = plugin
+    servicer.mark_unhealthy("2")
+    assert servicer._devices["2"].health == "Unhealthy"
+    servicer.refresh_devices()  # poll tick: device file still present
+    assert servicer._devices["2"].health == "Unhealthy"
+    servicer.mark_healthy("2")
+    assert servicer._devices["2"].health == "Healthy"
+    servicer.refresh_devices()
+    assert servicer._devices["2"].health == "Healthy"
+
+
 def test_kubelet_registration(tmp_path, dev_root):
     """Fake kubelet Registration service receives our Register call."""
     received = {}
@@ -207,3 +414,31 @@ def test_manager_retries_failed_registration(tmp_path, dev_root):
     assert mgr._last_sig is None  # failure recorded: retry next pass
     assert mgr.sync(register=True) is True  # retried, still failing
     mgr.stop()
+
+
+def test_subslice_servicer_preference_ignores_chip_topology(tmp_path, dev_root):
+    """Subslice device ids are not chip coordinates: the chip-mesh ICI
+    preference must be disabled, yet preferences stay valid and deduped."""
+    from tpu_operator.plugin.manager import SubslicePluginServicer
+
+    subs = [
+        {"id": i, "shape": "1x2", "chips": [2 * i, 2 * i + 1]}
+        for i in range(4)
+    ]
+    servicer = SubslicePluginServicer(
+        subs,
+        resource_name="google.com/tpu-1x2",
+        dev_root=dev_root,
+        generation="v5e",
+        host_topology="2x4",  # passed via servicer_kw in production
+    )
+    assert servicer.host_topology == ""
+    req = pb2.GetPreferredAllocationRequest()
+    creq = req.container_requests.add()
+    creq.available_deviceIDs.extend(["0", "1", "2", "3"])
+    creq.must_include_deviceIDs.extend(["2"])
+    creq.allocation_size = 2
+    resp = servicer.GetPreferredAllocation(req, None)
+    ids = [int(i) for i in resp.container_responses[0].deviceIDs]
+    assert len(ids) == 2 and len(set(ids)) == 2 and 2 in ids
+    servicer.stop()

@@ -41,6 +41,12 @@ class SubslicePluginServicer(TPUDevicePluginServicer):
     def __init__(self, subslices: List[dict], resource_name: str, **kw):
         self.subslices = {str(s["id"]): s for s in subslices}
         super().__init__(resource_name=resource_name, **kw)
+        # device ids here are SUBSLICE ids, not chip ids: the base class's
+        # chip-coordinate ICI preference would compute geometry on
+        # meaningless indices. Each subslice is already ICI-contiguous by
+        # construction (enumerate_subslices), so fall back to the naive
+        # must-include-first preference.
+        self.host_topology = ""
 
     def discover(self):
         return [{"index": int(i)} for i in sorted(self.subslices, key=int)]

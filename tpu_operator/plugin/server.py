@@ -64,13 +64,36 @@ class TPUDevicePluginServicer:
         self.resource_name = resource_name
         self.generation = generation
         self.host_topology = host_topology
+        if host_topology:
+            # validate the node label once; a malformed topology must not
+            # crash every GetPreferredAllocation RPC later
+            try:
+                topo.chip_count(host_topology)
+            except ValueError:
+                log.warning(
+                    "invalid host topology %r; topology-aware allocation "
+                    "disabled",
+                    host_topology,
+                )
+                self.host_topology = ""
         self.cdi_enabled = cdi_enabled
         self.libtpu_dir = libtpu_dir
         self.slice_env = slice_env or {}
         self.poll_interval_s = poll_interval_s
         self._stop = threading.Event()
-        self._changed = threading.Event()
+        # Condition + version counter (not a shared Event): every
+        # ListAndWatch stream must see every change — an Event consumed by
+        # one stream would starve concurrent/zombie streams of wakeups.
+        self._cond = threading.Condition()
+        # serializes re-enumeration (discover + publish) so a slow refresh
+        # can't publish a stale snapshot over a newer one
+        self._refresh_lock = threading.Lock()
+        self._version = 0
         self._devices: Dict[str, pb2.Device] = {}
+        # ids forced Unhealthy by an external prober (health loop); sticky
+        # across re-enumeration until mark_healthy clears them
+        self._forced_unhealthy: set = set()
+        self._poller: Optional[threading.Thread] = None
         self.refresh_devices()
 
     # ------------------------------------------------------------------
@@ -79,6 +102,10 @@ class TPUDevicePluginServicer:
 
     def refresh_devices(self) -> bool:
         """Re-enumerate chips; returns True when the set/health changed."""
+        with self._refresh_lock:
+            return self._refresh_devices_locked()
+
+    def _refresh_devices_locked(self) -> bool:
         chips = self.discover()
         new: Dict[str, pb2.Device] = {}
         for chip in chips:
@@ -88,17 +115,64 @@ class TPUDevicePluginServicer:
             if numa is not None and numa >= 0:
                 d.topology.nodes.add().ID = numa
             new[dev_id] = d
-        changed = set(new) != set(self._devices) or any(
-            new[k].health != self._devices[k].health for k in new
-        )
-        self._devices = new
-        if changed:
-            self._changed.set()
+        with self._cond:
+            for dev_id in self._forced_unhealthy:
+                if dev_id in new:
+                    new[dev_id].health = UNHEALTHY
+            changed = set(new) != set(self._devices) or any(
+                new[k].health != self._devices[k].health for k in new
+            )
+            self._devices = new
+            if changed:
+                self._version += 1
+                self._cond.notify_all()
         return changed
+
+    def mark_unhealthy(self, dev_id: str) -> None:
+        """Flip one device to Unhealthy (sticky across re-enumeration —
+        an external health prober owns the flag) and wake every stream."""
+        dev_id = str(dev_id)
+        with self._cond:
+            self._forced_unhealthy.add(dev_id)
+            dev = self._devices.get(dev_id)
+            if dev is not None and dev.health != UNHEALTHY:
+                dev.health = UNHEALTHY
+                self._version += 1
+                self._cond.notify_all()
+
+    def mark_healthy(self, dev_id: str) -> None:
+        """Clear a forced-Unhealthy flag (device passed a probe again)."""
+        dev_id = str(dev_id)
+        with self._cond:
+            self._forced_unhealthy.discard(dev_id)
+            dev = self._devices.get(dev_id)
+            if dev is not None and dev.health != HEALTHY:
+                dev.health = HEALTHY
+                self._version += 1
+                self._cond.notify_all()
 
     def stop(self):
         self._stop.set()
-        self._changed.set()
+        with self._cond:
+            self._cond.notify_all()
+
+    # -- background polling --------------------------------------------
+    def _ensure_poller(self):
+        """One shared poller re-enumerates devices; watch streams only
+        wait on the Condition (N zombie streams must not mean N scans)."""
+        with self._cond:
+            if self._poller is None or not self._poller.is_alive():
+                self._poller = threading.Thread(
+                    target=self._poll_loop, daemon=True
+                )
+                self._poller.start()
+
+    def _poll_loop(self):
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.refresh_devices()
+            except Exception:
+                log.exception("device re-enumeration failed")
 
     # -- RPCs ------------------------------------------------------------
     def GetDevicePluginOptions(self, request, context):
@@ -108,23 +182,56 @@ class TPUDevicePluginServicer:
         )
 
     def ListAndWatch(self, request, context):
-        """Stream the device list; re-send on change (kubelet holds this
-        stream for the plugin's lifetime)."""
+        """Stream the device list; initial send then re-send ONLY on
+        change (kubelet holds this stream for the plugin's lifetime —
+        real plugins don't re-send an unchanged list every poll tick).
+
+        Each stream tracks the version it last sent, so concurrent
+        streams (e.g. a zombie from before a kubelet reconnect) can't
+        steal each other's wakeups; the shared background poller does the
+        re-enumeration exactly once regardless of stream count."""
+        self._ensure_poller()
+        last_sent = None
         while not self._stop.is_set():
+            with self._cond:
+                if last_sent is not None and self._version == last_sent:
+                    # wait for a change broadcast (or time out and loop to
+                    # re-check _stop and the peer)
+                    self._cond.wait(self.poll_interval_s)
+                    if self._version == last_sent:
+                        if context is not None and not context.is_active():
+                            # dead peer (kubelet redialed): free the
+                            # worker thread instead of pinning it forever
+                            return
+                        continue
+                ver = self._version
+                devices = list(self._devices.values())
+            if self._stop.is_set():
+                return
             resp = pb2.ListAndWatchResponse()
-            for dev in self._devices.values():
+            for dev in devices:
                 resp.devices.append(dev)
             yield resp
-            self._changed.clear()
-            # wake on change or poll tick
-            self._changed.wait(self.poll_interval_s)
-            self.refresh_devices()
+            last_sent = ver
 
     def GetPreferredAllocation(self, request, context):
         resp = pb2.GetPreferredAllocationResponse()
         for creq in request.container_requests:
-            available = [int(i) for i in creq.available_deviceIDs]
-            must = [int(i) for i in creq.must_include_deviceIDs]
+            avail_set = {int(i) for i in creq.available_deviceIDs}
+            if self.host_topology:
+                # drop ids outside the labeled topology on EVERY path (the
+                # fallback too) — never recommend a device that can't
+                # exist; host_topology was validated in __init__
+                n_total = topo.chip_count(self.host_topology)
+                avail_set = {i for i in avail_set if 0 <= i < n_total}
+            available = sorted(avail_set)
+            # the kubelet contract guarantees must ⊆ available; enforce it
+            # defensively — never recommend a device we weren't offered
+            must = [
+                i
+                for i in (int(i) for i in creq.must_include_deviceIDs)
+                if i in avail_set
+            ]
             size = creq.allocation_size
             chosen = None
             if self.host_topology:
@@ -133,13 +240,21 @@ class TPUDevicePluginServicer:
                     self.generation or "v5e",
                     size,
                     available,
+                    must_include=must,
                 )
             if chosen is None:
-                chosen = sorted(available)[:size]
-            # must-include wins over preference
-            for m in must:
-                if m not in chosen and chosen:
-                    chosen[-1] = m
+                must_set = set(must)
+                if len(must_set) > size:
+                    # contract violation (must > size): a preferred set
+                    # must contain every must id, so return them all
+                    # unranked rather than silently truncating
+                    chosen = sorted(must_set)
+                else:
+                    # must ∪ best-fill, deduped, when topology can't help
+                    pool = sorted(must_set) + [
+                        i for i in sorted(avail_set) if i not in must_set
+                    ]
+                    chosen = pool[:size]
             cresp = resp.container_responses.add()
             cresp.deviceIDs.extend(str(i) for i in sorted(chosen))
         return resp

@@ -205,40 +205,68 @@ def pick_chips(
     generation: str,
     count: int,
     available: Sequence[int],
+    must_include: Sequence[int] = (),
 ) -> Optional[List[int]]:
     """Topology-aware allocation for the device plugin: choose ``count``
     chips from ``available`` (linear device ids) preferring an
     ICI-contiguous block; falls back to any chips if none is contiguous.
 
+    ``must_include`` ids are guaranteed to be in the result (kubelet's
+    ``must_include_deviceIDs`` contract): contiguous blocks are only
+    accepted when they cover the whole set, and the BFS fallback grows
+    the connected region outward from it.
+
     This is the TPU analogue of NVML topology-aware allocation in the
     reference's device plugin (external image; SURVEY.md §2.3).
     """
     dims = parse_topology(topology)
-    avail = set(available)
-    if count <= 0 or len(avail) < count:
+    n_total = chip_count(topology)
+    must = set(must_include)
+    # ids outside the topology (stale devfs state, label/plugin mismatch)
+    # are dropped so the valid chips still get topology-aware placement;
+    # an out-of-range or un-offered must-id is unsatisfiable here
+    avail = {i for i in available if 0 <= i < n_total}
+    if count <= 0 or len(avail) < count or len(must) > count:
+        return None
+    if not must <= avail:
         return None
     coords_by_idx: Dict[int, Coord] = {
         i: index_to_coord(i, dims) for i in avail
     }
+    topo_str = format_topology(dims)
     # try axis-aligned blocks of exactly `count` chips first
     for shape in _blocks_of(count, dims):
-        for sub in enumerate_subslices(format_topology(dims), shape):
+        if any(d % s != 0 for s, d in zip(shape, dims)):
+            # non-tiling shape (e.g. 1x3 in 2x4): the BFS below handles it
+            continue
+        for sub in enumerate_subslices(topo_str, shape):
             idxs = [coord_to_index(c, dims) for c in sub.coords()]
-            if all(i in avail for i in idxs):
+            if all(i in avail for i in idxs) and must <= set(idxs):
                 return sorted(idxs)
-    # greedy BFS fallback: grow a connected set from each available chip
-    for seed in sorted(avail):
-        chosen = [seed]
-        frontier = [seed]
+
+    def grow(seeds: List[int]) -> List[int]:
+        chosen = list(seeds)
+        frontier = list(seeds)
         while frontier and len(chosen) < count:
             cur = frontier.pop(0)
-            for nb in neighbors(coords_by_idx[cur], format_topology(dims), generation):
+            for nb in neighbors(coords_by_idx[cur], topo_str, generation):
                 nb_idx = coord_to_index(nb, dims)
                 if nb_idx in avail and nb_idx not in chosen:
                     chosen.append(nb_idx)
                     frontier.append(nb_idx)
                     if len(chosen) == count:
                         break
+        return chosen
+
+    # greedy BFS fallback: grow a connected set outward from the
+    # must-include chips (or from each available chip when unconstrained)
+    if must:
+        chosen = grow(sorted(must))
+        if len(chosen) < count:
+            chosen += sorted(avail - set(chosen))[: count - len(chosen)]
+        return sorted(chosen)
+    for seed in sorted(avail):
+        chosen = grow([seed])
         if len(chosen) == count:
             return sorted(chosen)
     # disconnected last resort

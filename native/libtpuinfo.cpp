@@ -14,7 +14,11 @@
 // C ABI only; no exceptions across the boundary; caller provides buffers.
 
 #include <dirent.h>
+#include <fcntl.h>
+#include <sys/stat.h>
 #include <unistd.h>
+
+#include <cerrno>
 
 #include <algorithm>
 #include <cstdio>
@@ -61,6 +65,27 @@ std::string resolve_pci(const std::string& dev_name) {
   return pos == std::string::npos ? t : t.substr(pos + 1);
 }
 
+// Stable-id assignment shared by the accel and vfio branches: parsed
+// names keep their numeric id; names that don't parse get ids past the
+// max parsed one so a fallback can never collide with (and shadow) a
+// real chip id.
+template <typename ParseFn>
+std::vector<int> stable_ids(const std::vector<std::string>& names, ParseFn parse) {
+  int max_parsed = -1;
+  std::vector<int> ids(names.size(), -1);
+  for (size_t i = 0; i < names.size(); ++i) {
+    int p = parse(names[i]);
+    if (p >= 0) {
+      ids[i] = p;
+      if (p > max_parsed) max_parsed = p;
+    }
+  }
+  int next = max_parsed;
+  for (auto& v : ids)
+    if (v < 0) v = ++next;
+  return ids;
+}
+
 std::vector<Chip> enumerate_chips(const char* dev_root) {
   std::vector<Chip> chips;
   std::string root = dev_root && *dev_root ? dev_root : "/dev";
@@ -74,10 +99,21 @@ std::vector<Chip> enumerate_chips(const char* dev_root) {
     }
     ::closedir(d);
     std::sort(names.begin(), names.end());
-    int idx = 0;
-    for (const auto& name : names) {
+    // stable id: the accelN suffix, NOT the enumeration position —
+    // Allocate maps id N to /dev/accelN, and positional ids shift when
+    // a node disappears (health/mounts would hit the wrong chip).
+    // Strict whole-name parse: "accel0foo" must NOT claim id 0.
+    auto ids = stable_ids(names, [](const std::string& n) {
+      int parsed = -1, len = -1;
+      if (std::sscanf(n.c_str(), "accel%d%n", &parsed, &len) == 1 &&
+          len == (int)n.size() && parsed >= 0)
+        return parsed;
+      return -1;
+    });
+    for (size_t i = 0; i < names.size(); ++i) {
+      const auto& name = names[i];
       Chip c;
-      c.index = idx++;
+      c.index = ids[i];
       c.path = root + "/" + name;
       c.pci = resolve_pci(name);
       if (!c.pci.empty()) {
@@ -88,6 +124,8 @@ std::vector<Chip> enumerate_chips(const char* dev_root) {
       }
       chips.push_back(std::move(c));
     }
+    std::sort(chips.begin(), chips.end(),
+              [](const Chip& a, const Chip& b) { return a.index < b.index; });
   }
   if (!chips.empty()) return chips;
 
@@ -104,13 +142,21 @@ std::vector<Chip> enumerate_chips(const char* dev_root) {
     }
     ::closedir(d);
     std::sort(names.begin(), names.end());
-    int idx = 0;
-    for (const auto& name : names) {
+    // vfio group names are numeric: use them as stable ids; strict
+    // whole-name parse ("noiommu-0" must not claim id 0)
+    auto ids = stable_ids(names, [](const std::string& n) {
+      char* end = nullptr;
+      long p = std::strtol(n.c_str(), &end, 10);
+      return (end && *end == '\0' && end != n.c_str() && p >= 0) ? (int)p : -1;
+    });
+    for (size_t i = 0; i < names.size(); ++i) {
       Chip c;
-      c.index = idx++;
-      c.path = vfio + "/" + name;
+      c.index = ids[i];
+      c.path = vfio + "/" + names[i];
       chips.push_back(std::move(c));
     }
+    std::sort(chips.begin(), chips.end(),
+              [](const Chip& a, const Chip& b) { return a.index < b.index; });
   }
   return chips;
 }
@@ -188,6 +234,35 @@ int tpuinfo_metrics_json(const char* dev_root, char* buf, int buf_len) {
   }
   out += "]}";
   return emit(out, buf, buf_len);
+}
+
+// Liveness probe: actually open+close the device node (non-blocking,
+// read-only — never disturbs the libtpu client that owns the chip).
+// Existence is not liveness: a wedged chip keeps its device node but
+// fails the open (reference re-runs `nvidia-smi`, validator/metrics.go:
+// 237-250). Takes the device PATH (not a positional index: enumeration
+// order shifts when a node disappears, and health must never be
+// attributed to the wrong chip). Returns 0 healthy, 1 busy-but-alive
+// (EBUSY: a client owns it, which proves the driver path works;
+// EPERM/EACCES: the device cgroup denied US, which says nothing about
+// the chip), -errno on failure (ENOENT/ENXIO/EIO => gone or wedged).
+int tpuinfo_device_probe_path(const char* path) {
+  if (!path || !*path) return -EINVAL;
+  // VFIO groups allow exactly ONE open file: never open() them — a
+  // transient probe open could race the VM launcher's one-shot open of
+  // its allocated group and fail the VM start. stat-only for those
+  // (centralized here so every caller gets the rule).
+  if (std::strstr(path, "/vfio/") != nullptr) {
+    struct stat st;
+    return ::stat(path, &st) == 0 ? 0 : -errno;
+  }
+  int fd = ::open(path, O_RDONLY | O_NONBLOCK | O_CLOEXEC);
+  if (fd >= 0) {
+    ::close(fd);
+    return 0;
+  }
+  if (errno == EBUSY || errno == EPERM || errno == EACCES) return 1;
+  return -errno;
 }
 
 }  // extern "C"

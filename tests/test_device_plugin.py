@@ -442,3 +442,112 @@ def test_subslice_servicer_preference_ignores_chip_topology(tmp_path, dev_root):
     ids = [int(i) for i in resp.container_responses[0].deviceIDs]
     assert len(ids) == 2 and len(set(ids)) == 2 and 2 in ids
     servicer.stop()
+
+
+def test_health_probe_flips_wedged_device_mid_stream(tmp_path, dev_root):
+    """A chip that wedges mid-stream (device node still present but
+    unopenable) must be streamed as Unhealthy by the periodic open-probe,
+    and recover to Healthy when the probe passes again."""
+    import queue
+    import time
+
+    servicer = TPUDevicePluginServicer(
+        dev_root=dev_root,
+        poll_interval_s=0.1,
+        health_probe_interval_s=0.1,
+    )
+    server = DevicePluginServer(
+        servicer, socket_dir=str(tmp_path / "kb"), socket_name="tpu.sock"
+    )
+    addr = server.start()
+    channel = grpc.insecure_channel(addr)
+    stub = grpc_glue.DevicePluginStub(channel)
+    msgs = queue.Queue()
+    stream = stub.ListAndWatch(pb2.Empty())
+
+    def pump():
+        try:
+            for m in stream:
+                msgs.put(m)
+        except grpc.RpcError:
+            pass
+
+    threading.Thread(target=pump, daemon=True).start()
+    first = msgs.get(timeout=2)
+    assert all(d.health == "Healthy" for d in first.devices)
+
+    # wedge chip 4: still enumerated, but the open-probe fails
+    wedged = os.path.join(dev_root, "accel4")
+    os.unlink(wedged)
+    os.symlink("/nonexistent/tpu", wedged)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        m = msgs.get(timeout=5)
+        health = {d.ID: d.health for d in m.devices}
+        if health.get("4") == "Unhealthy":
+            break
+    else:
+        raise AssertionError("wedged chip never went Unhealthy")
+    assert sum(1 for h in health.values() if h == "Healthy") == 7
+
+    # unwedge: the probe must bring it back
+    os.unlink(wedged)
+    (open(wedged, "w")).close()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        m = msgs.get(timeout=5)
+        health = {d.ID: d.health for d in m.devices}
+        if health.get("4") == "Healthy":
+            break
+    else:
+        raise AssertionError("recovered chip never went Healthy")
+    channel.close()
+    server.stop()
+
+
+def test_vfio_fallback_ids_degrade_topology_and_mount_real_paths(tmp_path):
+    """A host exposing only vfio groups (base servicer's devfs fallback)
+    advertises group-number ids: the chip-mesh preference must degrade to
+    naive (group numbers aren't coordinates) and legacy Allocate must
+    mount the recorded group path, not a fabricated /dev/accelN."""
+    d = tmp_path / "dev"
+    (d / "vfio").mkdir(parents=True)
+    for g in (11, 12):
+        (d / "vfio" / str(g)).touch()
+    servicer = TPUDevicePluginServicer(
+        dev_root=str(d),
+        generation="v5e",
+        host_topology="2x4",
+        cdi_enabled=False,
+    )
+    assert sorted(servicer._devices) == ["11", "12"]
+    req = pb2.GetPreferredAllocationRequest()
+    creq = req.container_requests.add()
+    creq.available_deviceIDs.extend(["11", "12"])
+    creq.allocation_size = 1
+    resp = servicer.GetPreferredAllocation(req, None)
+    assert resp.container_responses[0].deviceIDs == ["11"]  # not empty
+
+    req = pb2.AllocateRequest()
+    req.container_requests.add().devicesIDs.extend(["12"])
+    resp = servicer.Allocate(req, None)
+    spec = resp.container_responses[0].devices[0]
+    assert spec.host_path == str(d / "vfio" / "12")
+    # path shape preserved: VFIO userspace opens /dev/vfio/<group>
+    assert spec.container_path == "/dev/vfio/12"
+    servicer.stop()
+
+
+def test_preferred_allocation_must_include_outside_mesh_survives(plugin):
+    """A must-include id the plugin itself advertised but which falls
+    outside the labeled mesh (e.g. a fallback id) must never be dropped —
+    topology degrades to naive instead."""
+    _, _, stub = plugin
+    req = pb2.GetPreferredAllocationRequest()
+    creq = req.container_requests.add()
+    creq.available_deviceIDs.extend([str(i) for i in range(8)] + ["9"])
+    creq.must_include_deviceIDs.extend(["9"])
+    creq.allocation_size = 2
+    resp = stub.GetPreferredAllocation(req)
+    ids = [int(i) for i in resp.container_responses[0].deviceIDs]
+    assert 9 in ids and len(ids) == 2 and len(set(ids)) == 2

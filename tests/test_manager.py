@@ -183,3 +183,18 @@ def test_main_once_mode(monkeypatch):
     monkeypatch.setenv("UNIT_TEST", "true")
     assert main(["--fake", "--simulate-kubelet", "--once"]) == 0
     assert main(["--fake", "--once"]) == 2
+
+
+def test_leader_identity_from_pod_env(monkeypatch):
+    """Leader identity must be pod-name + pod-UID (downward API) so two
+    process incarnations on one host never share an identity within a
+    lease window (controller-runtime pattern)."""
+    from tpu_operator.manager import default_leader_identity
+
+    monkeypatch.setenv("POD_NAME", "tpu-operator-abc")
+    monkeypatch.setenv("POD_UID", "uid-123")
+    assert default_leader_identity() == "tpu-operator-abc_uid-123"
+    # off-cluster: unique per call (process restarts can't collide)
+    monkeypatch.delenv("POD_NAME")
+    monkeypatch.delenv("POD_UID")
+    assert default_leader_identity() != default_leader_identity()

@@ -90,3 +90,55 @@ def test_python_fallback_matches_native_shape(dev_root, monkeypatch):
     chips = tpuinfo.chip_summary(dev_root)
     assert [c["index"] for c in chips] == [0, 1, 2, 3]
     assert all("path" in c for c in chips)
+
+
+def test_device_probe_native_and_fallback(dev_root, tmp_path, monkeypatch):
+    """Open-probe liveness by path: healthy file, wedged (dangling
+    symlink, node still listed), missing — native and pure-Python agree."""
+    from tpu_operator.native import tpuinfo
+
+    for use_native in (True, False):
+        if use_native:
+            monkeypatch.setenv("LIBTPUINFO_PATH", LIB)
+        else:
+            monkeypatch.setenv("LIBTPUINFO_PATH", "/nonexistent.so")
+            monkeypatch.setattr(tpuinfo, "_SEARCH_DIRS", ())
+        monkeypatch.setattr(tpuinfo, "_lib", None)
+        monkeypatch.setattr(tpuinfo, "_loaded", False)
+        assert tpuinfo.native_available() is use_native
+        assert tpuinfo.device_probe_path(os.path.join(dev_root, "accel0")) is True
+        assert tpuinfo.device_probe_path(os.path.join(dev_root, "accel9")) is False
+        assert tpuinfo.device_probe_path("") is False
+        # wedge chip 2: device node still enumerable but unopenable
+        wedged = os.path.join(dev_root, "accel2")
+        os.unlink(wedged)
+        os.symlink("/nonexistent/tpu", wedged)
+        assert tpuinfo.device_probe_path(wedged) is False
+        assert tpuinfo.device_probe_path(os.path.join(dev_root, "accel1")) is True
+        os.unlink(wedged)
+        open(wedged, "w").close()  # restore for the second pass
+
+
+def test_stable_ids_survive_holes(tmp_path, monkeypatch):
+    """Device ids are the accelN suffix, not the enumeration position: a
+    missing accel1 must not shift accel2's id (Allocate maps id N to
+    /dev/accelN, so positional ids would mount the wrong chip)."""
+    from tpu_operator.native import tpuinfo
+
+    d = tmp_path / "dev"
+    d.mkdir()
+    for i in (0, 2, 3, 10):  # hole at 1, double-digit suffix
+        (d / f"accel{i}").touch()
+    for use_native in (True, False):
+        if use_native:
+            monkeypatch.setenv("LIBTPUINFO_PATH", LIB)
+        else:
+            monkeypatch.setenv("LIBTPUINFO_PATH", "/nonexistent.so")
+            monkeypatch.setattr(tpuinfo, "_SEARCH_DIRS", ())
+        monkeypatch.setattr(tpuinfo, "_lib", None)
+        monkeypatch.setattr(tpuinfo, "_loaded", False)
+        chips = tpuinfo.chip_summary(str(d))
+        assert [c["index"] for c in chips] == [0, 2, 3, 10], (use_native, chips)
+        assert all(
+            c["path"].endswith(f"accel{c['index']}") for c in chips
+        ), (use_native, chips)

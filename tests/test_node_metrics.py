@@ -41,3 +41,56 @@ def test_status_file_gauges(tmp_path):
     assert g("tpu_validator_probe_ready", node="n1", probe="membw") == 0
     assert g("tpu_validator_probe_ready", node="n1", probe="slice") == 0
     assert g("tpu_validator_probe_ready", node="n1", probe="ici") == 0
+
+
+def test_libtpu_revalidation_open_probes_devices(tmp_path):
+    """The live re-validation gauge must reflect device LIVENESS: a wedged
+    chip (node present, open fails) flips it to 0 even though all files
+    still exist (reference validator/metrics.go:237-250)."""
+    import os
+    import time
+
+    from prometheus_client import CollectorRegistry
+
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    for i in range(2):
+        (dev / f"accel{i}").touch()
+    lib = tmp_path / "libtpu"
+    lib.mkdir()
+    (lib / "libtpu.so").touch()
+
+    reg = CollectorRegistry()
+    nm = NodeMetrics(
+        node_name="n1",
+        status=StatusFiles(str(tmp_path)),
+        registry=reg,
+        install_dir=str(lib),
+        dev_root=str(dev),
+    )
+    nm.WATCH_LIBTPU_S = 0.02
+    t = threading.Thread(target=nm._watch_libtpu, daemon=True)
+    t.start()
+
+    def wait_for(value, timeout=3):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            v = reg.get_sample_value(
+                "tpu_validator_libtpu_validation", {"node": "n1"}
+            )
+            if v == value:
+                return True
+            time.sleep(0.02)
+        return False
+
+    assert wait_for(1)
+    # wedge accel1: still present, unopenable
+    os.unlink(dev / "accel1")
+    os.symlink("/nonexistent/tpu", dev / "accel1")
+    assert wait_for(0)
+    # heal it
+    os.unlink(dev / "accel1")
+    (dev / "accel1").touch()
+    assert wait_for(1)
+    nm._stop.set()
+    t.join(timeout=5)

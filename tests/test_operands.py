@@ -482,3 +482,38 @@ def test_libtpu_manager_pod_selector_evicts_extra_pods(tmp_path):
     assert client.get_or_none("v1", "Pod", "sidecar", "default") is None
     assert client.get_or_none("v1", "Pod", "bystander", "default") is not None
     assert client.get_or_none("v1", "Pod", "other-node", "default") is not None
+
+
+def test_vfio_probe_is_stat_only(tmp_path):
+    """VFIO groups allow exactly one open file: the health probe must
+    never open() the group (it could race the VM launcher's one-shot
+    open), yet a dangling group node must still read dead."""
+    import json
+    import os
+
+    from tpu_operator.plugin.manager import VfioPluginServicer
+
+    g = tmp_path / "g7"
+    g.touch()
+    state = tmp_path / "vm.json"
+    state.write_text(json.dumps({"devices": [{"id": 7, "vfio_group": str(g)}]}))
+
+    opens = []
+    real_open = os.open
+
+    def spy_open(path, *a, **kw):
+        opens.append(str(path))
+        return real_open(path, *a, **kw)
+
+    v = VfioPluginServicer(str(state), dev_root=str(tmp_path / "dev"))
+    os.open = spy_open
+    try:
+        assert v.device_probe("7") is True
+        assert str(g) not in opens  # stat-only
+    finally:
+        os.open = real_open
+    g.unlink()
+    os.symlink("/nonexistent/group", g)
+    v.refresh_devices()
+    assert v.device_probe("7") is False
+    v.stop()

@@ -95,6 +95,19 @@ class WorkQueue:
             return len(self._ready)
 
 
+def default_leader_identity() -> str:
+    """Pod name + pod UID (downward API) like controller-runtime; the UID
+    makes the identity unique across process restarts on the same host
+    within one lease window. Falls back to hostname + a per-process
+    random token off-cluster."""
+    import os
+    import uuid
+
+    pod = os.environ.get("POD_NAME") or socket.gethostname()
+    uid = os.environ.get("POD_UID") or uuid.uuid4().hex[:12]
+    return f"{pod}_{uid}"
+
+
 class LeaderElector:
     """Lease-based leader election (reference ``main.go:97-107``)."""
 
@@ -109,7 +122,7 @@ class LeaderElector:
         self.client = client
         self.namespace = namespace
         self.name = name
-        self.identity = identity or f"{socket.gethostname()}-{id(self)}"
+        self.identity = identity or default_leader_identity()
         self.lease_seconds = lease_seconds
 
     def try_acquire(self) -> bool:

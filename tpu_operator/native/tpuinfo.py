@@ -53,6 +53,12 @@ def _load() -> Optional[ctypes.CDLL]:
                     ctypes.c_char_p,
                     ctypes.c_int,
                 ]
+                try:
+                    # added after v0.1: older .so builds lack the symbol
+                    lib.tpuinfo_device_probe_path.restype = ctypes.c_int
+                    lib.tpuinfo_device_probe_path.argtypes = [ctypes.c_char_p]
+                except AttributeError:
+                    pass
                 _lib = lib
                 return _lib
             except OSError:
@@ -84,10 +90,14 @@ def chip_summary(dev_root: str = "/dev") -> List[dict]:
                 return json.loads(buf.value.decode())
             except json.JSONDecodeError:
                 pass
-    return [
-        {"index": i, "path": p, **_py_pci_info(p)}
-        for i, p in enumerate(_py_devices(dev_root))
-    ]
+    devs = _py_devices(dev_root)
+    return sorted(
+        (
+            {"index": idx, "path": p, **_py_pci_info(p)}
+            for idx, p in zip(_py_stable_indices(devs), devs)
+        ),
+        key=lambda c: c["index"],
+    )
 
 
 def metrics(dev_root: str = "/dev") -> dict:
@@ -105,13 +115,81 @@ def metrics(dev_root: str = "/dev") -> dict:
     devs = _py_devices(dev_root)
     return {
         "source": "fallback",
-        "chips": [{"index": i, "present": 1} for i in range(len(devs))],
+        "chips": [
+            {"index": idx, "present": 1}
+            for idx in _py_stable_indices(devs)
+        ],
     }
+
+
+def device_probe_path(path: str, stat_only: bool = False) -> bool:
+    """Liveness (not existence) of one device node: open+close it
+    read-only/non-blocking. True when the open succeeds, the device is
+    busy serving a client (EBUSY proves the driver path works), or the
+    caller itself was denied (EPERM/EACCES: an unprivileged container's
+    device cgroup says nothing about the chip); False when the node is
+    gone or wedged (ENOENT/ENXIO/EIO...).
+
+    Takes the device PATH, never a positional index — enumeration order
+    shifts when a node disappears and health must not be attributed to
+    the wrong chip. The TPU analogue of the reference re-running
+    ``nvidia-smi`` through the driver chroot
+    (``validator/metrics.go:237-250``) — a wedged chip with its device
+    file still present must NOT read as healthy."""
+    if not path:
+        return False
+    # VFIO groups allow exactly ONE open file: never open() them — a
+    # transient probe open could race the VM launcher's one-shot open of
+    # its allocated group. stat-only for those (and for callers that
+    # know their paths are groups regardless of location: stat_only=True).
+    # The native library applies the same /vfio/ rule; checking here too
+    # keeps the contract in one Python place.
+    if stat_only or os.sep + "vfio" + os.sep in path:
+        try:
+            os.stat(path)
+            return True
+        except OSError:
+            return False
+    lib = _load()
+    if lib is not None and hasattr(lib, "tpuinfo_device_probe_path"):
+        return lib.tpuinfo_device_probe_path(path.encode()) >= 0
+    import errno
+
+    try:
+        fd = os.open(path, os.O_RDONLY | os.O_NONBLOCK)
+        os.close(fd)
+        return True
+    except OSError as e:
+        return e.errno in (errno.EBUSY, errno.EPERM, errno.EACCES)
 
 
 # ---------------------------------------------------------------------------
 # pure-Python fallbacks
 # ---------------------------------------------------------------------------
+
+
+def _py_stable_indices(paths: List[str]) -> List[int]:
+    """Stable device ids: the numeric suffix of each node name (accelN /
+    vfio group number), NOT the enumeration position — positions shift
+    when a node disappears, and mounts/health keyed on them would hit
+    the wrong chip. Non-parsing names get ids past the max parsed one so
+    a fallback can never collide with (and shadow) a real chip id.
+    Strict whole-name parse ("accel0foo"/"noiommu-0" must not claim an
+    id). Mirrors the native enumeration."""
+    import re
+
+    parsed: List[Optional[int]] = []
+    for p in paths:
+        m = re.fullmatch(r"accel(\d+)|(\d+)", os.path.basename(p))
+        parsed.append(int(m.group(1) or m.group(2)) if m else None)
+    next_fallback = max((x for x in parsed if x is not None), default=-1)
+    out = []
+    for x in parsed:
+        if x is None:
+            next_fallback += 1
+            x = next_fallback
+        out.append(x)
+    return out
 
 
 def _py_devices(dev_root: str) -> List[str]:

@@ -51,6 +51,22 @@ class SubslicePluginServicer(TPUDevicePluginServicer):
     def discover(self):
         return [{"index": int(i)} for i in sorted(self.subslices, key=int)]
 
+    def device_probe(self, dev_id: str) -> bool:
+        """A subslice is alive only when every member CHIP open-probes
+        (subslice ids are not chip indices). Member chip N maps to
+        /dev/accelN — the same convention this class's Allocate uses."""
+        from tpu_operator.native import tpuinfo
+
+        sub = self.subslices.get(str(dev_id))
+        if sub is None:
+            return False
+        return all(
+            tpuinfo.device_probe_path(
+                os.path.join(self.dev_root, f"accel{int(c)}")
+            )
+            for c in sub["chips"]
+        )
+
     def Allocate(self, request, context):
         resp = pb2.AllocateResponse()
         for creq in request.container_requests:
@@ -87,6 +103,10 @@ class VfioPluginServicer(TPUDevicePluginServicer):
         self.vm_state_file = vm_state_file
         kw.setdefault("resource_name", "google.com/tpu-vm")
         super().__init__(**kw)
+        # vfio group numbers are kernel-assigned, not chip coordinates:
+        # small sequential groups would pass the mesh filter and get
+        # fictitious ICI geometry (same reasoning as SubslicePluginServicer)
+        self.host_topology = ""
 
     def discover(self):
         try:
@@ -95,6 +115,16 @@ class VfioPluginServicer(TPUDevicePluginServicer):
         except (OSError, json.JSONDecodeError):
             return []
         return [{"index": d["id"], "path": d["vfio_group"]} for d in state.get("devices", [])]
+
+    def device_probe(self, dev_id: str) -> bool:
+        """stat-only, never open: every device here is a VFIO group
+        (one open file per group is a kernel invariant), wherever the
+        state file placed it — so force the shared helper's stat path."""
+        from tpu_operator.native import tpuinfo
+
+        with self._cond:
+            path = self._device_paths.get(str(dev_id), "")
+        return bool(path) and tpuinfo.device_probe_path(path, stat_only=True)
 
     def Allocate(self, request, context):
         resp = pb2.AllocateResponse()

@@ -59,6 +59,7 @@ class TPUDevicePluginServicer:
         libtpu_dir: str = consts.LIBTPU_HOST_DIR,
         slice_env: Optional[Dict[str, str]] = None,
         poll_interval_s: float = 5.0,
+        health_probe_interval_s: float = 30.0,
     ):
         self.dev_root = dev_root
         self.resource_name = resource_name
@@ -80,6 +81,7 @@ class TPUDevicePluginServicer:
         self.libtpu_dir = libtpu_dir
         self.slice_env = slice_env or {}
         self.poll_interval_s = poll_interval_s
+        self.health_probe_interval_s = health_probe_interval_s
         self._stop = threading.Event()
         # Condition + version counter (not a shared Event): every
         # ListAndWatch stream must see every change — an Event consumed by
@@ -93,6 +95,9 @@ class TPUDevicePluginServicer:
         # ids forced Unhealthy by an external prober (health loop); sticky
         # across re-enumeration until mark_healthy clears them
         self._forced_unhealthy: set = set()
+        # device id -> node path recorded at discovery time; probes use
+        # these, never a fresh positional enumeration
+        self._device_paths: Dict[str, str] = {}
         self._poller: Optional[threading.Thread] = None
         self.refresh_devices()
 
@@ -108,6 +113,7 @@ class TPUDevicePluginServicer:
     def _refresh_devices_locked(self) -> bool:
         chips = self.discover()
         new: Dict[str, pb2.Device] = {}
+        paths: Dict[str, str] = {}
         for chip in chips:
             dev_id = str(chip["index"])
             d = pb2.Device(ID=dev_id, health=HEALTHY)
@@ -115,6 +121,7 @@ class TPUDevicePluginServicer:
             if numa is not None and numa >= 0:
                 d.topology.nodes.add().ID = numa
             new[dev_id] = d
+            paths[dev_id] = chip.get("path", "")
         with self._cond:
             for dev_id in self._forced_unhealthy:
                 if dev_id in new:
@@ -123,6 +130,7 @@ class TPUDevicePluginServicer:
                 new[k].health != self._devices[k].health for k in new
             )
             self._devices = new
+            self._device_paths = paths
             if changed:
                 self._version += 1
                 self._cond.notify_all()
@@ -168,11 +176,47 @@ class TPUDevicePluginServicer:
                 self._poller.start()
 
     def _poll_loop(self):
+        last_probe = 0.0
         while not self._stop.wait(self.poll_interval_s):
             try:
                 self.refresh_devices()
+                now = time.monotonic()
+                if now - last_probe >= self.health_probe_interval_s:
+                    last_probe = now
+                    self.probe_health()
             except Exception:
                 log.exception("device re-enumeration failed")
+
+    def device_probe(self, dev_id: str) -> bool:
+        """Open-probe one advertised device at the path recorded when it
+        was discovered; existence is not liveness, and a fresh positional
+        enumeration could attribute health to the wrong chip."""
+        with self._cond:
+            path = self._device_paths.get(str(dev_id), "")
+        return tpuinfo.device_probe_path(path)
+
+    def probe_health(self) -> None:
+        """Open-probe every advertised device and flip its health — the
+        TPU analogue of the reference's periodic `nvidia-smi` re-run
+        (validator/metrics.go:237-250). A wedged chip whose device node
+        still exists goes Unhealthy so the kubelet stops placing pods."""
+        for dev_id in list(self._devices):
+            try:
+                ok = self.device_probe(dev_id)
+            except Exception:
+                log.exception("health probe failed for device %s", dev_id)
+                continue
+            if ok:
+                self.mark_healthy(dev_id)
+            else:
+                with self._cond:
+                    already = str(dev_id) in self._forced_unhealthy
+                if not already:  # warn on the transition, not every cycle
+                    log.warning(
+                        "device %s failed open-probe; marking Unhealthy",
+                        dev_id,
+                    )
+                self.mark_unhealthy(dev_id)
 
     # -- RPCs ------------------------------------------------------------
     def GetDevicePluginOptions(self, request, context):
@@ -218,13 +262,6 @@ class TPUDevicePluginServicer:
         resp = pb2.GetPreferredAllocationResponse()
         for creq in request.container_requests:
             avail_set = {int(i) for i in creq.available_deviceIDs}
-            if self.host_topology:
-                # drop ids outside the labeled topology on EVERY path (the
-                # fallback too) — never recommend a device that can't
-                # exist; host_topology was validated in __init__
-                n_total = topo.chip_count(self.host_topology)
-                avail_set = {i for i in avail_set if 0 <= i < n_total}
-            available = sorted(avail_set)
             # the kubelet contract guarantees must ⊆ available; enforce it
             # defensively — never recommend a device we weren't offered
             must = [
@@ -232,9 +269,26 @@ class TPUDevicePluginServicer:
                 for i in (int(i) for i in creq.must_include_deviceIDs)
                 if i in avail_set
             ]
+            use_topology = bool(self.host_topology)
+            if use_topology:
+                # drop ids outside the labeled topology on EVERY path (the
+                # fallback too) — never recommend a device that can't
+                # exist; host_topology was validated in __init__. But ids
+                # the plugin itself advertised must survive: if a
+                # must-include id (or the whole set) falls outside the
+                # mesh, these ids aren't chip coordinates (e.g. vfio
+                # group numbers) — degrade to naive instead of dropping
+                # kubelet-required devices.
+                n_total = topo.chip_count(self.host_topology)
+                filtered = {i for i in avail_set if 0 <= i < n_total}
+                if filtered and set(must) <= filtered:
+                    avail_set = filtered
+                else:
+                    use_topology = False
+            available = sorted(avail_set)
             size = creq.allocation_size
             chosen = None
-            if self.host_topology:
+            if use_topology:
                 chosen = topo.pick_chips(
                     self.host_topology,
                     self.generation or "v5e",
@@ -271,11 +325,24 @@ class TPUDevicePluginServicer:
                     )
             else:
                 for dev_id in ids:
+                    # mount the path recorded at discovery (devfs truth),
+                    # not a reconstructed accelN guess — they differ on
+                    # vfio-fallback hosts
+                    with self._cond:
+                        host_path = self._device_paths.get(str(dev_id), "")
+                    if not host_path:
+                        host_path = os.path.join(
+                            self.dev_root, f"accel{dev_id}"
+                        )
+                    # preserve the path shape under /dev: VFIO userspace
+                    # opens /dev/vfio/<group> in-container, so flattening
+                    # to /dev/<group> would break passthrough
+                    rel = os.path.relpath(host_path, self.dev_root)
+                    if rel.startswith(".."):
+                        rel = os.path.basename(host_path)
                     spec = cresp.devices.add()
-                    spec.host_path = os.path.join(
-                        self.dev_root, f"accel{dev_id}"
-                    )
-                    spec.container_path = f"/dev/accel{dev_id}"
+                    spec.host_path = host_path
+                    spec.container_path = os.path.join("/dev", rel)
                     spec.permissions = "rw"
                 mount = cresp.mounts.add()
                 mount.host_path = self.libtpu_dir

@@ -109,12 +109,23 @@ class NodeMetrics:
             self._stop.wait(self.WATCH_STATUS_S)
 
     def _watch_libtpu(self):
+        """Live re-validation: OPEN-probe every device, not just stat it.
+        The reference re-executes `nvidia-smi` through the driver chroot
+        (validator/metrics.go:237-250); a wedged chip whose device node
+        still exists must flip this gauge to 0."""
         import glob
         import os
 
+        from tpu_operator.native import tpuinfo
+
         while not self._stop.is_set():
-            ok = bool(find_tpu_devices(self.dev_root)) and bool(
-                glob.glob(os.path.join(self.install_dir, "libtpu*.so"))
+            devs = find_tpu_devices(self.dev_root)
+            # device_probe_path itself stats (never opens) /dev/vfio/*
+            # groups — one open file per group is a kernel invariant
+            ok = (
+                bool(devs)
+                and all(tpuinfo.device_probe_path(p) for p in devs)
+                and bool(glob.glob(os.path.join(self.install_dir, "libtpu*.so")))
             )
             self.g_libtpu_valid.labels(node=self.node_name).set(1 if ok else 0)
             self._stop.wait(self.WATCH_LIBTPU_S)

@@ -151,3 +151,115 @@ def test_cli_csv_commands(capsys):
     assert main(["generate", "csv", "--config-dir", os.path.join(REPO, "config")]) == 0
     out = capsys.readouterr().out
     assert yaml.safe_load(out)["kind"] == "ClusterServiceVersion"
+
+
+def test_crd_schema_hardening():
+    """The generated CRD types maps, enums, bounds and tolerations —
+    reference CRD depth instead of preserve-unknown-fields everywhere."""
+    from tpu_operator.cfg.crdgen import build_crd
+
+    crd = build_crd()
+    spec = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"][
+        "properties"
+    ]["spec"]["properties"]
+    # typed maps
+    labels = spec["daemonsets"]["properties"]["labels"]
+    assert labels == {
+        "type": "object",
+        "additionalProperties": {"type": "string"},
+    }
+    # toleration item schema
+    tol = spec["daemonsets"]["properties"]["tolerations"]["items"]
+    assert tol["properties"]["effect"]["enum"] == [
+        "NoSchedule",
+        "PreferNoSchedule",
+        "NoExecute",
+    ]
+    # enums + bounds
+    assert spec["daemonsets"]["properties"]["updateStrategy"]["enum"] == [
+        "RollingUpdate",
+        "OnDelete",
+    ]
+    assert spec["libtpu"]["properties"]["imagePullPolicy"]["enum"] == [
+        "Always",
+        "IfNotPresent",
+        "Never",
+    ]
+    assert spec["operator"]["properties"]["defaultRuntime"]["enum"] == [
+        "docker",
+        "containerd",
+        "crio",
+    ]
+    assert spec["metricsd"]["properties"]["hostPort"]["maximum"] == 65535
+    up = spec["libtpu"]["properties"]["upgradePolicy"]["properties"]
+    assert up["maxUnavailable"] == {"x-kubernetes-int-or-string": True, "pattern": r"^\d+%?$"}
+    assert up["maxParallelUpgrades"]["minimum"] == 0
+    # the vestigial GPU-ism is gone
+    assert "useOcpDriverToolkit" not in spec["operator"]["properties"]
+
+
+def test_schema_validation_rejects_malformed_cr():
+    """cfg validate (and the apiserver enforcing the same schema) must
+    reject enum violations, non-string map values and bad patterns."""
+    from tpu_operator.cfg.main import validate_clusterpolicy_obj
+
+    def cr(spec):
+        return {
+            "apiVersion": "tpu.k8s.io/v1",
+            "kind": "ClusterPolicy",
+            "metadata": {"name": "cp"},
+            "spec": spec,
+        }
+
+    base = {
+        "libtpu": {"repository": "r", "image": "i", "version": "v"},
+    }
+    assert not [
+        p
+        for p in validate_clusterpolicy_obj(cr(dict(base)))
+        if "no image" not in p and "no tag or digest" not in p
+    ]
+    bad_enum = dict(base, daemonsets={"updateStrategy": "Recreate"})
+    assert any("updateStrategy" in p for p in validate_clusterpolicy_obj(cr(bad_enum)))
+    bad_map = dict(base, daemonsets={"labels": {"a": 3}})
+    assert any("labels.a" in p for p in validate_clusterpolicy_obj(cr(bad_map)))
+    bad_tol = dict(
+        base, daemonsets={"tolerations": [{"effect": "Sometimes"}]}
+    )
+    assert any("effect" in p for p in validate_clusterpolicy_obj(cr(bad_tol)))
+    bad_pct = dict(
+        base,
+        libtpu=dict(base["libtpu"], upgradePolicy={"maxUnavailable": "abc%"}),
+    )
+    assert any("maxUnavailable" in p for p in validate_clusterpolicy_obj(cr(bad_pct)))
+    bad_port = dict(base, metricsd={"hostPort": 70000})
+    assert any("hostPort" in p for p in validate_clusterpolicy_obj(cr(bad_port)))
+    bad_typo = dict(base, operator={"useOcpDriverToolkit": True})
+    assert any(
+        "unknown field" in p for p in validate_clusterpolicy_obj(cr(bad_typo))
+    )
+
+
+def test_resources_accept_int_or_string_quantities():
+    """k8s Quantities like `cpu: 2` must pass the resources maps while a
+    list still fails — x-kubernetes-int-or-string, not plain string."""
+    from tpu_operator.cfg.main import validate_clusterpolicy_obj
+
+    def probs(spec):
+        return [
+            p
+            for p in validate_clusterpolicy_obj(
+                {
+                    "apiVersion": "tpu.k8s.io/v1",
+                    "kind": "ClusterPolicy",
+                    "metadata": {"name": "cp"},
+                    "spec": spec,
+                }
+            )
+            if "resources" in p
+        ]
+
+    ok = {"libtpu": {"resources": {"limits": {"cpu": 2, "memory": "1Gi"}}}}
+    assert not probs(ok)
+    bad = {"libtpu": {"resources": {"limits": {"cpu": [1]}}}}
+    assert any("limits.cpu" in p for p in probs(bad))

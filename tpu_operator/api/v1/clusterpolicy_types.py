@@ -244,7 +244,6 @@ class OperatorSpec(SpecBase):
 
     default_runtime: str = "containerd"
     runtime_class: str = "tpu"
-    use_ocp_driver_toolkit: Optional[bool] = None
     init_container: InitContainerSpec = field(default_factory=InitContainerSpec)
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)

@@ -17,6 +17,54 @@ from tpu_operator import consts
 from tpu_operator.api.v1 import clusterpolicy_types as cpt
 
 
+# Typed toleration items (reference CRD depth: the hand-maintained
+# nvidia.com CRD schema types tolerations fully rather than
+# preserve-unknown-fields)
+TOLERATION_SCHEMA: Dict[str, Any] = {
+    "type": "object",
+    "properties": {
+        "key": {"type": "string"},
+        "operator": {"type": "string", "enum": ["Exists", "Equal"]},
+        "value": {"type": "string"},
+        "effect": {
+            "type": "string",
+            "enum": ["NoSchedule", "PreferNoSchedule", "NoExecute"],
+        },
+        # plain int64 like the k8s core type: negative values are legal
+        # (documented as "treated as 0"), so no minimum here
+        "tolerationSeconds": {"type": "integer"},
+    },
+}
+
+# Validation enrichment keyed by the serialized field name. The decoder
+# stays permissive (Python dataclasses); the apiserver enforces these at
+# admission — a malformed CR is rejected before it reaches the operator.
+_FIELD_RULES: Dict[str, Dict[str, Any]] = {
+    "imagePullPolicy": {"enum": ["Always", "IfNotPresent", "Never"]},
+    "updateStrategy": {"enum": ["RollingUpdate", "OnDelete"]},
+    "defaultRuntime": {"enum": ["docker", "containerd", "crio"]},
+    "defaultWorkload": {"enum": ["container", "vm-passthrough"]},
+    # k8s intstr convention: `maxUnavailable: 1` (int) and `"25%"` are
+    # both valid; the pattern constrains the string arm only
+    "maxUnavailable": {
+        "x-kubernetes-int-or-string": True,
+        "pattern": r"^\d+%?$",
+    },
+    "timeoutSeconds": {"minimum": 0},
+    "maxParallelUpgrades": {"minimum": 0},
+    "hostPort": {"minimum": 1, "maximum": 65535},
+    "tolerations": {"items": TOLERATION_SCHEMA},
+    # k8s Quantities: `cpu: 2` and `cpu: "2"` are both valid, so these
+    # maps take int-or-string values, not plain strings
+    "limits": {
+        "additionalProperties": {"x-kubernetes-int-or-string": True}
+    },
+    "requests": {
+        "additionalProperties": {"x-kubernetes-int-or-string": True}
+    },
+}
+
+
 def _schema_for(tp) -> Dict[str, Any]:
     tp = cpt._unwrap_optional(tp)
     origin = typing.get_origin(tp)
@@ -24,6 +72,14 @@ def _schema_for(tp) -> Dict[str, Any]:
         (item,) = typing.get_args(tp) or (Any,)
         return {"type": "array", "items": _schema_for(item)}
     if origin in (dict, typing.Dict):
+        args = typing.get_args(tp)
+        # typed maps (labels/annotations/nodeSelector/...): enforce
+        # string values instead of preserve-unknown-fields
+        if args and args[1] is str:
+            return {
+                "type": "object",
+                "additionalProperties": {"type": "string"},
+            }
         return {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
     if dataclasses.is_dataclass(tp):
         return _dataclass_schema(tp)
@@ -43,10 +99,32 @@ def _dataclass_schema(cls) -> Dict[str, Any]:
     props = {}
     for f in dataclasses.fields(cls):
         key = cpt._field_key(f)
-        props[key] = _schema_for(hints[f.name])
+        schema = _schema_for(hints[f.name])
+        rules = _FIELD_RULES.get(key)
+        if rules:
+            if rules.get("x-kubernetes-int-or-string"):
+                # int-or-string replaces the schema wholesale: a `type`
+                # key would make the structural schema invalid
+                schema = dict(rules)
+            else:
+                for rk, rv in rules.items():
+                    if rk == "items":
+                        if schema.get("type") == "array":
+                            schema["items"] = rv
+                        continue
+                    if rk in ("minimum", "maximum") and schema.get(
+                        "type"
+                    ) not in ("integer", "number"):
+                        continue  # bounds only apply to numerics
+                    schema[rk] = rv
+        # per-field overrides declared on the dataclass win over the table
+        for meta_key in ("enum", "minimum", "maximum", "pattern"):
+            if meta_key in f.metadata:
+                schema[meta_key] = f.metadata[meta_key]
         doc = f.metadata.get("doc")
         if doc:
-            props[key]["description"] = doc
+            schema["description"] = doc
+        props[key] = schema
     return {"type": "object", "properties": props}
 
 

@@ -36,6 +36,12 @@ def validate_clusterpolicy_obj(obj: dict) -> list:
     problems = []
     if obj.get("kind") != "ClusterPolicy":
         problems.append(f"kind is {obj.get('kind')!r}, want ClusterPolicy")
+    # schema validation first — exactly what the apiserver enforces at
+    # admission against the generated CRD (enums, typed maps, bounds)
+    from tpu_operator.cfg.crdgen import build_crd
+    from tpu_operator.cfg.schema_validate import validate_cr
+
+    problems += validate_cr(build_crd(), obj)
     cp = clusterpolicy_from_obj(obj)
     spec = cp.spec
     # every enabled operand must resolve to a pullable image ref

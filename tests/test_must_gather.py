@@ -4,6 +4,7 @@ in-repo component the reference leaves untested; we don't)."""
 import os
 import stat
 import subprocess
+import tarfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "hack", "must-gather.sh")
@@ -13,14 +14,27 @@ STUB = """#!/usr/bin/env bash
 # leading -n flag, so printf)
 printf '%s\\n' "$*" >> "$STUB_LOG"
 case "$*" in
+  *"get pods -l app=tpu-node-status-exporter -o name"*)
+    echo "pod/tpu-node-status-exporter-n1" ;;
   *"get pods -o name"*) echo "pod/tpu-operator-abc"; echo "pod/tpu-libtpu-xyz" ;;
+  *"get daemonsets -o name"*) echo "daemonset.apps/tpu-device-plugin" ;;
+  *"-o jsonpath={.spec.nodeName}"*) echo "node-1" ;;
+  *".spec.containers[*].name}"*) echo "main sidecar" ;;
+  *"logs -c "*"--previous"*)
+    # only the operator pod's main container has a previous incarnation
+    case "$*" in
+      *"-c main"*tpu-operator-abc*) echo "previous log line" ;;
+      *) echo "no previous" >&2; exit 1 ;;
+    esac ;;
   *logs*) echo "log line" ;;
+  *exec*) echo "-rw-r--r-- libtpu-ready"; echo "--- /run/tpu/validations/libtpu-ready"; echo '{"ok": true}' ;;
+  *"get clusterpolicies.tpu.k8s.io -o name"*) echo "clusterpolicy.tpu.k8s.io/cp" ;;
   *) echo "kind: List" ;;
 esac
 """
 
 
-def test_must_gather_collects(tmp_path):
+def run_script(tmp_path):
     kubectl = tmp_path / "kubectl"
     kubectl.write_text(STUB)
     kubectl.chmod(kubectl.stat().st_mode | stat.S_IEXEC)
@@ -32,24 +46,110 @@ def test_must_gather_collects(tmp_path):
         ARTIFACT_DIR=str(out),
         OPERATOR_NAMESPACE="tpu-ns",
         STUB_LOG=str(log),
+        VERSION="v0.2.0",
+    )
+    res = subprocess.run(
+        ["bash", SCRIPT], env=env, capture_output=True, text=True, timeout=60
+    )
+    return res, out, log
+
+
+def test_must_gather_collects(tmp_path):
+    res, out, log = run_script(tmp_path)
+    assert res.returncode == 0, res.stderr
+    for f in (
+        "version",
+        "must-gather.log",
+        "cluster/version.yaml",
+        "cluster/clusterpolicy.yaml",
+        "cluster/crd.yaml",
+        "cluster/events.txt",
+        "nodes/nodes.yaml",
+        "nodes/node-labels.txt",
+        "nodes/node-os-info.txt",
+        "nodes/tpu-capacity.txt",
+        "nodes/tpu-nodes.descr",
+        "nfd/nodefeatures.yaml",
+        "nfd/nodefeaturerules.yaml",
+        "slices/slice-status.json",
+        "slices/slice-configmaps.yaml",
+        "operator/daemonsets.yaml",
+        "operator/ds-tpu-device-plugin.descr",
+        "operator/events.txt",
+        "operator/pod-images.txt",
+    ):
+        assert (out / f).exists(), f
+    assert (out / "version").read_text().splitlines()[1] == "v0.2.0"
+
+
+def test_must_gather_pod_logs_including_previous(tmp_path):
+    res, out, log = run_script(tmp_path)
+    assert res.returncode == 0, res.stderr
+    assert (out / "pod-logs" / "tpu-operator-abc.log").exists()
+    assert (out / "pod-logs" / "tpu-libtpu-xyz.log").exists()
+    assert (out / "pod-logs" / "tpu-operator-abc.descr").exists()
+    # previous logs per container, kept only where a previous incarnation
+    # existed — a never-restarted sidecar must not lose the main
+    # container's crash log
+    assert (out / "pod-logs" / "tpu-operator-abc.main.previous.log").exists()
+    assert not (out / "pod-logs" / "tpu-operator-abc.sidecar.previous.log").exists()
+    assert not (out / "pod-logs" / "tpu-libtpu-xyz.main.previous.log").exists()
+    calls = log.read_text()
+    assert "logs -c main --previous" in calls
+    assert "logs -c sidecar --previous" in calls
+
+
+def test_must_gather_host_validations_and_tarball(tmp_path):
+    res, out, log = run_script(tmp_path)
+    assert res.returncode == 0, res.stderr
+    # per-node host status files via the node-status-exporter pod
+    vals = (out / "validations" / "node-1.txt").read_text()
+    assert "libtpu-ready" in vals and '{"ok": true}' in vals
+    calls = log.read_text()
+    assert "exec tpu-node-status-exporter-n1" in calls
+    # tarball artifact next to the bundle dir
+    tarball = tmp_path / "bundle.tar.gz"
+    assert tarball.exists()
+    with tarfile.open(tarball) as t:
+        names = t.getnames()
+    assert any(n.endswith("nodes/node-labels.txt") for n in names)
+
+
+def test_must_gather_fails_without_kubectl(tmp_path):
+    env = dict(
+        os.environ,
+        KUBECTL=str(tmp_path / "missing-kubectl"),
+        ARTIFACT_DIR=str(tmp_path / "bundle2"),
+    )
+    res = subprocess.run(
+        ["bash", SCRIPT], env=env, capture_output=True, text=True, timeout=60
+    )
+    assert res.returncode == 1
+    assert "not working" in res.stderr
+
+
+def test_must_gather_empty_validations_not_reported_as_exec_failure(tmp_path):
+    """A node with no validation files yet must read as 'empty', not as
+    an exec failure (the remote glob test must not set the exit code)."""
+    kubectl = tmp_path / "kubectl"
+    kubectl.write_text(
+        STUB.replace(
+            '*exec*) echo "-rw-r--r-- libtpu-ready"; echo "--- /run/tpu/validations/libtpu-ready"; echo \'{"ok": true}\' ;;',
+            '*"exit 0"*) exit 0 ;;',
+        )
+    )
+    kubectl.chmod(kubectl.stat().st_mode | stat.S_IEXEC)
+    out = tmp_path / "bundle"
+    env = dict(
+        os.environ,
+        KUBECTL=str(kubectl),
+        ARTIFACT_DIR=str(out),
+        OPERATOR_NAMESPACE="tpu-ns",
+        STUB_LOG=str(tmp_path / "calls.log"),
     )
     res = subprocess.run(
         ["bash", SCRIPT], env=env, capture_output=True, text=True, timeout=60
     )
     assert res.returncode == 0, res.stderr
-    for f in (
-        "version.yaml",
-        "clusterpolicy.yaml",
-        "nodes.yaml",
-        "node-labels.txt",
-        "slice-status.json",
-        "daemonsets.yaml",
-        "events.txt",
-    ):
-        assert (out / f).exists(), f
-    # per-pod logs from the stubbed pod list
-    assert (out / "pod-logs" / "tpu-operator-abc.log").exists()
-    assert (out / "pod-logs" / "tpu-libtpu-xyz.log").exists()
-    calls = log.read_text()
-    assert "-n tpu-ns get daemonsets -o yaml" in calls
-    assert "--all-containers" in calls
+    vals = (out / "validations" / "node-1.txt").read_text()
+    assert "exec failed" not in vals

@@ -42,7 +42,13 @@ def validate_clusterpolicy_obj(obj: dict) -> list:
     from tpu_operator.cfg.schema_validate import validate_cr
 
     problems += validate_cr(build_crd(), obj)
-    cp = clusterpolicy_from_obj(obj)
+    try:
+        cp = clusterpolicy_from_obj(obj)
+    except Exception as e:
+        # a CR the apiserver would reject may not decode at all; report
+        # the admission problems instead of crashing on the decoder
+        problems.append(f"spec does not decode: {e}")
+        return problems
     spec = cp.spec
     # every enabled operand must resolve to a pullable image ref
     # (reference checks image paths resolve, images.go:1-171)

@@ -1,9 +1,32 @@
-# Top-level targets (reference Makefile shape: build/test/validate).
-
-.PHONY: all native test crd bundle validate lint clean dev-run docker-build
+# Top-level targets (reference Makefile shape: build/test/validate +
+# multi-arch release machinery via DIST-selected .mk includes).
 
 include versions.mk
+
+DOCKER ?= docker
+# DIST=multi-arch (buildx, linux/amd64+arm64) or native-only (host arch)
+DIST ?= native-only
+include $(DIST).mk
+
 IMAGE ?= $(REGISTRY)/tpu-operator:$(VERSION)
+
+# the three shipped images and their Dockerfiles
+IMAGES = operator jax-validator bundle-image
+DOCKERFILE_operator      = docker/Dockerfile
+IMAGE_TAG_operator       = $(REGISTRY)/tpu-operator:$(VERSION)
+DOCKERFILE_jax-validator = docker/Dockerfile.jax-validator
+IMAGE_TAG_jax-validator  = $(REGISTRY)/tpu-operator-jax-validator:$(VERSION)
+DOCKERFILE_bundle-image  = docker/bundle.Dockerfile
+IMAGE_TAG_bundle-image   = $(REGISTRY)/tpu-operator-bundle:$(VERSION)
+
+DOCKER_BUILD_TARGETS = $(patsubst %,docker-build-%,$(IMAGES))
+DOCKER_PUSH_TARGETS = $(patsubst %,docker-push-%,$(IMAGES))
+
+# declared AFTER the target lists exist: a .PHONY on an undefined
+# variable expands to nothing and silently un-phonies the fan-out
+.PHONY: all native test crd bundle release-bundle validate lint clean \
+	dev-run bench builder docker-build docker-push \
+	$(DOCKER_BUILD_TARGETS) $(DOCKER_PUSH_TARGETS)
 
 all: native crd bundle
 
@@ -20,21 +43,38 @@ crd:
 	cp deployments/tpu-operator/crds/tpu.k8s.io_clusterpolicies.yaml config/crd/
 	cp deployments/tpu-operator/crds/tpu.k8s.io_clusterpolicies.yaml bundle/manifests/
 
-# regenerate the OLM bundle CSV from config/ sources
+# refresh the CURRENT release bundle (head of the upgrade graph) from
+# config/ sources; PREV_VERSION provides the replaces edge
 bundle:
-	python -m tpu_operator.cfg.main generate csv > bundle/manifests/tpu-operator.clusterserviceversion.yaml
+	python -m tpu_operator.cfg.main release bundle \
+	  --version v$(VERSION) --replaces "$(PREV_VERSION)"
+
+# cut a NEW versioned release bundle: bump VERSION/PREV_VERSION in
+# versions.mk (the single version pin consts.py/csvgen read), then run
+# this — a command-line VERSION= override alone would leave the runtime
+# pin behind and fail `validate bundle`'s head check
+release-bundle: bundle
 
 validate:
 	python -m tpu_operator.cfg.main validate clusterpolicy --input config/samples/v1_clusterpolicy.yaml
 	python -m tpu_operator.cfg.main validate chart --dir deployments/tpu-operator
 	python -m tpu_operator.cfg.main validate csv --input bundle/manifests/tpu-operator.clusterserviceversion.yaml
+	python -m tpu_operator.cfg.main validate bundle --dir bundle
 
-docker-build:
-	docker build -f docker/Dockerfile -t $(IMAGE) .
-	docker build -f docker/Dockerfile.jax-validator -t $(IMAGE)-jax-validator .
-	docker build -f docker/bundle.Dockerfile \
-	  --build-arg VERSION=$(VERSION) --build-arg GIT_COMMIT=$(GIT_COMMIT) \
-	  -t $(REGISTRY)/tpu-operator-bundle:$(VERSION) .
+# per-image build/push fan-out; `make docker-build DIST=multi-arch
+# PUSH_ON_BUILD=true` is the release pipeline
+$(DOCKER_BUILD_TARGETS): docker-build-%: builder
+	$(call build_image,$(DOCKERFILE_$*),$(IMAGE_TAG_$*))
+
+docker-build: $(DOCKER_BUILD_TARGETS)
+
+# push goes through the DIST-selected macro: multi-arch re-runs buildx
+# with push=true (a plain `docker push` can't publish a multi-platform
+# manifest, and buildx images never land in the local daemon anyway)
+$(DOCKER_PUSH_TARGETS): docker-push-%: builder
+	$(call push_image,$(DOCKERFILE_$*),$(IMAGE_TAG_$*))
+
+docker-push: $(DOCKER_PUSH_TARGETS)
 
 bench:
 	python bench.py

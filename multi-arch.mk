@@ -1,0 +1,31 @@
+# Multi-platform image builds via buildx (reference multi-arch.mk slot).
+# Selected with DIST=multi-arch; the Makefile default is DIST=native-only
+# (plain host-arch `docker build`).
+PLATFORMS ?= linux/amd64,linux/arm64
+PUSH_ON_BUILD ?= false
+
+# buildx writes to the registry (or the local image store when not
+# pushing); a named builder keeps the cache warm across invocations
+BUILDER ?= tpu-operator-builder
+
+builder:
+	-$(DOCKER) buildx create --name $(BUILDER) --driver docker-container 2>/dev/null
+	$(DOCKER) buildx use $(BUILDER)
+
+define build_image
+	$(DOCKER) buildx build \
+	  --platform $(PLATFORMS) \
+	  --output=type=image,push=$(PUSH_ON_BUILD) \
+	  --build-arg VERSION=$(VERSION) --build-arg GIT_COMMIT=$(GIT_COMMIT) \
+	  -f $(1) -t $(2) .
+endef
+
+# pushing a multi-platform manifest is a buildx re-run with push=true
+# (cache-hot after docker-build); plain `docker push` cannot do it
+define push_image
+	$(DOCKER) buildx build \
+	  --platform $(PLATFORMS) \
+	  --output=type=image,push=true \
+	  --build-arg VERSION=$(VERSION) --build-arg GIT_COMMIT=$(GIT_COMMIT) \
+	  -f $(1) -t $(2) .
+endef

@@ -1,0 +1,17 @@
+# Single-platform image builds for the host arch (reference
+# native-only.mk slot). Selected with DIST=native-only; useful on CI
+# runners without binfmt/qemu and for fast local iteration. Plain
+# `docker build` always targets the host platform — no PLATFORMS knob.
+
+builder:
+	@true  # plain docker build needs no builder setup
+
+define build_image
+	$(DOCKER) build \
+	  --build-arg VERSION=$(VERSION) --build-arg GIT_COMMIT=$(GIT_COMMIT) \
+	  -f $(1) -t $(2) .
+endef
+
+define push_image
+	$(DOCKER) push $(2)
+endef

@@ -263,3 +263,113 @@ def test_resources_accept_int_or_string_quantities():
     assert not probs(ok)
     bad = {"libtpu": {"resources": {"limits": {"cpu": [1]}}}}
     assert any("limits.cpu" in p for p in probs(bad))
+
+
+def test_release_bundles_and_upgrade_graph(tmp_path):
+    """Versioned release bundles validate as a tree: per-release CSV/CRD,
+    a single-head acyclic replaces chain, and a head mirror."""
+    from tpu_operator.cfg.release import validate_bundle_tree
+
+    assert validate_bundle_tree(
+        os.path.join(REPO, "bundle"), config_dir=os.path.join(REPO, "config")
+    ) == []
+    # the shipped graph: v0.2.0 (head) replaces v0.1.0
+    csv = yaml.safe_load(
+        open(
+            os.path.join(
+                REPO, "bundle", "v0.2.0", "manifests",
+                "tpu-operator.clusterserviceversion.yaml",
+            )
+        )
+    )
+    assert csv["spec"]["replaces"] == "tpu-operator.v0.1.0"
+    old = yaml.safe_load(
+        open(
+            os.path.join(
+                REPO, "bundle", "v0.1.0", "manifests",
+                "tpu-operator.clusterserviceversion.yaml",
+            )
+        )
+    )
+    assert "replaces" not in old["spec"]
+
+
+def test_release_graph_problems_detected(tmp_path):
+    """A broken upgrade graph (dangling replaces, two heads, stale head
+    mirror) is flagged by the bundle linter."""
+    import shutil
+
+    from tpu_operator.cfg.release import validate_bundle_tree
+
+    bundle = tmp_path / "bundle"
+    shutil.copytree(os.path.join(REPO, "bundle"), bundle)
+    config = os.path.join(REPO, "config")
+
+    # dangling replaces edge
+    p = bundle / "v0.1.0" / "manifests" / "tpu-operator.clusterserviceversion.yaml"
+    csv = yaml.safe_load(p.read_text())
+    csv["spec"]["replaces"] = "tpu-operator.v0.0.9"
+    p.write_text(yaml.safe_dump(csv, sort_keys=False))
+    problems = validate_bundle_tree(str(bundle), config_dir=config)
+    assert any("not a shipped bundle" in x for x in problems)
+
+    # two heads (drop the v0.2.0 replaces edge)
+    csv["spec"].pop("replaces")
+    p.write_text(yaml.safe_dump(csv, sort_keys=False))
+    p2 = bundle / "v0.2.0" / "manifests" / "tpu-operator.clusterserviceversion.yaml"
+    csv2 = yaml.safe_load(p2.read_text())
+    csv2["spec"].pop("replaces")
+    p2.write_text(yaml.safe_dump(csv2, sort_keys=False))
+    problems = validate_bundle_tree(str(bundle), config_dir=config)
+    assert any("exactly one head" in x for x in problems)
+
+
+def test_cut_release_writes_versioned_bundle(tmp_path):
+    """cut_release produces a loadable bundle dir + head mirror."""
+    import shutil
+
+    from tpu_operator.cfg.release import cut_release, validate_bundle_tree
+
+    bundle = tmp_path / "bundle"
+    shutil.copytree(os.path.join(REPO, "bundle"), bundle)
+    config = os.path.join(REPO, "config")
+    # monkeying the current version: cut 0.2.0 again into the tree
+    rel = cut_release(
+        "v0.2.0", replaces="v0.1.0", bundle_dir=str(bundle), config_dir=config
+    )
+    assert os.path.isdir(rel)
+    assert validate_bundle_tree(str(bundle), config_dir=config) == []
+
+
+def test_version_pin_single_source():
+    """versions.mk is THE version pin: consts reads it, csvgen follows
+    consts, and the installed-package fallback literal in consts.py must
+    match so an environment without the repo checkout can't drift."""
+    import re
+
+    from tpu_operator import consts
+    from tpu_operator.cfg.csvgen import OPERATOR_VERSION
+
+    mk = open(os.path.join(REPO, "versions.mk")).read()
+    pinned = re.search(r"^VERSION \?=\s*(\S+)", mk, re.M).group(1)
+    assert consts.VERSION == pinned
+    assert OPERATOR_VERSION == pinned
+    src = open(os.path.join(REPO, "tpu_operator", "consts.py")).read()
+    fallback = re.search(r'return "(\d+\.\d+\.\d+)"', src).group(1)
+    assert fallback == pinned, "bump the consts.py fallback with versions.mk"
+    assert pinned in consts.DEFAULT_JAX_WORKLOAD_IMAGE
+
+
+def test_bogus_skips_edge_detected(tmp_path):
+    import shutil
+
+    from tpu_operator.cfg.release import validate_bundle_tree
+
+    bundle = tmp_path / "bundle"
+    shutil.copytree(os.path.join(REPO, "bundle"), bundle)
+    p = bundle / "v0.2.0" / "manifests" / "tpu-operator.clusterserviceversion.yaml"
+    csv = yaml.safe_load(p.read_text())
+    csv["spec"]["skips"] = ["tpu-operator.v9.9.9"]
+    p.write_text(yaml.safe_dump(csv, sort_keys=False))
+    problems = validate_bundle_tree(str(bundle), config_dir=os.path.join(REPO, "config"))
+    assert any("skips" in x and "not a shipped bundle" in x for x in problems)

@@ -20,7 +20,7 @@ import yaml
 
 from tpu_operator import consts
 
-OPERATOR_VERSION = "0.1.0"
+OPERATOR_VERSION = consts.VERSION
 
 DESCRIPTION = """\
 The TPU Operator manages the software needed to provision Cloud TPU nodes
@@ -39,6 +39,8 @@ def _load_yaml(path: str):
 def build_csv(
     config_dir: str = "config",
     version: str = OPERATOR_VERSION,
+    replaces: str = "",
+    skips: List[str] = (),
 ) -> Dict[str, Any]:
     sample = _load_yaml(os.path.join(config_dir, "samples", "v1_clusterpolicy.yaml"))[0]
     deployment = _load_yaml(os.path.join(config_dir, "manager", "manager.yaml"))[0]
@@ -66,6 +68,14 @@ def build_csv(
             sep = "@" if ver.startswith("sha256:") else ":"
             ref = f"{ref}{sep}{ver}"
         related.append({"name": img, "image": ref})
+
+    spec_extra: Dict[str, Any] = {}
+    if replaces:
+        # OLM upgrade graph (reference per-release CSVs carry
+        # `replaces: gpu-operator-certified.v<prev>`)
+        spec_extra["replaces"] = f"tpu-operator.v{replaces.lstrip('v')}"
+    if skips:
+        spec_extra["skips"] = [f"tpu-operator.v{s.lstrip('v')}" for s in skips]
 
     return {
         "apiVersion": "operators.coreos.com/v1alpha1",
@@ -123,6 +133,7 @@ def build_csv(
                 },
             },
             "relatedImages": related,
+            **spec_extra,
         },
     }
 
@@ -131,9 +142,13 @@ def render_csv_yaml(config_dir: str = "config") -> str:
     return yaml.safe_dump(build_csv(config_dir), sort_keys=False, width=100)
 
 
-def validate_csv(path: str, config_dir: str = "config") -> List[str]:
+def validate_csv(
+    path: str, config_dir: str = "config", check_fresh: bool = True
+) -> List[str]:
     """Problems list (empty = valid): decodability, alm-examples validity,
-    owned-CRD consistency, image resolvability, freshness vs generator."""
+    owned-CRD consistency, image resolvability, freshness vs generator
+    (``check_fresh=False`` for historical release bundles, which are
+    frozen snapshots of older sources)."""
     from tpu_operator.cfg.main import validate_clusterpolicy_obj
 
     problems: List[str] = []
@@ -205,10 +220,27 @@ def validate_csv(path: str, config_dir: str = "config") -> List[str]:
                     f"deployment container {ctr.get('name', '?')}: {image!r} unpinned"
                 )
 
-    # freshness vs the generator (same pattern as the chart CRD check)
-    if os.path.isdir(config_dir):
-        if csv != build_csv(config_dir):
+    # freshness vs the generator (same pattern as the chart CRD check);
+    # compare at the CSV's own version/graph position so versioned
+    # release bundles validate too
+    if check_fresh and os.path.isdir(config_dir):
+        spec = csv.get("spec", {})
+        version = str(spec.get("version", OPERATOR_VERSION))
+        if version != OPERATOR_VERSION:
+            # check_fresh means "this should be the CURRENT release": a
+            # version left behind after a versions.mk bump must fail
+            # standalone `validate csv`, not only `validate bundle`
             problems.append(
-                f"{path} is stale; regenerate with 'tpuop-cfg generate csv'"
+                f"{path}: version {version} != current {OPERATOR_VERSION}; "
+                "run 'make bundle'"
+            )
+        replaces = str(spec.get("replaces", "")).removeprefix("tpu-operator.v")
+        skips = [
+            s.removeprefix("tpu-operator.v") for s in spec.get("skips", [])
+        ]
+        if csv != build_csv(config_dir, version=version, replaces=replaces, skips=skips):
+            problems.append(
+                f"{path} is stale; regenerate with 'make bundle' "
+                "(tpuop-cfg release bundle keeps the replaces edge)"
             )
     return problems

@@ -135,11 +135,21 @@ def main(argv=None) -> int:
     vcsv = vsub.add_parser("csv")
     vcsv.add_argument("--input", required=True)
     vcsv.add_argument("--config-dir", default="config")
+    vb = vsub.add_parser("bundle")
+    vb.add_argument("--dir", default="bundle")
+    vb.add_argument("--config-dir", default="config")
     g = sub.add_parser("generate")
     gsub = g.add_subparsers(dest="what", required=True)
     gsub.add_parser("crd")
     gcsv = gsub.add_parser("csv")
     gcsv.add_argument("--config-dir", default="config")
+    r = sub.add_parser("release")
+    rsub = r.add_subparsers(dest="what", required=True)
+    rb = rsub.add_parser("bundle")
+    rb.add_argument("--version", required=True)
+    rb.add_argument("--replaces", default="")
+    rb.add_argument("--bundle-dir", default="bundle")
+    rb.add_argument("--config-dir", default="config")
     args = p.parse_args(argv)
 
     if args.cmd == "validate" and args.what == "clusterpolicy":
@@ -159,6 +169,21 @@ def main(argv=None) -> int:
         from tpu_operator.cfg.csvgen import render_csv_yaml
 
         sys.stdout.write(render_csv_yaml(args.config_dir))
+        return 0
+    elif args.cmd == "validate" and args.what == "bundle":
+        from tpu_operator.cfg.release import validate_bundle_tree
+
+        problems = validate_bundle_tree(args.dir, config_dir=args.config_dir)
+    elif args.cmd == "release" and args.what == "bundle":
+        from tpu_operator.cfg.release import cut_release
+
+        rel = cut_release(
+            args.version,
+            replaces=args.replaces,
+            bundle_dir=args.bundle_dir,
+            config_dir=args.config_dir,
+        )
+        print(f"release bundle written: {rel}")
         return 0
     else:  # pragma: no cover
         p.error("unknown command")

@@ -7,6 +7,35 @@ per-component deploy labels which are the DaemonSets' nodeSelectors, and the
 upgrade engine runs its FSM through per-node state labels.
 """
 
+# --- Version (single source: versions.mk) ------------------------------
+
+
+def _read_version() -> str:
+    """The release version lives in versions.mk (the central pin the
+    Makefile, CSV generator and runtime defaults all share); installed
+    packages without the file fall back to the last released value."""
+    import os
+    import re
+
+    path = os.path.join(os.path.dirname(__file__), "..", "versions.mk")
+    try:
+        with open(path) as f:
+            for line in f:
+                m = re.match(r"VERSION \?=\s*(\S+)", line)
+                if m:
+                    return m.group(1)
+    except OSError:
+        pass
+    return "0.2.0"
+
+
+VERSION = _read_version()
+DEFAULT_REGISTRY = "gcr.io/tpu-operator"
+# the tag the release pipeline actually publishes (Makefile image table)
+DEFAULT_JAX_WORKLOAD_IMAGE = (
+    f"{DEFAULT_REGISTRY}/tpu-operator-jax-validator:{VERSION}"
+)
+
 # --- CRD ---------------------------------------------------------------
 GROUP = "tpu.k8s.io"
 API_VERSION = f"{GROUP}/v1"

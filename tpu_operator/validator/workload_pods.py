@@ -95,7 +95,7 @@ def jax_workload_pod(
     import os
 
     image = image or os.environ.get(
-        "JAX_WORKLOAD_IMAGE", "gcr.io/tpu-operator/jax-validator:latest"
+        "JAX_WORKLOAD_IMAGE", consts.DEFAULT_JAX_WORKLOAD_IMAGE
     )
     return _workload_pod(
         "tpu-jax-validator", node_name, namespace, JAX_MATMUL_SCRIPT, image
@@ -108,7 +108,7 @@ def plugin_workload_pod(
     import os
 
     image = image or os.environ.get(
-        "JAX_WORKLOAD_IMAGE", "gcr.io/tpu-operator/jax-validator:latest"
+        "JAX_WORKLOAD_IMAGE", consts.DEFAULT_JAX_WORKLOAD_IMAGE
     )
     return _workload_pod(
         "tpu-plugin-validator", node_name, namespace, PLUGIN_SMOKE_SCRIPT, image

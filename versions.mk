@@ -1,4 +1,6 @@
 # Central version pins (reference versions.mk slot).
-VERSION ?= 0.1.0
+VERSION ?= 0.2.0
 REGISTRY ?= gcr.io/tpu-operator
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
+# previous release: the `replaces` edge of the current bundle's CSV
+PREV_VERSION ?= v0.1.0

@@ -12,7 +12,6 @@ Run: OPERATOR_NAMESPACE=tpu-operator python tests/scripts/fake_e2e.py
 
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, REPO)
@@ -24,18 +23,8 @@ NS = os.environ["OPERATOR_NAMESPACE"]
 CP = "tpu.k8s.io/v1"
 
 
-def wait_for(what, pred, timeout_s=60.0, poll_s=0.2):
-    deadline = time.monotonic() + timeout_s
-    while time.monotonic() < deadline:
-        if pred():
-            print(f"ok: {what}")
-            return
-        time.sleep(poll_s)
-    raise SystemExit(f"TIMEOUT waiting for {what}")
-
-
 def main() -> int:
-    from tpu_operator.kube.testing import simulate_kubelet_once
+    from tpu_operator.kube.testing import simulate_kubelet_once, wait_for
     from tpu_operator.main import make_fake_client
     from tpu_operator.controllers.clusterpolicy_controller import (
         ClusterPolicyReconciler,

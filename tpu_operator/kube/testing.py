@@ -110,3 +110,18 @@ def simulate_kubelet_once(
                     "resourceVersion"
                 ]
                 client.update(pod)
+
+
+def wait_for(what: str, pred, timeout_s: float = 60.0, poll_s: float = 0.2):
+    """Poll ``pred`` until true or exit the process — the e2e scripts'
+    shared readiness helper (one copy so timeout/reporting can't drift)."""
+    import sys
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            print(f"ok: {what}")
+            return
+        time.sleep(poll_s)
+    sys.exit(f"TIMEOUT waiting for {what}")

@@ -193,10 +193,19 @@ class Collector {
           gauge("tpu_tensorcore_utilization_percent",
                 "TensorCore utilization % (from chip-owning sampler)", label,
                 util);
+        double duty = find_number(entry, "duty_cycle");
+        if (!std::isnan(duty))
+          gauge("tpu_duty_cycle_percent",
+                "TensorCore duty cycle % (from chip-owning sampler)", label,
+                duty);
         double hbm = find_number(entry, "hbm_used");
         if (!std::isnan(hbm))
           gauge("tpu_hbm_used_bytes", "HBM bytes in use (from sampler)", label,
                 hbm);
+        double hbm_total = find_number(entry, "hbm_total");
+        if (!std::isnan(hbm_total))
+          gauge("tpu_hbm_total_bytes", "HBM capacity bytes (from sampler)",
+                label, hbm_total);
       }
     } else {
       gauge("tpu_metricsd_sample_fresh", "Sampler side-file present", "", 0);

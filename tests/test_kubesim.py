@@ -204,8 +204,10 @@ def test_watch_streams_adds_and_deletes(cluster):
 
 
 def test_watch_survives_410_compaction(cluster):
-    """Compacting the event log mid-watch forces the 410 Gone ERROR; the
-    RestClient watch loop must re-list and keep delivering."""
+    """Compacting away an UNCONSUMED event forces the 410 Gone ERROR; the
+    RestClient watch loop must re-list and keep delivering — including
+    the object whose watch event was destroyed (only a re-list can
+    surface it)."""
     server, client = cluster
     events = []
     stop = threading.Event()
@@ -222,13 +224,27 @@ def test_watch_survives_410_compaction(cluster):
     deadline = time.time() + 5
     while time.time() < deadline and ("ADDED", "before") not in events:
         time.sleep(0.05)
-    # wipe history: the open watch's cursor is now before min_event_rv
-    server.sim.compact_now()
+    # create 'gap' and compact ATOMICALLY (the watcher can't drain while
+    # we hold the sim lock): its event is destroyed before delivery, so
+    # the watcher's cursor is strictly behind min_event_rv -> 410
+    with server.sim._cond:
+        code, _ = server.sim.create(
+            "", "v1", "configmaps", NS,
+            {"apiVersion": "v1", "kind": "ConfigMap",
+             "metadata": {"name": "gap", "namespace": NS}},
+        )
+        assert code == 201
+        server.sim.compact_now()
     client.create({"apiVersion": "v1", "kind": "ConfigMap",
                    "metadata": {"name": "after", "namespace": NS}})
     deadline = time.time() + 10
-    while time.time() < deadline and ("ADDED", "after") not in events:
+    while time.time() < deadline and not {
+        ("ADDED", "gap"), ("ADDED", "after")
+    } <= set(events):
         time.sleep(0.05)
+    # 'gap' could ONLY arrive via the re-list after the 410 — its watch
+    # event no longer exists
+    assert ("ADDED", "gap") in events, events
     assert ("ADDED", "after") in events, events
     stop.set()
 

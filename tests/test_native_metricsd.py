@@ -354,3 +354,28 @@ def test_native_dropfile_without_directory(dev_root, tmp_path):
     assert r.returncode == 0
     out = tmp_path / "drop-rel.json"
     assert out.exists() and json.loads(out.read_text())["chip_count"] == 2
+
+
+def test_bench_telemetry_chain_end_to_end():
+    """bench.py's telemetry proof is itself testable without a chip: the
+    sampler side-file values must survive the native hostengine merge AND
+    the exporter scrape into rendered Prometheus series."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    import bench
+
+    out = bench.run_telemetry_chain(
+        {
+            "tensorcore_util": 42.5,
+            "duty_cycle": 99.0,
+            "hbm_used": 123456.0,
+            "hbm_total": 1.0e9,
+        }
+    )
+    assert out["ok"], out
+    assert out["tensorcore_util_percent"] == 42.5
+    assert out["native_tensorcore_util_percent"] == 42.5
+    assert out["duty_cycle_percent"] == 99.0
+    assert out["native_duty_cycle_percent"] == 99.0
+    assert out["hbm_used_bytes"] == 123456.0

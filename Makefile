@@ -10,14 +10,16 @@ include $(DIST).mk
 
 IMAGE ?= $(REGISTRY)/tpu-operator:$(VERSION)
 
-# the three shipped images and their Dockerfiles
-IMAGES = operator jax-validator bundle-image
+# the shipped images and their Dockerfiles
+IMAGES = operator jax-validator bundle-image must-gather
 DOCKERFILE_operator      = docker/Dockerfile
 IMAGE_TAG_operator       = $(REGISTRY)/tpu-operator:$(VERSION)
 DOCKERFILE_jax-validator = docker/Dockerfile.jax-validator
 IMAGE_TAG_jax-validator  = $(REGISTRY)/tpu-operator-jax-validator:$(VERSION)
 DOCKERFILE_bundle-image  = docker/bundle.Dockerfile
 IMAGE_TAG_bundle-image   = $(REGISTRY)/tpu-operator-bundle:$(VERSION)
+DOCKERFILE_must-gather   = docker/must-gather.Dockerfile
+IMAGE_TAG_must-gather    = $(REGISTRY)/tpu-operator-must-gather:$(VERSION)
 
 DOCKER_BUILD_TARGETS = $(patsubst %,docker-build-%,$(IMAGES))
 DOCKER_PUSH_TARGETS = $(patsubst %,docker-push-%,$(IMAGES))

@@ -84,79 +84,11 @@ def run_telemetry_chain(sample: dict) -> dict:
         stderr=subprocess.DEVNULL,
     )
     try:
-        # 1) hostengine merged the side-file
-        deadline = time.time() + 10
-        data = None
-        while time.time() < deadline:
-            try:
-                with urllib.request.urlopen(
-                    f"http://127.0.0.1:{port}/json", timeout=2
-                ) as r:
-                    data = json.load(r)
-                if data.get("chips") and data.get("sample"):
-                    break
-            except OSError:
-                pass
-            time.sleep(0.2)
-        if not data or not data.get("sample"):
-            out["error"] = "hostengine never served the merged sample"
-            return out
-
-        # 2) the native /metrics text carries the series
-        with urllib.request.urlopen(
-            f"http://127.0.0.1:{port}/metrics", timeout=2
-        ) as r:
-            native_prom = r.read().decode()
-
-        # 3) the exporter (dcgm-exporter slot) scrapes the hostengine and
-        # renders Prometheus series
-        from prometheus_client import CollectorRegistry, generate_latest
-
-        from tpu_operator.exporter.exporter import Exporter
-
-        registry = CollectorRegistry()
-        exporter = Exporter(
-            node_name="bench",
-            dev_root=dev_root,
-            metricsd_endpoint=f"127.0.0.1:{port}",
-            registry=registry,
-        )
-        exporter.collect_once()
-        rendered = generate_latest(registry).decode()
-
-        def series(text: str, name: str) -> float:
-            for line in text.splitlines():
-                if line.startswith(name) and not line.startswith("#"):
-                    return float(line.rsplit(" ", 1)[1])
-            return 0.0
-
-        out["tensorcore_util_percent"] = series(
-            rendered, "tpu_tensorcore_utilization_percent"
-        )
-        out["duty_cycle_percent"] = series(rendered, "tpu_duty_cycle_percent")
-        out["hbm_used_bytes"] = series(rendered, "tpu_hbm_used_bytes")
-        out["native_tensorcore_util_percent"] = series(
-            native_prom, "tpu_tensorcore_utilization_percent"
-        )
-        out["native_duty_cycle_percent"] = series(
-            native_prom, "tpu_duty_cycle_percent"
-        )
-        out["native_hbm_used_bytes"] = series(native_prom, "tpu_hbm_used_bytes")
-        # the end-to-end assertion: non-zero all the way through BOTH
-        # serving paths (native text and exporter render)
-        out["ok"] = all(
-            out[k] > 0
-            for k in (
-                "tensorcore_util_percent",
-                "duty_cycle_percent",
-                "hbm_used_bytes",
-                "native_tensorcore_util_percent",
-                "native_duty_cycle_percent",
-                "native_hbm_used_bytes",
-            )
-        )
-        if not out["ok"]:
-            out["error"] = "a telemetry series rendered zero"
+        return _drive_chain(port, dev_root, out)
+    except Exception as e:
+        # a broken chain must surface as telemetry failure in the one
+        # JSON line, never as a bench traceback
+        out["error"] = f"{type(e).__name__}: {e}"
         return out
     finally:
         proc.terminate()
@@ -168,6 +100,83 @@ def run_telemetry_chain(sample: dict) -> dict:
             proc.kill()
             proc.wait()
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _drive_chain(port: int, dev_root: str, out: dict) -> dict:
+    # 1) hostengine merged the side-file
+    deadline = time.time() + 10
+    data = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/json", timeout=2
+            ) as r:
+                data = json.load(r)
+            if data.get("chips") and data.get("sample"):
+                break
+        except OSError:
+            pass
+        time.sleep(0.2)
+    if not data or not data.get("sample"):
+        out["error"] = "hostengine never served the merged sample"
+        return out
+
+    # 2) the native /metrics text carries the series
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=2
+    ) as r:
+        native_prom = r.read().decode()
+
+    # 3) the exporter (dcgm-exporter slot) scrapes the hostengine and
+    # renders Prometheus series
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    from tpu_operator.exporter.exporter import Exporter
+
+    registry = CollectorRegistry()
+    exporter = Exporter(
+        node_name="bench",
+        dev_root=dev_root,
+        metricsd_endpoint=f"127.0.0.1:{port}",
+        registry=registry,
+    )
+    exporter.collect_once()
+    rendered = generate_latest(registry).decode()
+
+    def series(text: str, name: str) -> float:
+        for line in text.splitlines():
+            if line.startswith(name) and not line.startswith("#"):
+                return float(line.rsplit(" ", 1)[1])
+        return 0.0
+
+    out["tensorcore_util_percent"] = series(
+        rendered, "tpu_tensorcore_utilization_percent"
+    )
+    out["duty_cycle_percent"] = series(rendered, "tpu_duty_cycle_percent")
+    out["hbm_used_bytes"] = series(rendered, "tpu_hbm_used_bytes")
+    out["native_tensorcore_util_percent"] = series(
+        native_prom, "tpu_tensorcore_utilization_percent"
+    )
+    out["native_duty_cycle_percent"] = series(
+        native_prom, "tpu_duty_cycle_percent"
+    )
+    out["native_hbm_used_bytes"] = series(native_prom, "tpu_hbm_used_bytes")
+    # the end-to-end assertion: non-zero all the way through BOTH
+    # serving paths (native text and exporter render)
+    out["ok"] = all(
+        out[k] > 0
+        for k in (
+            "tensorcore_util_percent",
+            "duty_cycle_percent",
+            "hbm_used_bytes",
+            "native_tensorcore_util_percent",
+            "native_duty_cycle_percent",
+            "native_hbm_used_bytes",
+        )
+    )
+    if not out["ok"]:
+        out["error"] = "a telemetry series rendered zero"
+    return out
 
 
 def run_ici_on_cpu_mesh() -> dict:
@@ -232,47 +241,63 @@ def main() -> int:
     # THIS run (utilization from the matmul; memory stats from the
     # device; the chip was continuously busy during the timed window)
     stats = jax.local_devices()[0].memory_stats() or {}
+    # measured, never fabricated: a broken utilization measurement must
+    # fail the non-zero chain assertion, not be papered over.
+    if res.utilization is not None:
+        util_pct = round(res.utilization * 100, 2)
+    elif not on_tpu:
+        # CPU CI has no rated peak; raw TFLOPS is still a real
+        # measurement from this run and keeps the chain exercised
+        util_pct = round(res.tflops, 3)
+    else:
+        # a TPU generation missing from the peak table must fail the
+        # chain loudly (fix the table), not render an impossible percent
+        util_pct = 0.0
     hbm_used = float(
-        stats.get("peak_bytes_in_use") or stats.get("bytes_in_use") or 0
+        stats.get("peak_bytes_in_use")
+        or stats.get("bytes_in_use")
+        # no allocator stats on this backend: the operands' known bytes
+        or 2 * res.size * res.size * 2
     )
-    hbm_total = float(stats.get("bytes_limit") or 0)
-    util_pct = round((res.utilization or 0.0) * 100, 2)
     sample = {
-        "tensorcore_util": util_pct or 1.0,
-        "duty_cycle": util_pct or 1.0,
-        "hbm_used": hbm_used or float(2 * res.size * res.size * 2),
-        "hbm_total": hbm_total,
+        "tensorcore_util": util_pct,
+        "duty_cycle": util_pct,
+        "hbm_used": hbm_used,
+        "hbm_total": float(stats.get("bytes_limit") or 0),
     }
     telemetry = run_telemetry_chain(sample)
 
     # ICI axis last: it re-binds JAX to the CPU mesh
     ici = run_ici_on_cpu_mesh()
 
-    vs_baseline = res.utilization if res.utilization is not None else 1.0
-    print(
-        json.dumps(
-            {
-                "metric": "validator_jax_matmul_tflops_per_chip",
-                "value": round(res.tflops, 2),
-                "unit": "TFLOPS",
-                "vs_baseline": round(vs_baseline, 4),
-                "device": res.device_kind,
-                "platform": res.platform,
-                "peak_tflops": res.peak_tflops,
-                "membw_copy_gbps": round(getattr(mem, "copy_gbps", 0.0) or 0.0, 1),
-                "membw_stream_gbps": round(
-                    getattr(mem, "stream_gbps", 0.0) or 0.0, 1
-                ),
-                "membw_gbps": round(getattr(mem, "gbps", 0.0) or 0.0, 1),
-                "membw_utilization": round(
-                    getattr(mem, "utilization", 0.0) or 0.0, 4
-                ),
-                "telemetry": telemetry,
-                "ici_cpu_mesh": ici,
-            }
-        )
-    )
-    return 0 if telemetry.get("ok") else 1
+    if res.utilization is not None:
+        vs_baseline = res.utilization
+    else:
+        # CPU CI: no rated peak to compare against; unmapped TPU: 0.0
+        # so the regression tracker flags it instead of recording parity
+        vs_baseline = 1.0 if not on_tpu else 0.0
+    out = {
+        "metric": "validator_jax_matmul_tflops_per_chip",
+        "value": round(res.tflops, 2),
+        "unit": "TFLOPS",
+        "vs_baseline": round(vs_baseline, 4),
+        "device": res.device_kind,
+        "platform": res.platform,
+        "peak_tflops": res.peak_tflops,
+        "membw_ok": bool(mem.ok),
+        "membw_copy_gbps": round(mem.copy_gbps, 1),
+        "membw_stream_gbps": round(mem.stream_gbps, 1),
+        "membw_gbps": round(mem.gbps, 1),
+        "membw_utilization": round(mem.utilization or 0.0, 4),
+        "telemetry": telemetry,
+        "ici_cpu_mesh": ici,
+    }
+    if not mem.ok and mem.error:
+        out["membw_error"] = mem.error
+    print(json.dumps(out))
+    # a failed axis is a failed bench — zeros must never be recorded as
+    # a successful run (same policy as the telemetry assertion)
+    return 0 if telemetry.get("ok") and mem.ok else 1
 
 
 if __name__ == "__main__":

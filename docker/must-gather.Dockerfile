@@ -1,0 +1,22 @@
+# must-gather plugin image (reference pattern: `oc adm must-gather
+# --image=...` runs /usr/bin/gather, which writes into /must-gather).
+# Standalone use: docker run -v $KUBECONFIG:/root/.kube/config <image>
+FROM alpine:3.19
+# default to the current stable kubectl at build time (client-server skew
+# policy is +/-1 minor); pin explicitly via --build-arg for reproducible
+# builds against a known cluster version
+ARG KUBECTL_VERSION=""
+RUN apk add --no-cache bash curl tar \
+    && KV="${KUBECTL_VERSION:-$(curl -fsSL https://dl.k8s.io/release/stable.txt)}" \
+    && curl -fsSLo /usr/local/bin/kubectl \
+       "https://dl.k8s.io/release/${KV}/bin/linux/$(uname -m | sed 's/x86_64/amd64/; s/aarch64/arm64/')/kubectl" \
+    && chmod +x /usr/local/bin/kubectl
+COPY hack/must-gather.sh /usr/bin/gather
+RUN chmod +x /usr/bin/gather
+ARG VERSION=dev
+ARG GIT_COMMIT=unknown
+ENV VERSION=${VERSION}
+LABEL org.opencontainers.image.title="tpu-operator-must-gather" \
+      org.opencontainers.image.version="${VERSION}" \
+      org.opencontainers.image.revision="${GIT_COMMIT}"
+ENTRYPOINT ["/usr/bin/gather"]

@@ -64,6 +64,83 @@ def build_args(argv=None):
     return p.parse_args(argv)
 
 
+def build_manager(
+    client,
+    namespace: str,
+    metrics_port: int = 8080,
+    probe_port: int = 8081,
+    leader_election: bool = False,
+    debug_endpoints: bool = False,
+    assets_dir=None,
+):
+    """Manager + both reconcilers, registered exactly as the process runs
+    them — shared by main() and the kubesim manager e2e so the tested
+    wiring IS the shipped wiring. Returns (manager, cp_reconciler,
+    upgrade_reconciler)."""
+    from tpu_operator.upgrade.upgrade_controller import UpgradeReconciler
+
+    mgr = Manager(
+        client,
+        namespace,
+        metrics_port=metrics_port,
+        probe_port=probe_port,
+        leader_election=leader_election,
+        debug_endpoints=debug_endpoints,
+    )
+    reconciler = ClusterPolicyReconciler(client, assets_dir=assets_dir)
+    mgr.add_reconciler(CP_KEY, lambda _key: reconciler.reconcile())
+    upgrade = UpgradeReconciler(client, namespace)
+    mgr.add_reconciler(UPGRADE_KEY, lambda _key: upgrade.reconcile())
+    return mgr, reconciler, upgrade
+
+
+def wire_event_sources(mgr, client, namespace: str, stop_event=None) -> None:
+    """Watches feed the workqueue (reference watch wiring,
+    controllers/clusterpolicy_controller.go:317-344). Shared by main()
+    and the kubesim manager e2e so the tested path IS the shipped path."""
+    node_cache = {}
+
+    def on_event(event, obj):
+        kind = obj.get("kind")
+        if kind == "ClusterPolicy":
+            mgr.enqueue(CP_KEY)
+            mgr.enqueue(UPGRADE_KEY)
+        elif kind == "Node":
+            name = obj["metadata"]["name"]
+            old = node_cache.get(name)
+            node_cache[name] = None if event == "DELETED" else obj
+            if node_event_needs_reconcile(event, old, obj):
+                mgr.enqueue(CP_KEY)
+        elif kind == "DaemonSet":
+            # owned-operand drift (reference watch on owned DaemonSets)
+            mgr.enqueue(CP_KEY, delay=0.1)
+
+    if hasattr(client, "add_watcher"):
+        # fake client pushes events in-process
+        client.add_watcher(on_event)
+    elif hasattr(client, "watch"):
+        # real API server: one list+watch loop per watched kind
+        for av, kind, ns in (
+            (consts.API_VERSION, "ClusterPolicy", ""),
+            ("v1", "Node", ""),
+            ("apps/v1", "DaemonSet", namespace),
+        ):
+            threading.Thread(
+                target=client.watch,
+                args=(av, kind, on_event),
+                kwargs={"namespace": ns, "stop_event": stop_event},
+                daemon=True,
+            ).start()
+    else:
+        def poll():
+            while True:
+                mgr.enqueue(CP_KEY)
+                mgr.enqueue(UPGRADE_KEY)
+                time.sleep(30)
+
+        threading.Thread(target=poll, daemon=True).start()
+
+
 def make_fake_client():
     from tpu_operator.kube import FakeClient
     from tpu_operator.kube.testing import make_tpu_node
@@ -125,21 +202,15 @@ def main(argv=None) -> int:
         log.error("%s must be set", consts.OPERATOR_NAMESPACE_ENV)
         return 1
 
-    mgr = Manager(
+    mgr, reconciler, upgrade = build_manager(
         client,
         namespace,
         metrics_port=args.metrics_port,
         probe_port=args.probe_port,
         leader_election=args.leader_election,
         debug_endpoints=args.debug_endpoints,
+        assets_dir=args.assets,
     )
-    reconciler = ClusterPolicyReconciler(client, assets_dir=args.assets)
-    mgr.add_reconciler(CP_KEY, lambda _key: reconciler.reconcile())
-
-    from tpu_operator.upgrade.upgrade_controller import UpgradeReconciler
-
-    upgrade = UpgradeReconciler(client, namespace)
-    mgr.add_reconciler(UPGRADE_KEY, lambda _key: upgrade.reconcile())
 
     if args.once:
         if args.fake and args.simulate_kubelet:
@@ -157,49 +228,7 @@ def main(argv=None) -> int:
         log.info("single pass done: ready=%s", res.ready)
         return 0 if res.ready else 2
 
-    # watches feed the workqueue (reference watch wiring,
-    # controllers/clusterpolicy_controller.go:317-344)
-    node_cache = {}
-
-    def on_event(event, obj):
-        kind = obj.get("kind")
-        if kind == "ClusterPolicy":
-            mgr.enqueue(CP_KEY)
-            mgr.enqueue(UPGRADE_KEY)
-        elif kind == "Node":
-            name = obj["metadata"]["name"]
-            old = node_cache.get(name)
-            node_cache[name] = None if event == "DELETED" else obj
-            if node_event_needs_reconcile(event, old, obj):
-                mgr.enqueue(CP_KEY)
-        elif kind == "DaemonSet":
-            # owned-operand drift (reference watch on owned DaemonSets)
-            mgr.enqueue(CP_KEY, delay=0.1)
-
-    if hasattr(client, "add_watcher"):
-        # fake client pushes events in-process
-        client.add_watcher(on_event)
-    elif hasattr(client, "watch"):
-        # real API server: one list+watch loop per watched kind
-        for av, kind, ns in (
-            (consts.API_VERSION, "ClusterPolicy", ""),
-            ("v1", "Node", ""),
-            ("apps/v1", "DaemonSet", namespace),
-        ):
-            threading.Thread(
-                target=client.watch,
-                args=(av, kind, on_event),
-                kwargs={"namespace": ns},
-                daemon=True,
-            ).start()
-    else:
-        def poll():
-            while True:
-                mgr.enqueue(CP_KEY)
-                mgr.enqueue(UPGRADE_KEY)
-                time.sleep(30)
-
-        threading.Thread(target=poll, daemon=True).start()
+    wire_event_sources(mgr, client, namespace)
 
     if args.fake and args.simulate_kubelet:
         threading.Thread(

@@ -259,3 +259,41 @@ def test_eviction_subresource(cluster):
     )
     with pytest.raises(NotFoundError):
         client.get("v1", "Pod", "victim", NS)
+
+
+def test_watch_resumes_without_relist_on_expiry(cluster):
+    """A clean server-side stream expiry must RESUME from the last seen
+    resourceVersion — no full re-list, no duplicate ADDED storm (the
+    informer contract; only a 410 forces the re-list)."""
+    _, client = cluster
+    client.create({"apiVersion": "v1", "kind": "ConfigMap",
+                   "metadata": {"name": "r1", "namespace": NS}})
+    events = []
+    stop = threading.Event()
+    t = threading.Thread(
+        target=client.watch,
+        args=("v1", "ConfigMap", lambda e, o: events.append((e, o["metadata"]["name"]))),
+        # 1s server timeout: the stream expires several times during the test
+        kwargs={"namespace": NS, "stop_event": stop, "timeout_s": 1},
+        daemon=True,
+    )
+    t.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and ("ADDED", "r1") not in events:
+            time.sleep(0.05)
+        assert ("ADDED", "r1") in events
+        # ride across ~3 expiries with no changes: r1 must NOT be
+        # re-delivered
+        time.sleep(3.2)
+        assert events.count(("ADDED", "r1")) == 1, events
+        # events still flow after the resumed streams
+        client.create({"apiVersion": "v1", "kind": "ConfigMap",
+                       "metadata": {"name": "r2", "namespace": NS}})
+        deadline = time.time() + 5
+        while time.time() < deadline and ("ADDED", "r2") not in events:
+            time.sleep(0.05)
+        assert ("ADDED", "r2") in events
+        assert events.count(("ADDED", "r1")) == 1, events
+    finally:
+        stop.set()

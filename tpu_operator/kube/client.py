@@ -272,6 +272,10 @@ class FakeClient(Client):
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
             obj = self._store.pop(key)
             deleted_uid = obj.get("metadata", {}).get("uid")
+            # the DELETED event carries the DELETION resourceVersion (real
+            # apiserver + kubesim semantics, so the two doubles agree)
+            self._rv += 1
+            obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
             self._notify("DELETED", obj)
             # ownerReference cascade, like the API server's garbage collector
             # (the reference leans on SetControllerReference for operand

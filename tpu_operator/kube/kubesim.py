@@ -226,7 +226,9 @@ class KubeSim:
             stored = self._objs.pop(key, None)
             if stored is None:
                 return 404, _status(404, "NotFound", f"{plural} {name} not found")
-            self._bump()
+            # the DELETED event carries the DELETION resourceVersion (real
+            # apiserver semantics) so clients can resume watches from it
+            stored["metadata"]["resourceVersion"] = self._bump()
             self._emit("DELETED", key, stored)
             self._gc(stored["metadata"].get("uid"))
             return 200, _status(200, "Success", f"{plural} {name} deleted")
@@ -245,7 +247,7 @@ class KubeSim:
         ]
         for key, obj in dependents:
             self._objs.pop(key, None)
-            self._bump()
+            obj["metadata"]["resourceVersion"] = self._bump()
             self._emit("DELETED", key, obj)
             self._gc(obj["metadata"].get("uid"))
 

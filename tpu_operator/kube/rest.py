@@ -296,16 +296,22 @@ class RestClient(Client):
                         },
                     )
                 known = seen
-                self._watch_stream(
-                    api_version,
-                    kind,
-                    namespace,
-                    rv,
-                    deliver,
-                    stop_event,
-                    timeout_s,
-                    known,
-                )
+                # stream, RESUMING from the last seen resourceVersion on
+                # clean expiry (server timeoutSeconds) — the informer
+                # contract: only a 410 Gone forces the full re-list above
+                while not stop_event.is_set():
+                    rv = self._watch_stream(
+                        api_version,
+                        kind,
+                        namespace,
+                        rv,
+                        deliver,
+                        stop_event,
+                        timeout_s,
+                        known,
+                    )
+                    if rv is None:
+                        break  # expired history: re-list
             except Exception:
                 if stop_event.is_set():
                     return
@@ -322,13 +328,17 @@ class RestClient(Client):
         stop_event,
         timeout_s,
         known=None,
-    ) -> None:
+    ) -> Optional[str]:
+        """One watch request. Returns the resourceVersion to RESUME from
+        after a clean server-side close (expiry), or ``None`` when the
+        server answered 410/ERROR — history expired, caller must re-list."""
         path = _resource_path(api_version, kind, namespace)
         params = {"watch": "true", "timeoutSeconds": str(timeout_s)}
         if rv:
             params["resourceVersion"] = rv
         path += "?" + urlencode(params)
         conn = self._make_conn(timeout=timeout_s + 30)
+        last_rv: Optional[str] = rv or None
         try:
             headers = {"Accept": "application/json"}
             token = self._token()
@@ -336,13 +346,15 @@ class RestClient(Client):
                 headers["Authorization"] = f"Bearer {token}"
             conn.request("GET", path, headers=headers)
             resp = conn.getresponse()
+            if resp.status == 410:
+                return None  # Gone: re-list
             if resp.status >= 400:
                 raise RuntimeError(f"watch {path} -> {resp.status}")
             buf = b""
             while not stop_event.is_set():
                 chunk = resp.read1(65536)
                 if not chunk:
-                    return  # server closed; caller re-lists
+                    return last_rv  # clean close; caller resumes from here
                 buf += chunk
                 while b"\n" in buf:
                     line, buf = buf.split(b"\n", 1)
@@ -352,7 +364,12 @@ class RestClient(Client):
                     etype = event.get("type", "")
                     obj = event.get("object", {})
                     if etype == "ERROR":
-                        return  # resourceVersion expired; re-list
+                        return None  # resourceVersion expired; re-list
+                    obj_rv = obj.get("metadata", {}).get("resourceVersion")
+                    if obj_rv:
+                        last_rv = obj_rv
+                    if etype == "BOOKMARK":
+                        continue  # progress marker only: advances last_rv
                     if etype in ("ADDED", "MODIFIED", "DELETED"):
                         obj.setdefault("apiVersion", api_version)
                         obj.setdefault("kind", kind)
@@ -367,5 +384,6 @@ class RestClient(Client):
                             else:
                                 known.add(key)
                         callback(etype, obj)
+            return last_rv
         finally:
             conn.close()

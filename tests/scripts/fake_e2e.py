@@ -106,27 +106,12 @@ def main() -> int:
             )
         )
 
+    from tpu_operator.kube.testing import make_validator_pod
+
     def validator_pod(node, ready):
-        name = f"val-{node}"
-        existing = client.get_or_none("v1", "Pod", name, NS)
-        if existing is not None:
-            client.delete("v1", "Pod", name, NS)
-        client.create(
-            {
-                "apiVersion": "v1",
-                "kind": "Pod",
-                "metadata": {
-                    "name": name,
-                    "namespace": NS,
-                    "labels": {"app": "tpu-operator-validator"},
-                },
-                "spec": {"nodeName": node},
-                "status": {
-                    "phase": "Running" if ready else "Pending",
-                    "containerStatuses": [{"ready": ready}],
-                },
-            }
-        )
+        if client.get_or_none("v1", "Pod", f"val-{node}", NS) is not None:
+            client.delete("v1", "Pod", f"val-{node}", NS)
+        client.create(make_validator_pod(node, ready, NS))
 
     validator_pod("vp-host-0", True)
     validator_pod("vp-host-1", False)  # one host lags: slice must be degraded

@@ -250,6 +250,47 @@ def main() -> int:
     )
     print("ok: 3-node rolling upgrade (cordon → evict → validate → uncordon)")
 
+    print("=== multi-host slice readiness (all-hosts-or-nothing aggregate)")
+    for i in range(2):
+        client.create(
+            make_tpu_node(
+                f"vp-host-{i}",
+                accelerator="tpu-v5p-slice",
+                topology="2x2x2",
+                extra_labels={
+                    consts.GKE_NODEPOOL_LABEL: "vp-pool",
+                    consts.TFD_SLICE_HOSTS_LABEL: "2",
+                    consts.TFD_WORKER_ID_LABEL: str(i),
+                },
+            )
+        )
+
+    from tpu_operator.kube.testing import make_validator_pod
+
+    def slice_validator(node, ready):
+        if client.get_or_none("v1", "Pod", f"val-{node}", NS) is not None:
+            client.delete("v1", "Pod", f"val-{node}", NS)
+        client.create(make_validator_pod(node, ready, NS))
+
+    slice_validator("vp-host-0", True)
+    slice_validator("vp-host-1", False)  # one host lags: slice degraded
+    converge()
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    slices = cp["status"].get("slices", {})
+    assert "vp-pool" in slices.get("degraded", []), slices
+    n0 = client.get("v1", "Node", "vp-host-0")
+    assert n0["metadata"]["labels"][consts.SLICE_READY_LABEL] == "false", (
+        "a slice with a lagging host must not be ready on ANY member"
+    )
+    slice_validator("vp-host-1", True)  # last host validates → slice flips
+    converge()
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    assert "vp-pool" not in cp["status"]["slices"].get("degraded", [])
+    for i in range(2):
+        node = client.get("v1", "Node", f"vp-host-{i}")
+        assert node["metadata"]["labels"][consts.SLICE_READY_LABEL] == "true"
+    print("ok: slice aggregate degraded → ready over the wire")
+
     print("=== uninstall (CR delete → SERVER-side ownerRef GC)")
     client.delete(CP, "ClusterPolicy", "cluster-policy")
     wait_for(

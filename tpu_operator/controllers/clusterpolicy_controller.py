@@ -24,7 +24,7 @@ from tpu_operator.controllers.state_manager import (
     ClusterPolicyController,
     has_tpu_labels,
 )
-from tpu_operator.kube.client import Client
+from tpu_operator.kube.client import Client, ConflictError
 
 log = logging.getLogger("tpu-operator.reconcile")
 
@@ -221,6 +221,23 @@ class ClusterPolicyReconciler:
         ]
         try:
             self.client.update_status(cp_obj)
+        except ConflictError:
+            # the CR moved while we reconciled (self-inflicted spec writes
+            # or another writer): re-read and re-apply the status to the
+            # fresh revision — standard status-writer retry, no logspam
+            try:
+                meta = cp_obj.get("metadata", {})
+                fresh = self.client.get(
+                    cp_obj["apiVersion"], cp_obj["kind"], meta["name"],
+                    meta.get("namespace", ""),
+                )
+                fresh["status"] = status
+                self.client.update_status(fresh)
+            except Exception:
+                log.exception(
+                    "failed to update ClusterPolicy status after conflict "
+                    "retry; next reconcile will converge it"
+                )
         except Exception:
             log.exception("failed to update ClusterPolicy status")
 

@@ -125,3 +125,23 @@ def wait_for(what: str, pred, timeout_s: float = 60.0, poll_s: float = 0.2):
             return
         time.sleep(poll_s)
     sys.exit(f"TIMEOUT waiting for {what}")
+
+
+def make_validator_pod(node: str, ready: bool, namespace: str) -> Obj:
+    """A validator operand pod as the slice-readiness aggregate sees it
+    (app label + phase + container readiness) — shared by the e2e scripts
+    so the pod shape can't drift between them."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"val-{node}",
+            "namespace": namespace,
+            "labels": {"app": "tpu-operator-validator"},
+        },
+        "spec": {"nodeName": node},
+        "status": {
+            "phase": "Running" if ready else "Pending",
+            "containerStatuses": [{"ready": ready}],
+        },
+    }

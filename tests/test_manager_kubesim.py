@@ -13,9 +13,8 @@ import pytest
 os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
 os.environ.setdefault("UNIT_TEST", "true")
 
-from tpu_operator.cfg.crdgen import build_crd
 from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
-from tpu_operator.kube.testing import make_tpu_node, simulate_kubelet_once
+from tpu_operator.kube.testing import simulate_kubelet_once
 from tpu_operator.main import build_manager, wire_event_sources
 from tpu_operator.manager import LeaderElector
 
@@ -34,16 +33,12 @@ def wait_until(pred, timeout_s=30.0, poll_s=0.1):
 
 @pytest.fixture()
 def cluster():
-    import yaml
+    from tpu_operator.kube.testing import seed_cluster
 
     server = KubeSimServer(KubeSim(bookmark_interval_s=1.0)).start()
     client = make_client(server.port)
     client.GET_RETRY_BACKOFF_S = 0.05
-    client.create({"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}})
-    client.create(build_crd())
-    client.create(make_tpu_node("tpu-node-1"))
-    with open("config/samples/v1_clusterpolicy.yaml") as f:
-        client.create(yaml.safe_load(f))
+    seed_cluster(client, NS, node_names=("tpu-node-1",))
     yield server, client
     server.stop()
 
@@ -163,3 +158,25 @@ def test_leader_election_failover_over_the_wire(cluster):
     )
     stop_b.set()
     tb.join(timeout=5)
+
+
+def test_kubesim_dev_mode_once_converges():
+    """`tpu-operator --kubesim --simulate-kubelet --once` is the dev loop
+    with wire semantics: one process, in-process apiserver, exit 0 on
+    Ready."""
+    import subprocess
+    import sys
+
+    res = subprocess.run(
+        [
+            sys.executable, "-m", "tpu_operator.main",
+            "--kubesim", "--simulate-kubelet", "--once",
+            "--metrics-port", "0", "--probe-port", "0",
+        ],
+        env=dict(os.environ, OPERATOR_NAMESPACE="tpu-operator"),
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "ready=True" in res.stderr

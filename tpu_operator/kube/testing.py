@@ -145,3 +145,33 @@ def make_validator_pod(node: str, ready: bool, namespace: str) -> Obj:
             "containerStatuses": [{"ready": ready}],
         },
     }
+
+
+def sample_clusterpolicy_path() -> str:
+    """Repo-relative path of the sample CR (single resolution point)."""
+    import os
+
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "config",
+        "samples",
+        "v1_clusterpolicy.yaml",
+    )
+
+
+def seed_cluster(client, namespace: str, node_names=("fake-tpu-node-1",)) -> None:
+    """Seed a kubesim/real cluster the way dev mode and the e2e fixtures
+    need it: namespace, generated CRD, TPU node(s), sample CR — one
+    helper so the dev loop and the tests cannot drift."""
+    import yaml
+
+    from tpu_operator.cfg.crdgen import build_crd
+
+    client.create(
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": namespace}}
+    )
+    client.create(build_crd())
+    for name in node_names:
+        client.create(make_tpu_node(name))
+    with open(sample_clusterpolicy_path()) as f:
+        client.create(yaml.safe_load(f))

@@ -48,10 +48,17 @@ def build_args(argv=None):
         help="run against an in-memory API server seeded with the sample CR",
     )
     p.add_argument(
+        "--kubesim",
+        action="store_true",
+        help="run against an in-process kubesim HTTP apiserver (CRD "
+        "admission, /status subresource, 409s, GC, watches) seeded like "
+        "--fake, through the production RestClient",
+    )
+    p.add_argument(
         "--simulate-kubelet",
         action="store_true",
-        help="(with --fake) mark DaemonSets scheduled/available and run "
-        "their pods, so the cluster converges to Ready",
+        help="(with --fake/--kubesim) mark DaemonSets scheduled/available "
+        "and run their pods, so the cluster converges to Ready",
     )
     p.add_argument("--log-level", default="INFO")
     p.add_argument(
@@ -141,6 +148,21 @@ def wire_event_sources(mgr, client, namespace: str, stop_event=None) -> None:
         threading.Thread(target=poll, daemon=True).start()
 
 
+def make_kubesim_client():
+    """An in-process kubesim apiserver seeded like ``make_fake_client``
+    (namespace, CRD, one TPU node, the sample CR), reached through the
+    production ``RestClient`` — the dev loop with wire semantics."""
+    from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+    from tpu_operator.kube.testing import seed_cluster
+
+    ns = os.environ.setdefault(consts.OPERATOR_NAMESPACE_ENV, consts.DEFAULT_NAMESPACE)
+    server = KubeSimServer(KubeSim()).start()
+    client = make_client(server.port)
+    seed_cluster(client, ns)
+    client._kubesim_server = server  # keep the server alive with the client
+    return client
+
+
 def make_fake_client():
     from tpu_operator.kube import FakeClient
     from tpu_operator.kube.testing import make_tpu_node
@@ -152,13 +174,9 @@ def make_fake_client():
             make_tpu_node("fake-tpu-node-1"),
         ]
     )
-    sample = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "config",
-        "samples",
-        "v1_clusterpolicy.yaml",
-    )
-    with open(sample) as f:
+    from tpu_operator.kube.testing import sample_clusterpolicy_path
+
+    with open(sample_clusterpolicy_path()) as f:
         cr = yaml.safe_load(f)
     cr["metadata"]["uid"] = "fake-uid"
     client.create(cr)
@@ -188,13 +206,20 @@ def main(argv=None) -> int:
 
     if args.fake:
         client = make_fake_client()
+    elif args.kubesim:
+        client = make_kubesim_client()
+        log.info("kubesim apiserver started in-process")
     else:
         from tpu_operator.kube.rest import RestClient
 
         try:
             client = RestClient()
         except FileNotFoundError as e:
-            log.error("not running in a cluster (%s); use --fake for dev", e)
+            log.error(
+                "not running in a cluster (%s); use --fake or --kubesim "
+                "for dev",
+                e,
+            )
             return 1
 
     namespace = os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "")
@@ -213,7 +238,7 @@ def main(argv=None) -> int:
     )
 
     if args.once:
-        if args.fake and args.simulate_kubelet:
+        if (args.fake or args.kubesim) and args.simulate_kubelet:
             from tpu_operator.kube.testing import simulate_kubelet_once
 
             # converge like the fake e2e: reconcile + kubelet sim rounds
@@ -230,7 +255,7 @@ def main(argv=None) -> int:
 
     wire_event_sources(mgr, client, namespace)
 
-    if args.fake and args.simulate_kubelet:
+    if (args.fake or args.kubesim) and args.simulate_kubelet:
         threading.Thread(
             target=_simulate_kubelet, args=(client, namespace), daemon=True
         ).start()

@@ -297,3 +297,51 @@ def test_watch_resumes_without_relist_on_expiry(cluster):
         assert events.count(("ADDED", "r1")) == 1, events
     finally:
         stop.set()
+
+
+def test_validator_workload_pod_spawn_over_the_wire(cluster):
+    """The jax/plugin validation spawns a workload pod and polls it to
+    Succeeded — driven against kubesim so the pod shape (tolerations,
+    resources, ownerRef to the validator DS) survives real admission and
+    the pod is GC'd with the DaemonSet."""
+    import threading
+    import time as _time
+
+    from tpu_operator.validator.workload_pods import (
+        jax_workload_pod,
+        run_to_completion,
+    )
+
+    _, client = cluster
+    ds = client.create(
+        {"apiVersion": "apps/v1", "kind": "DaemonSet",
+         "metadata": {"name": "tpu-operator-validator", "namespace": NS},
+         "spec": {"selector": {"matchLabels": {"app": "tpu-operator-validator"}}}}
+    )
+
+    def kubelet_runs_pod():
+        # the kubelet's role: run the scheduled pod to completion
+        deadline = _time.time() + 10
+        while _time.time() < deadline:
+            pod = client.get_or_none("v1", "Pod", "tpu-jax-validator", NS)
+            if pod is not None:
+                pod["status"] = {"phase": "Succeeded"}
+                client.update_status(pod)
+                return
+            _time.sleep(0.05)
+
+    t = threading.Thread(target=kubelet_runs_pod, daemon=True)
+    t.start()
+    pod = jax_workload_pod("tpu-node-1", NS)
+    phase = run_to_completion(client, pod, retries=100, sleep_s=0.1)
+    assert phase == "Succeeded"
+    live = client.get("v1", "Pod", "tpu-jax-validator", NS)
+    refs = live["metadata"]["ownerReferences"]
+    assert refs[0]["uid"] == ds["metadata"]["uid"]
+    assert live["spec"]["tolerations"][0]["key"] == "google.com/tpu"
+    assert live["spec"]["containers"][0]["resources"]["limits"][
+        "google.com/tpu"
+    ] == "1"
+    # deleting the validator DS GCs the workload pod server-side
+    client.delete("apps/v1", "DaemonSet", "tpu-operator-validator", NS)
+    assert client.get_or_none("v1", "Pod", "tpu-jax-validator", NS) is None

@@ -42,12 +42,13 @@ def build_args(argv=None):
         help="expose /debug/stacks and /debug/vars on the probe port",
     )
     p.add_argument("--assets", default=None, help="asset dir override")
-    p.add_argument(
+    backend = p.add_mutually_exclusive_group()
+    backend.add_argument(
         "--fake",
         action="store_true",
         help="run against an in-memory API server seeded with the sample CR",
     )
-    p.add_argument(
+    backend.add_argument(
         "--kubesim",
         action="store_true",
         help="run against an in-process kubesim HTTP apiserver (CRD "
@@ -263,7 +264,8 @@ def main(argv=None) -> int:
     mgr.enqueue(CP_KEY)
     mgr.enqueue(UPGRADE_KEY)
     mgr.install_signal_handlers()
-    log.info("tpu-operator starting (namespace=%s fake=%s)", namespace, args.fake)
+    mode = "fake" if args.fake else "kubesim" if args.kubesim else "cluster"
+    log.info("tpu-operator starting (namespace=%s mode=%s)", namespace, mode)
     mgr.run_forever()
     return 0
 

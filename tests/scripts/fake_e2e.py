@@ -136,8 +136,10 @@ def main() -> int:
     # clean up the slice nodes so the node-departure phase below still
     # exercises the zero-TPU-node posture
     for i in range(2):
+        # node deletion GCs the bound validator pod (pod-GC behavior the
+        # fake now shares with kubesim)
         client.delete("v1", "Node", f"vp-host-{i}")
-        client.delete("v1", "Pod", f"val-vp-host-{i}", NS)
+        assert client.get_or_none("v1", "Pod", f"val-vp-host-{i}", NS) is None
 
     print("=== node-departure (last TPU node removed → 45s NFD-poll posture)")
     client.delete("v1", "Node", "fake-tpu-node-1")

@@ -291,6 +291,18 @@ def main() -> int:
         assert node["metadata"]["labels"][consts.SLICE_READY_LABEL] == "true"
     print("ok: slice aggregate degraded → ready over the wire")
 
+    print("=== node churn (last TPU node gone → 45s NFD posture → recovery)")
+    for n in nodes + [f"vp-host-{i}" for i in range(2)]:
+        client.delete("v1", "Node", n)
+    res = reconciler.reconcile()
+    # reference semantics (clusterpolicy_controller.go:169-182): with no
+    # NFD-labelled node left the CR drops to notReady and polls at 45s
+    assert not res.ready and res.requeue_after == 45.0, res
+    client.create(make_tpu_node(nodes[0]))
+    res = converge()
+    assert res is not None and res.ready, f"no recovery on node arrival: {res}"
+    print("ok: node departure/arrival posture over the wire")
+
     print("=== uninstall (CR delete → SERVER-side ownerRef GC)")
     client.delete(CP, "ClusterPolicy", "cluster-policy")
     wait_for(

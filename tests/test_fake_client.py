@@ -93,3 +93,25 @@ def test_field_selector():
     c.create(p)
     assert len(c.list("v1", "Pod", "ns", field_selector={"spec.nodeName": "node-a"})) == 1
     assert len(c.list("v1", "Pod", "ns", field_selector={"spec.nodeName": "node-b"})) == 0
+
+
+def test_node_deletion_gcs_bound_pods_fake():
+    """FakeClient matches kubesim: deleting a Node removes pods bound to
+    it (pod-GC / node-lifecycle behavior) — the two doubles must agree."""
+    from tpu_operator.kube import FakeClient
+
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": "ns"}},
+            {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "doomed"}},
+        ]
+    )
+    client.create({"apiVersion": "v1", "kind": "Pod",
+                   "metadata": {"name": "on-doomed", "namespace": "ns"},
+                   "spec": {"nodeName": "doomed"}})
+    client.create({"apiVersion": "v1", "kind": "Pod",
+                   "metadata": {"name": "elsewhere", "namespace": "ns"},
+                   "spec": {"nodeName": "other"}})
+    client.delete("v1", "Node", "doomed")
+    assert client.get_or_none("v1", "Pod", "on-doomed", "ns") is None
+    assert client.get_or_none("v1", "Pod", "elsewhere", "ns") is not None

@@ -304,9 +304,6 @@ def test_validator_workload_pod_spawn_over_the_wire(cluster):
     Succeeded — driven against kubesim so the pod shape (tolerations,
     resources, ownerRef to the validator DS) survives real admission and
     the pod is GC'd with the DaemonSet."""
-    import threading
-    import time as _time
-
     from tpu_operator.validator.workload_pods import (
         jax_workload_pod,
         run_to_completion,
@@ -321,14 +318,14 @@ def test_validator_workload_pod_spawn_over_the_wire(cluster):
 
     def kubelet_runs_pod():
         # the kubelet's role: run the scheduled pod to completion
-        deadline = _time.time() + 10
-        while _time.time() < deadline:
+        deadline = time.time() + 10
+        while time.time() < deadline:
             pod = client.get_or_none("v1", "Pod", "tpu-jax-validator", NS)
             if pod is not None:
                 pod["status"] = {"phase": "Succeeded"}
                 client.update_status(pod)
                 return
-            _time.sleep(0.05)
+            time.sleep(0.05)
 
     t = threading.Thread(target=kubelet_runs_pod, daemon=True)
     t.start()
@@ -345,3 +342,20 @@ def test_validator_workload_pod_spawn_over_the_wire(cluster):
     # deleting the validator DS GCs the workload pod server-side
     client.delete("apps/v1", "DaemonSet", "tpu-operator-validator", NS)
     assert client.get_or_none("v1", "Pod", "tpu-jax-validator", NS) is None
+
+
+def test_node_deletion_gcs_bound_pods(cluster):
+    """Deleting a Node removes pods bound to it (pod-GC / node-lifecycle
+    behavior): stale DaemonSet pods on dead nodes must not linger."""
+    _, client = cluster
+    client.create({"apiVersion": "v1", "kind": "Node",
+                   "metadata": {"name": "doomed"}})
+    client.create({"apiVersion": "v1", "kind": "Pod",
+                   "metadata": {"name": "on-doomed", "namespace": NS},
+                   "spec": {"nodeName": "doomed"}})
+    client.create({"apiVersion": "v1", "kind": "Pod",
+                   "metadata": {"name": "elsewhere", "namespace": NS},
+                   "spec": {"nodeName": "other"}})
+    client.delete("v1", "Node", "doomed")
+    assert client.get_or_none("v1", "Pod", "on-doomed", NS) is None
+    assert client.get_or_none("v1", "Pod", "elsewhere", NS) is not None

@@ -277,6 +277,21 @@ class FakeClient(Client):
             self._rv += 1
             obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
             self._notify("DELETED", obj)
+            # node-lifecycle/pod-GC behavior, matching kubesim: deleting
+            # a Node removes pods bound to it (stale DaemonSet pods on a
+            # dead node would otherwise pin readiness NotReady forever)
+            if kind == "Node":
+                bound = [
+                    (k, o)
+                    for k, o in list(self._store.items())
+                    if k[1] == "Pod"
+                    and o.get("spec", {}).get("nodeName") == name
+                ]
+                for (av, k, ns, n), _o in bound:
+                    try:
+                        self.delete(av, k, n, ns)
+                    except NotFoundError:
+                        pass
             # ownerReference cascade, like the API server's garbage collector
             # (the reference leans on SetControllerReference for operand
             # cleanup on CR deletion)

@@ -26,9 +26,11 @@ cannot prove, behind the genuine REST/JSON wire the operator's
   ``pods/{name}/eviction`` subresource.
 
 Deliberately NOT simulated: authn/authz (any token accepted), admission
-webhooks, server-side apply, and kubelet/controller behaviors — pod and
-DaemonSet status stays writable by the test's node simulator, which
-plays the kubelet's role.
+webhooks, and server-side apply. Pod and DaemonSet status stays writable
+by the test's node simulator, which plays the kubelet's role. One
+controller behavior IS modeled because every real cluster has it and its
+absence diverges operator behavior: deleting a Node garbage-collects the
+pods bound to it (the pod-GC / node-lifecycle controllers).
 """
 
 from __future__ import annotations
@@ -223,15 +225,30 @@ class KubeSim:
     def delete(self, group, version, plural, namespace, name):
         with self._lock:
             key = self._key(group, version, plural, namespace, name)
-            stored = self._objs.pop(key, None)
+            stored = self._objs.get(key)
             if stored is None:
                 return 404, _status(404, "NotFound", f"{plural} {name} not found")
-            # the DELETED event carries the DELETION resourceVersion (real
-            # apiserver semantics) so clients can resume watches from it
-            stored["metadata"]["resourceVersion"] = self._bump()
-            self._emit("DELETED", key, stored)
-            self._gc(stored["metadata"].get("uid"))
+            # _delete_stored stamps the DELETION resourceVersion on the
+            # event (real apiserver semantics), cascades ownerRef GC, and
+            # for Nodes removes bound pods (pod-GC / node-lifecycle
+            # behavior — stale DaemonSet pods on dead nodes would pin
+            # readiness NotReady forever, unlike any real cluster)
+            self._delete_stored(key, stored)
             return 200, _status(200, "Success", f"{plural} {name} deleted")
+
+    def _delete_stored(self, key, obj: dict) -> None:
+        """Remove + emit with deletion-rv semantics, then cascade GC —
+        the single deletion path shared by delete/_gc/_gc_node_pods.
+        No-op when the object is already gone (an earlier cascade step in
+        the same snapshot loop may have removed it): an object must never
+        get two DELETED events."""
+        if self._objs.pop(key, None) is None:
+            return
+        obj["metadata"]["resourceVersion"] = self._bump()
+        self._emit("DELETED", key, obj)
+        self._gc(obj["metadata"].get("uid"))
+        if key[2] == "nodes":
+            self._gc_node_pods(key[4])
 
     def _gc(self, owner_uid: Optional[str]) -> None:
         """Cascade-delete dependents (the apiserver's foreground GC)."""
@@ -246,10 +263,17 @@ class KubeSim:
             )
         ]
         for key, obj in dependents:
-            self._objs.pop(key, None)
-            obj["metadata"]["resourceVersion"] = self._bump()
-            self._emit("DELETED", key, obj)
-            self._gc(obj["metadata"].get("uid"))
+            self._delete_stored(key, obj)
+
+    def _gc_node_pods(self, node_name: str) -> None:
+        orphans = [
+            (key, obj)
+            for key, obj in list(self._objs.items())
+            if key[2] == "pods"
+            and obj.get("spec", {}).get("nodeName") == node_name
+        ]
+        for key, obj in orphans:
+            self._delete_stored(key, obj)
 
     def get(self, group, version, plural, namespace, name):
         with self._lock:

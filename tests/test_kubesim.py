@@ -359,3 +359,50 @@ def test_node_deletion_gcs_bound_pods(cluster):
     client.delete("v1", "Node", "doomed")
     assert client.get_or_none("v1", "Pod", "on-doomed", NS) is None
     assert client.get_or_none("v1", "Pod", "elsewhere", NS) is not None
+
+
+def test_every_asset_manifest_is_server_admissible():
+    """POST every operand manifest from all 17 state dirs to kubesim —
+    including the default-disabled sandbox states no e2e ever creates —
+    so a manifest typo (bad kind, broken YAML, missing name) fails here
+    instead of on a real cluster."""
+    import os
+
+    import yaml
+
+    from tpu_operator.controllers.resource_manager import get_assets_from
+    from tpu_operator.kube.rest import KIND_TABLE
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assets = os.path.join(repo, "assets")
+    state_dirs = sorted(
+        os.path.join(assets, d)
+        for d in os.listdir(assets)
+        if os.path.isdir(os.path.join(assets, d))
+    )
+    assert len(state_dirs) >= 17, state_dirs
+    total = 0
+    for state_dir in state_dirs:
+        server = KubeSimServer(KubeSim()).start()
+        try:
+            client = make_client(server.port)
+            client.create(
+                {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}
+            )
+            # the SAME discovery production uses (openshift variants too)
+            for path in get_assets_from(state_dir, openshift=True):
+                with open(path) as f:
+                    docs = [d for d in yaml.safe_load_all(f) if d]
+                assert docs, f"{path}: no documents"
+                for obj in docs:
+                    kind = obj.get("kind")
+                    assert kind in KIND_TABLE, f"{path}: unknown kind {kind!r}"
+                    _, namespaced = KIND_TABLE[kind]
+                    if namespaced:
+                        obj.setdefault("metadata", {})["namespace"] = NS
+                    created = client.create(obj)
+                    assert created["metadata"]["uid"], path
+                    total += 1
+        finally:
+            server.stop()
+    assert total >= 60, total  # every operand object round-tripped

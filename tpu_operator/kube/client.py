@@ -210,6 +210,10 @@ class FakeClient(Client):
         self._rv += 1
         meta = obj.setdefault("metadata", {})
         meta["resourceVersion"] = str(self._rv)
+        # a uid on every object, like the apiserver (and kubesim): ownerRef
+        # GC keys on it, so an absent uid silently disables cascades
+        if not meta.get("uid"):
+            meta["uid"] = f"fake-uid-{self._rv:012d}"
         # creationTimestamp is set once; the monotonic counter keeps ordering
         # deterministic even within one wall-clock second
         if "creationTimestamp" not in meta:
@@ -248,6 +252,9 @@ class FakeClient(Client):
                 stored["metadata"]["creationTimestamp"] = existing["metadata"][
                     "creationTimestamp"
                 ]
+            # uid is immutable: always the stored one, never caller-supplied
+            if existing["metadata"].get("uid"):
+                stored.setdefault("metadata", {})["uid"] = existing["metadata"]["uid"]
             self._stamp(stored)
             self._store[key] = stored
             self._notify("MODIFIED", stored)
@@ -270,45 +277,48 @@ class FakeClient(Client):
             key = (api_version, kind, namespace or "", name)
             if key not in self._store:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
-            obj = self._store.pop(key)
-            deleted_uid = obj.get("metadata", {}).get("uid")
-            # the DELETED event carries the DELETION resourceVersion (real
-            # apiserver + kubesim semantics, so the two doubles agree)
-            self._rv += 1
-            obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
-            self._notify("DELETED", obj)
-            # node-lifecycle/pod-GC behavior, matching kubesim: deleting
-            # a Node removes pods bound to it (stale DaemonSet pods on a
-            # dead node would otherwise pin readiness NotReady forever)
-            if kind == "Node":
-                bound = [
-                    (k, o)
-                    for k, o in list(self._store.items())
-                    if k[1] == "Pod"
-                    and o.get("spec", {}).get("nodeName") == name
-                ]
-                for (av, k, ns, n), _o in bound:
-                    try:
-                        self.delete(av, k, n, ns)
-                    except NotFoundError:
-                        pass
-            # ownerReference cascade, like the API server's garbage collector
-            # (the reference leans on SetControllerReference for operand
-            # cleanup on CR deletion)
-            if deleted_uid:
-                orphans = [
-                    (k, o)
-                    for k, o in list(self._store.items())
-                    if any(
-                        ref.get("uid") == deleted_uid
-                        for ref in o.get("metadata", {}).get("ownerReferences", [])
-                    )
-                ]
-                for (av, k, ns, n), _o in orphans:
-                    try:
-                        self.delete(av, k, n, ns)
-                    except NotFoundError:
-                        pass
+            self._delete_stored(key)
+
+    def _delete_stored(self, key) -> None:
+        """Remove + notify with deletion-rv semantics, then cascade GC —
+        the single deletion path, in the SAME order as kubesim's
+        (ownerRef cascade, then node-bound pod GC) so the two doubles
+        emit identical DELETED event sequences. No-op when the object is
+        already gone (an earlier cascade step may have removed it)."""
+        obj = self._store.pop(key, None)
+        if obj is None:
+            return
+        _, kind, _, name = key
+        # the DELETED event carries the DELETION resourceVersion (real
+        # apiserver + kubesim semantics)
+        self._rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+        self._notify("DELETED", obj)
+        # ownerReference cascade, like the API server's garbage collector
+        # (the reference leans on SetControllerReference for operand
+        # cleanup on CR deletion)
+        deleted_uid = obj.get("metadata", {}).get("uid")
+        if deleted_uid:
+            for k, _o in [
+                (k, o)
+                for k, o in list(self._store.items())
+                if any(
+                    ref.get("uid") == deleted_uid
+                    for ref in o.get("metadata", {}).get("ownerReferences", [])
+                )
+            ]:
+                self._delete_stored(k)
+        # node-lifecycle/pod-GC behavior: deleting a Node removes pods
+        # bound to it (stale DaemonSet pods on a dead node would
+        # otherwise pin readiness NotReady forever)
+        if kind == "Node":
+            for k, _o in [
+                (k, o)
+                for k, o in list(self._store.items())
+                if k[1] == "Pod"
+                and o.get("spec", {}).get("nodeName") == name
+            ]:
+                self._delete_stored(k)
 
     # -- test helpers ----------------------------------------------------
     def all_objects(self) -> List[Obj]:

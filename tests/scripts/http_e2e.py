@@ -98,7 +98,30 @@ def main() -> int:
             kubelet_all_nodes()
             if res.ready:
                 return res
+        dump_not_ready()
         return res
+
+    def dump_not_ready():
+        """CI diagnostics: which state/control is holding NotReady. Walks
+        the already-loaded controls directly (no monkeypatching of
+        step()) and re-runs each control once — they are idempotent."""
+        from tpu_operator.api.v1.clusterpolicy_types import State
+        from tpu_operator.controllers import object_controls
+
+        ctrl = reconciler.ctrl
+        found = False
+        for state, controls in ctrl.controls.items():
+            for control_name, obj in controls:
+                status = object_controls.CONTROLS[control_name](ctrl, state, obj)
+                if status == State.NOT_READY:
+                    print(
+                        f"    NOT READY: {state} {control_name} "
+                        f"{obj.get('metadata', {}).get('name')}"
+                    )
+                    found = True
+        if not found:
+            print("    (all controls ready on the diagnostic pass — the "
+                  "failure was a converge-round race)")
 
     res = converge()
     assert res is not None and res.ready, f"never converged: {res}"
@@ -248,6 +271,12 @@ def main() -> int:
     assert client.get_or_none("v1", "Pod", "train-1", "default") is None, (
         "workload survived the drain — eviction subresource not exercised"
     )
+    # retire this phase's hand-played per-node kubelet pods: later spec
+    # changes re-hash the DS template, and outside this loop nothing
+    # plays the DS controller recreating them at the new revision
+    for n in nodes:
+        client.delete_if_exists("v1", "Pod", f"libtpu-{n}", NS)
+        client.delete_if_exists("v1", "Pod", f"validator-{n}", NS)
     print("ok: 3-node rolling upgrade (cordon → evict → validate → uncordon)")
 
     print("=== multi-host slice readiness (all-hosts-or-nothing aggregate)")
@@ -290,6 +319,41 @@ def main() -> int:
         node = client.get("v1", "Node", f"vp-host-{i}")
         assert node["metadata"]["labels"][consts.SLICE_READY_LABEL] == "true"
     print("ok: slice aggregate degraded → ready over the wire")
+
+    print("=== sandbox workloads (vm-passthrough posture over the wire)")
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    cp["spec"]["sandboxWorkloads"] = {"enabled": True}
+    client.update(cp)
+    client.create(
+        make_tpu_node(
+            "vm-host-1",
+            extra_labels={
+                consts.WORKLOAD_CONFIG_LABEL: consts.WORKLOAD_VM_PASSTHROUGH
+            },
+        )
+    )
+    res = converge()
+    assert res is not None and res.ready, f"sandbox enable broke readiness: {res}"
+    ds_names = {d["metadata"]["name"] for d in client.list("apps/v1", "DaemonSet", NS)}
+    assert "tpu-vm-manager-daemonset" in ds_names, sorted(ds_names)
+    vm_node = client.get("v1", "Node", "vm-host-1")
+    vm_labels = vm_node["metadata"]["labels"]
+    assert (
+        vm_labels.get(consts.DEPLOY_LABEL_PREFIX + consts.COMPONENT_VM_MANAGER)
+        == "true"
+    ), {k: v for k, v in vm_labels.items() if "deploy" in k}
+    # container components must NOT deploy to the vm-passthrough node
+    assert (
+        vm_labels.get(consts.DEPLOY_LABEL_PREFIX + consts.COMPONENT_LIBTPU)
+        != "true"
+    )
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    cp["spec"]["sandboxWorkloads"] = {"enabled": False}
+    client.update(cp)
+    client.delete("v1", "Node", "vm-host-1")
+    res = converge()
+    assert res is not None and res.ready
+    print("ok: sandbox enable/disable with vm-passthrough node labeling")
 
     print("=== node churn (last TPU node gone → 45s NFD posture → recovery)")
     for n in nodes + [f"vp-host-{i}" for i in range(2)]:

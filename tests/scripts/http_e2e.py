@@ -102,12 +102,26 @@ def main() -> int:
         return res
 
     def dump_not_ready():
-        """CI diagnostics: which state/control is holding NotReady. Walks
-        the already-loaded controls directly (no monkeypatching of
-        step()) and re-runs each control once — they are idempotent."""
+        """CI diagnostics: which state/control is holding NotReady. Starts
+        from what the failed reconcile actually wrote (status + conditions,
+        so early-return causes — no primary CR, no TPU nodes, init failure
+        — are visible), then walks the already-loaded controls directly
+        (no monkeypatching of step()) and re-runs each control once — they
+        are idempotent, though the re-run does re-apply manifests, so the
+        control walk is evidence about readiness, not a faithful snapshot
+        of the failed pass."""
         from tpu_operator.api.v1.clusterpolicy_types import State
         from tpu_operator.controllers import object_controls
 
+        cp_now = client.get_or_none(CP, "ClusterPolicy", "cluster-policy")
+        status_now = (cp_now or {}).get("status", {})
+        print(f"    CR status: state={status_now.get('state')!r}")
+        for cond in status_now.get("conditions") or []:
+            print(
+                f"    condition: type={cond.get('type')} "
+                f"status={cond.get('status')} reason={cond.get('reason')} "
+                f"message={cond.get('message')!r}"
+            )
         ctrl = reconciler.ctrl
         found = False
         for state, controls in ctrl.controls.items():
@@ -120,8 +134,10 @@ def main() -> int:
                     )
                     found = True
         if not found:
-            print("    (all controls ready on the diagnostic pass — the "
-                  "failure was a converge-round race)")
+            print("    (every control reports ready when re-run — the "
+                  "reconcile loop failed before/around the control walk; "
+                  "see the CR status/conditions above for the early-return "
+                  "cause, or it was a converge-round race)")
 
     res = converge()
     assert res is not None and res.ready, f"never converged: {res}"

@@ -57,6 +57,64 @@ def make_cpu_node(name: str) -> Obj:
     }
 
 
+def _stamp_ds_status(client: Client, ds: Obj, scheduled: int) -> None:
+    status = {
+        "desiredNumberScheduled": scheduled,
+        "numberUnavailable": 0,
+        "updatedNumberScheduled": scheduled,
+    }
+    if ds.get("status") != status:
+        ds["status"] = status
+        client.update_status(ds)
+
+
+def _ensure_operand_pod(
+    client: Client,
+    namespace: str,
+    name: str,
+    app: str,
+    revision_hash,
+    node_name: str,
+    refresh_stale: bool,
+) -> None:
+    """Create (or, when ``refresh_stale``, hash-refresh) one Running operand
+    pod — the single pod shape both kubelet simulators use so they can't
+    drift."""
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": {"app": app},
+            "annotations": {consts.LAST_APPLIED_HASH_ANNOTATION: revision_hash},
+        },
+        "spec": {"nodeName": node_name},
+        "status": {"phase": "Running", "containerStatuses": [{"ready": True}]},
+    }
+    existing = client.get_or_none("v1", "Pod", name, namespace)
+    if existing is None:
+        client.create(pod)
+    elif refresh_stale and (
+        existing["metadata"].get("annotations", {}).get(
+            consts.LAST_APPLIED_HASH_ANNOTATION
+        )
+        != revision_hash
+    ):
+        pod["metadata"]["resourceVersion"] = existing["metadata"]["resourceVersion"]
+        client.update(pod)
+
+
+def _ds_app_and_hash(ds: Obj):
+    app = ds["spec"]["selector"]["matchLabels"]["app"]
+    h = (
+        ds["spec"]["template"]["metadata"]
+        .get("annotations", {})
+        .get(consts.LAST_APPLIED_HASH_ANNOTATION)
+    )
+    return app, h
+
+
 def simulate_kubelet_once(
     client: Client,
     namespace: str,
@@ -69,47 +127,52 @@ def simulate_kubelet_once(
     earlier diverged copy of this helper missed)."""
     for ds in client.list("apps/v1", "DaemonSet", namespace):
         if not ds.get("status"):
-            ds["status"] = {
-                "desiredNumberScheduled": pods_per_ds,
-                "numberUnavailable": 0,
-                "updatedNumberScheduled": pods_per_ds,
-            }
-            client.update_status(ds)
+            _stamp_ds_status(client, ds, pods_per_ds)
         if ds["spec"].get("updateStrategy", {}).get("type") != "OnDelete":
             continue
-        app = ds["spec"]["selector"]["matchLabels"]["app"]
-        h = (
-            ds["spec"]["template"]["metadata"]
-            .get("annotations", {})
-            .get(consts.LAST_APPLIED_HASH_ANNOTATION)
-        )
+        app, h = _ds_app_and_hash(ds)
         for i in range(pods_per_ds):
-            name = f"{app}-{i}"
-            pod = {
-                "apiVersion": "v1",
-                "kind": "Pod",
-                "metadata": {
-                    "name": name,
-                    "namespace": namespace,
-                    "labels": {"app": app},
-                    "annotations": {consts.LAST_APPLIED_HASH_ANNOTATION: h},
-                },
-                "spec": {"nodeName": node_name},
-                "status": {"phase": "Running"},
-            }
-            existing = client.get_or_none("v1", "Pod", name, namespace)
-            if existing is None:
-                client.create(pod)
-            elif (
-                existing["metadata"].get("annotations", {}).get(
-                    consts.LAST_APPLIED_HASH_ANNOTATION
-                )
-                != h
-            ):
-                pod["metadata"]["resourceVersion"] = existing["metadata"][
-                    "resourceVersion"
-                ]
-                client.update(pod)
+            _ensure_operand_pod(
+                client,
+                namespace,
+                f"{app}-{i}",
+                app,
+                h,
+                node_name,
+                refresh_stale=True,
+            )
+
+
+def simulate_kubelet_nodes(client: Client, namespace: str, node_names) -> None:
+    """One kubelet pass over a multi-node pool with FAITHFUL OnDelete
+    semantics: each node gets one Running pod per DaemonSet (named
+    ``{app}-{node}``) stamped with the template revision hash at creation
+    time. An OnDelete pod is never refreshed on a template change — a real
+    OnDelete kubelet only re-creates a pod after something deletes it
+    (reference apps/v1 OnDelete contract, the premise of the upgrade FSM's
+    pod-restart step, ``upgrade_state.go:59-110``) — while a RollingUpdate
+    pod IS hash-refreshed, the way the DS controller rolls it.
+
+    ``simulate_kubelet_once`` (above) deliberately refreshes stale OnDelete
+    pods too, so single-node dev mode converges without the upgrade FSM;
+    this variant is the one upgrade e2e tests must use, otherwise the
+    kubelet would upgrade the driver behind the FSM's back and the rolling
+    upgrade would be untestable."""
+    node_names = list(node_names)
+    for ds in client.list("apps/v1", "DaemonSet", namespace):
+        _stamp_ds_status(client, ds, len(node_names))
+        on_delete = ds["spec"].get("updateStrategy", {}).get("type") == "OnDelete"
+        app, h = _ds_app_and_hash(ds)
+        for node in node_names:
+            _ensure_operand_pod(
+                client,
+                namespace,
+                f"{app}-{node}",
+                app,
+                h,
+                node,
+                refresh_stale=not on_delete,
+            )
 
 
 def wait_for(what: str, pred, timeout_s: float = 60.0, poll_s: float = 0.2):

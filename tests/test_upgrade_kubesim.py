@@ -71,7 +71,7 @@ def test_rolling_upgrade_three_nodes_over_the_wire(cluster):
         while not halt.is_set():
             try:
                 simulate_kubelet_nodes(client, NS, NODES)
-            except (ConflictError, NotFoundError, TransientAPIError):
+            except (ConflictError, NotFoundError, TransientAPIError, OSError):
                 pass  # races with the reconciler/FSM; retried next pass
             time.sleep(0.15)
 
@@ -100,7 +100,7 @@ def test_rolling_upgrade_three_nodes_over_the_wire(cluster):
                     if s in us.ACTIVE_STATES:
                         active += 1
                 max_active[0] = max(max_active[0], active)
-            except TransientAPIError:
+            except (TransientAPIError, OSError):
                 pass  # server busy/stopping; keep the retry rate bounded
             time.sleep(0.05)
 
@@ -216,6 +216,130 @@ def test_rolling_upgrade_three_nodes_over_the_wire(cluster):
         assert seen_states & set(us.ACTIVE_STATES), (
             f"sampler saw no active states at all: {seen_states}"
         )
+    finally:
+        halt.set()
+        stop.set()
+        mgr.stop()
+
+
+def test_upgrade_drain_timeout_failure_recovery_and_cleanup(cluster):
+    """The unhappy path over the wire: a node whose drain cannot clear (an
+    unmanaged TPU pod, non-force drain) exhausts its 1 s budget and lands
+    terminal ``upgrade-failed`` — cordoned, Warning Event on the Node —
+    while the unblocked nodes complete. The documented recovery (remove
+    the blocker, uncordon, clear the state label) re-enters the FSM to
+    done; disabling autoUpgrade then strips every per-node state label
+    (reference ``controllers/upgrade_controller.go:168-194``)."""
+    server, client = cluster
+    mgr, _, _ = build_manager(client, NS, metrics_port=0, probe_port=0)
+    stop = threading.Event()
+    wire_event_sources(mgr, client, NS, stop_event=stop)
+    mgr.start()
+
+    halt = threading.Event()
+
+    def kubelet():
+        while not halt.is_set():
+            try:
+                simulate_kubelet_nodes(client, NS, NODES)
+            except (ConflictError, NotFoundError, TransientAPIError, OSError):
+                pass
+            time.sleep(0.15)
+
+    def pump():
+        while not halt.is_set():
+            mgr.enqueue(UPGRADE_KEY)
+            time.sleep(0.25)
+
+    for fn in (kubelet, pump):
+        threading.Thread(target=fn, daemon=True).start()
+
+    try:
+        assert wait_until(lambda: cr_state(client) == "ready", 90)
+
+        # an UNMANAGED (ownerless) TPU pod on node 1: kubectl-drain
+        # semantics refuse to delete it without force, so drain can never
+        # clear the node
+        client.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": "adhoc-train", "namespace": NS},
+                "spec": {
+                    "nodeName": NODES[0],
+                    "containers": [
+                        {
+                            "name": "train",
+                            "resources": {"limits": {consts.TPU_RESOURCE: "4"}},
+                        }
+                    ],
+                },
+                "status": {"phase": "Running"},
+            }
+        )
+
+        cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
+        cp["spec"]["libtpu"]["upgradePolicy"] = {
+            "autoUpgrade": True,
+            "maxParallelUpgrades": 3,
+            "maxUnavailable": "100%",
+            "drain": {"enable": True, "timeoutSeconds": 1},
+        }
+        cp["spec"]["libtpu"]["version"] = "2025.3.0"
+        client.update(cp)
+
+        def settled():
+            labels = {n: upgrade_label(client.get("v1", "Node", n)) for n in NODES}
+            return labels[NODES[0]] == us.STATE_FAILED and all(
+                labels[n] == us.STATE_DONE for n in NODES[1:]
+            )
+
+        assert wait_until(settled, 90), {
+            n: upgrade_label(client.get("v1", "Node", n)) for n in NODES
+        }
+
+        # terminal failure: node stays cordoned, blocker survived the
+        # (non-force) drain, and the cause is a Warning Event on the Node
+        blocked = client.get("v1", "Node", NODES[0])
+        assert blocked.get("spec", {}).get("unschedulable") is True
+        assert client.get_or_none("v1", "Pod", "adhoc-train", NS) is not None
+        events = client.list("v1", "Event", NS)
+        assert any(
+            e.get("reason") == "UpgradeDrainTimeout"
+            and e.get("involvedObject", {}).get("name") == NODES[0]
+            for e in events
+        ), [e.get("reason") for e in events]
+
+        # a failed node holds its budget slot but must not block retries
+        # forever: the documented recovery is remove the blocker, uncordon,
+        # clear the state label
+        client.delete("v1", "Pod", "adhoc-train", NS)
+        node = client.get("v1", "Node", NODES[0])
+        node["spec"]["unschedulable"] = False
+        client.update(node)
+        node = client.get("v1", "Node", NODES[0])
+        del node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL]
+        client.update(node)
+
+        assert wait_until(
+            lambda: upgrade_label(client.get("v1", "Node", NODES[0]))
+            == us.STATE_DONE,
+            90,
+        ), upgrade_label(client.get("v1", "Node", NODES[0]))
+        assert not client.get("v1", "Node", NODES[0]).get("spec", {}).get(
+            "unschedulable", False
+        )
+
+        # disabling autoUpgrade strips the per-node FSM labels
+        cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
+        cp["spec"]["libtpu"]["upgradePolicy"]["autoUpgrade"] = False
+        client.update(cp)
+        assert wait_until(
+            lambda: all(
+                upgrade_label(client.get("v1", "Node", n)) is None for n in NODES
+            ),
+            60,
+        ), {n: upgrade_label(client.get("v1", "Node", n)) for n in NODES}
     finally:
         halt.set()
         stop.set()

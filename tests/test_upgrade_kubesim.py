@@ -11,6 +11,7 @@ uncordon -> done, throttled to one node in flight by
 import os
 import threading
 import time
+from contextlib import contextmanager
 
 import pytest
 
@@ -58,13 +59,18 @@ def upgrade_label(node):
     return (node["metadata"].get("labels") or {}).get(consts.UPGRADE_STATE_LABEL)
 
 
-def test_rolling_upgrade_three_nodes_over_the_wire(cluster):
-    server, client = cluster
+@contextmanager
+def running_operator(client, extra_threads=()):
+    """The shared e2e scaffolding: the full Manager wired exactly as
+    main() ships it, plus a faithful-OnDelete kubelet per node and an
+    upgrade-reconciler pump (production re-queues every 120 s,
+    ``upgrade_controller.REQUEUE_S``; same level-triggered loop at test
+    cadence). ``extra_threads`` are ``fn(halt)`` loops joined to the same
+    halt event so both tests stop identically."""
     mgr, _, _ = build_manager(client, NS, metrics_port=0, probe_port=0)
     stop = threading.Event()
     wire_event_sources(mgr, client, NS, stop_event=stop)
     mgr.start()
-
     halt = threading.Event()
 
     def kubelet():
@@ -76,19 +82,31 @@ def test_rolling_upgrade_three_nodes_over_the_wire(cluster):
             time.sleep(0.15)
 
     def pump():
-        # production re-queues the upgrade reconciler every 120 s
-        # (upgrade_controller.REQUEUE_S); same level-triggered loop at
-        # test cadence
         while not halt.is_set():
             mgr.enqueue(UPGRADE_KEY)
             time.sleep(0.25)
+
+    for fn in (kubelet, pump):
+        threading.Thread(target=fn, daemon=True).start()
+    for fn in extra_threads:
+        threading.Thread(target=fn, args=(halt,), daemon=True).start()
+    try:
+        yield mgr
+    finally:
+        halt.set()
+        stop.set()
+        mgr.stop()
+
+
+def test_rolling_upgrade_three_nodes_over_the_wire(cluster):
+    server, client = cluster
 
     # concurrency witness: at no sampled instant may more than
     # maxParallelUpgrades(=1) nodes sit in an active FSM state
     max_active = [0]
     seen_states = set()
 
-    def sampler():
+    def sampler(halt):
         while not halt.is_set():
             try:
                 nodes = client.list("v1", "Node")
@@ -104,10 +122,7 @@ def test_rolling_upgrade_three_nodes_over_the_wire(cluster):
                 pass  # server busy/stopping; keep the retry rate bounded
             time.sleep(0.05)
 
-    for fn in (kubelet, pump, sampler):
-        threading.Thread(target=fn, daemon=True).start()
-
-    try:
+    with running_operator(client, extra_threads=(sampler,)):
         assert wait_until(lambda: cr_state(client) == "ready", 90), (
             "cluster never converged to Ready before the upgrade"
         )
@@ -216,10 +231,6 @@ def test_rolling_upgrade_three_nodes_over_the_wire(cluster):
         assert seen_states & set(us.ACTIVE_STATES), (
             f"sampler saw no active states at all: {seen_states}"
         )
-    finally:
-        halt.set()
-        stop.set()
-        mgr.stop()
 
 
 def test_upgrade_drain_timeout_failure_recovery_and_cleanup(cluster):
@@ -231,30 +242,7 @@ def test_upgrade_drain_timeout_failure_recovery_and_cleanup(cluster):
     done; disabling autoUpgrade then strips every per-node state label
     (reference ``controllers/upgrade_controller.go:168-194``)."""
     server, client = cluster
-    mgr, _, _ = build_manager(client, NS, metrics_port=0, probe_port=0)
-    stop = threading.Event()
-    wire_event_sources(mgr, client, NS, stop_event=stop)
-    mgr.start()
-
-    halt = threading.Event()
-
-    def kubelet():
-        while not halt.is_set():
-            try:
-                simulate_kubelet_nodes(client, NS, NODES)
-            except (ConflictError, NotFoundError, TransientAPIError, OSError):
-                pass
-            time.sleep(0.15)
-
-    def pump():
-        while not halt.is_set():
-            mgr.enqueue(UPGRADE_KEY)
-            time.sleep(0.25)
-
-    for fn in (kubelet, pump):
-        threading.Thread(target=fn, daemon=True).start()
-
-    try:
+    with running_operator(client):
         assert wait_until(lambda: cr_state(client) == "ready", 90)
 
         # an UNMANAGED (ownerless) TPU pod on node 1: kubectl-drain
@@ -340,7 +328,3 @@ def test_upgrade_drain_timeout_failure_recovery_and_cleanup(cluster):
             ),
             60,
         ), {n: upgrade_label(client.get("v1", "Node", n)) for n in NODES}
-    finally:
-        halt.set()
-        stop.set()
-        mgr.stop()

@@ -227,6 +227,24 @@ def test_slice_partition_2x2(slice_env):
     assert labels[consts.DEPLOY_LABEL_PREFIX + "device-plugin"] == "true"
 
 
+def test_slice_lingering_pause_recovers(slice_env):
+    """A crash (or 409 storm) between apply and unpause leaves chip
+    clients paused with the state label already success; the paused-client
+    veto on the early-return guard must make the next pass re-apply and
+    restore them."""
+    client, mgr, tmp = slice_env
+    set_config(client, "all-2x2")
+    assert mgr.reconcile_once() == sm.STATE_SUCCESS
+    node = client.get("v1", "Node", "n1")
+    node["metadata"]["labels"][
+        consts.DEPLOY_LABEL_PREFIX + "device-plugin"
+    ] = sm.PAUSED_VALUE
+    client.update(node)
+    assert mgr.reconcile_once() == sm.STATE_SUCCESS
+    labels = client.get("v1", "Node", "n1")["metadata"]["labels"]
+    assert labels[consts.DEPLOY_LABEL_PREFIX + "device-plugin"] == "true"
+
+
 def test_slice_unpartitioned(slice_env):
     client, mgr, tmp = slice_env
     set_config(client, "all-disabled")

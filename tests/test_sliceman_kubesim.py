@@ -1,0 +1,171 @@
+"""Slice manager (mig-manager slot) over the wire: the node-daemon label
+FSM driven through the production RestClient against kubesim — including
+the write-race case a fake client can't produce faithfully: another label
+writer (the operator's deploy-label bus, TFD) updating the same Node
+concurrently. A 409 on the slice manager's label writes must be retried,
+never reported as partition failure (reference: mig-manager shares
+``nvidia.com/*`` node labels with the operator the same way)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+import yaml
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+from tpu_operator import consts
+from tpu_operator.kube.client import ConflictError, NotFoundError
+from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+from tpu_operator.kube.rest import TransientAPIError
+from tpu_operator.kube.testing import make_tpu_node
+from tpu_operator.sliceman import slice_manager as sm
+
+NODE = "slice-node-1"
+
+
+def wait_until(pred, timeout_s=30.0, poll_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll_s)
+    return False
+
+
+@pytest.fixture()
+def env(tmp_path):
+    server = KubeSimServer(KubeSim()).start()
+    client = make_client(server.port)
+    client.GET_RETRY_BACKOFF_S = 0.05
+    node = make_tpu_node(NODE, topology="2x4")
+    node["metadata"]["labels"][consts.DEPLOY_LABEL_PREFIX + "device-plugin"] = "true"
+    client.create(node)
+
+    cfg = tmp_path / "slice-configs.yaml"
+    cfg.write_text(
+        yaml.safe_dump(
+            {
+                "version": "v1",
+                "slice-configs": {
+                    "all-2x2": [
+                        {
+                            "devices": "all",
+                            "partitioned": True,
+                            "layout": {"shape": "2x2"},
+                        }
+                    ],
+                },
+            }
+        )
+    )
+    clients_file = tmp_path / "clients.yaml"
+    clients_file.write_text(
+        yaml.safe_dump(
+            {
+                "version": "v1",
+                "kubernetes-labels": [consts.DEPLOY_LABEL_PREFIX + "device-plugin"],
+            }
+        )
+    )
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    for i in range(8):
+        (dev / f"accel{i}").touch()
+
+    mgr = sm.SliceManager(
+        client,
+        NODE,
+        config_file=str(cfg),
+        chip_clients_file=str(clients_file),
+        partition_file=str(tmp_path / "partitions.json"),
+        cdi_spec_path=str(tmp_path / "cdi.yaml"),
+        dev_root=str(dev),
+    )
+    yield client, mgr, tmp_path
+    server.stop()
+
+
+def test_slice_fsm_converges_under_label_churn(env):
+    client, mgr, tmp = env
+
+    halt = threading.Event()
+    states_seen = set()
+
+    def churn():
+        """Another node-label writer racing the slice manager — forces
+        real 409s on the shared Node object."""
+        i = 0
+        while not halt.is_set():
+            try:
+                node = client.get("v1", "Node", NODE)
+                s = node["metadata"]["labels"].get(consts.SLICE_CONFIG_STATE_LABEL)
+                if s:
+                    states_seen.add(s)
+                node["metadata"]["labels"]["churn.test/seq"] = str(i)
+                client.update(node)
+                i += 1
+            except (ConflictError, NotFoundError, TransientAPIError, OSError):
+                pass
+            # no sleep: maximize write pressure on the shared Node
+
+    def daemon_loop():
+        # run_loop's body at test cadence, halt-aware so the thread does
+        # not outlive the fixture's server
+        while not halt.is_set():
+            try:
+                mgr.reconcile_once()
+            except (ConflictError, TransientAPIError, OSError):
+                pass
+            time.sleep(0.05)
+
+    loop = threading.Thread(target=daemon_loop, daemon=True)
+    churn_t = threading.Thread(target=churn, daemon=True)
+    churn_t.start()
+    loop.start()
+    try:
+        # request the partition via the node label, like GKE tooling would
+        def set_config():
+            node = client.get("v1", "Node", NODE)
+            node["metadata"]["labels"][consts.SLICE_CONFIG_LABEL] = "all-2x2"
+            client.update(node)
+
+        for _ in range(20):
+            try:
+                set_config()
+                break
+            except ConflictError:
+                time.sleep(0.02)
+
+        assert wait_until(
+            lambda: (
+                client.get("v1", "Node", NODE)["metadata"]["labels"].get(
+                    consts.SLICE_CONFIG_STATE_LABEL
+                )
+                == sm.STATE_SUCCESS
+            ),
+            30,
+        ), client.get("v1", "Node", NODE)["metadata"]["labels"]
+    finally:
+        halt.set()
+        churn_t.join(timeout=5)
+        loop.join(timeout=5)
+
+    # the partition really happened: 2x4 host -> two ICI-contiguous 2x2
+    # subslices, CDI composite devices regenerated
+    state = json.loads((tmp / "partitions.json").read_text())
+    assert state["partitioned"] and state["shape"] == "2x2"
+    assert len(state["subslices"]) == 2
+    spec = yaml.safe_load((tmp / "cdi.yaml").read_text())
+    names = [d["name"] for d in spec["devices"]]
+    assert "subslice-0-2x2" in names and "subslice-1-2x2" in names
+
+    # chip clients were restored after the repartition window
+    labels = client.get("v1", "Node", NODE)["metadata"]["labels"]
+    assert labels[consts.DEPLOY_LABEL_PREFIX + "device-plugin"] == "true"
+
+    # the write races never surfaced as a partition failure
+    assert sm.STATE_FAILED not in states_seen, states_seen

@@ -31,6 +31,7 @@ from typing import Dict, List, Optional
 import yaml
 
 from tpu_operator import consts
+from tpu_operator.kube.client import ConflictError
 from tpu_operator.native import tpuinfo
 from tpu_operator.workloads import topology as topo
 
@@ -145,12 +146,34 @@ class SliceManager:
     def _node(self) -> dict:
         return self.client.get("v1", "Node", self.node_name)
 
+    def _mutate_labels(self, mutate) -> None:
+        """Apply ``mutate(labels) -> bool(changed)`` under optimistic
+        concurrency: the Node object is shared with other label writers
+        (the operator's deploy-label bus, the upgrade FSM, TFD), so a 409
+        means re-GET and re-apply, not failure."""
+        last: Optional[Exception] = None
+        for attempt in range(5):
+            if attempt:
+                time.sleep(0.05 * attempt)
+            node = self._node()
+            labels = node["metadata"].setdefault("labels", {})
+            if not mutate(labels):
+                return
+            try:
+                self.client.update(node)
+                return
+            except ConflictError as e:
+                last = e
+        raise last  # type: ignore[misc]
+
     def _set_state(self, value: str) -> None:
-        node = self._node()
-        labels = node["metadata"].setdefault("labels", {})
-        if labels.get(consts.SLICE_CONFIG_STATE_LABEL) != value:
+        def mutate(labels: dict) -> bool:
+            if labels.get(consts.SLICE_CONFIG_STATE_LABEL) == value:
+                return False
             labels[consts.SLICE_CONFIG_STATE_LABEL] = value
-            self.client.update(node)
+            return True
+
+        self._mutate_labels(mutate)
 
     def _pause_clients(self, pause: bool) -> None:
         """Flip chip-client deploy labels so their DaemonSets release the
@@ -159,18 +182,19 @@ class SliceManager:
         client_labels = load_chip_clients(self.chip_clients_file)
         if not client_labels:
             return
-        node = self._node()
-        labels = node["metadata"].setdefault("labels", {})
-        changed = False
-        for key in client_labels:
-            if pause and labels.get(key) == "true":
-                labels[key] = PAUSED_VALUE
-                changed = True
-            elif not pause and labels.get(key) == PAUSED_VALUE:
-                labels[key] = "true"
-                changed = True
-        if changed:
-            self.client.update(node)
+
+        def mutate(labels: dict) -> bool:
+            changed = False
+            for key in client_labels:
+                if pause and labels.get(key) == "true":
+                    labels[key] = PAUSED_VALUE
+                    changed = True
+                elif not pause and labels.get(key) == PAUSED_VALUE:
+                    labels[key] = "true"
+                    changed = True
+            return changed
+
+        self._mutate_labels(mutate)
 
     # ------------------------------------------------------------------
     def apply_config(self, config_name: str) -> dict:
@@ -219,23 +243,47 @@ class SliceManager:
         want = labels.get(consts.SLICE_CONFIG_LABEL)
         if not want:
             return None
-        if want == self._applied and labels.get(
-            consts.SLICE_CONFIG_STATE_LABEL
-        ) == STATE_SUCCESS:
+        # clients still paused (a prior pass crashed/409'd between apply
+        # and unpause, or a previous process died mid-window) veto the
+        # early return: the re-apply below is idempotent and retries the
+        # unpause
+        paused = any(
+            labels.get(k) == PAUSED_VALUE
+            for k in load_chip_clients(self.chip_clients_file)
+        )
+        if (
+            want == self._applied
+            and labels.get(consts.SLICE_CONFIG_STATE_LABEL) == STATE_SUCCESS
+            and not paused
+        ):
             return STATE_SUCCESS
-        self._set_state(STATE_PENDING)
         try:
+            self._set_state(STATE_PENDING)
             self._pause_clients(True)
             self.apply_config(want)
             self._applied = want
             self._set_state(STATE_SUCCESS)
-            return STATE_SUCCESS
+            result = STATE_SUCCESS
+        except ConflictError:
+            # a write race that outlasted the retry budget is transient —
+            # the next loop pass re-reconciles; marking the partition
+            # FAILED over it would misreport a healthy node
+            log.warning("slice config %r hit persistent 409s; retrying", want)
+            result = None
         except Exception:
             log.exception("slice config %r failed", want)
-            self._set_state(STATE_FAILED)
-            return STATE_FAILED
-        finally:
+            try:
+                self._set_state(STATE_FAILED)
+            except ConflictError:
+                log.warning("failed-state write hit 409s; next pass retries")
+            result = STATE_FAILED
+        try:
             self._pause_clients(False)
+        except ConflictError:
+            # clients stay paused for now; the paused-veto above makes the
+            # next pass retry the unpause instead of early-returning
+            log.warning("unpause hit persistent 409s; next pass retries")
+        return result
 
     def run_loop(self, interval_s: float = 15.0, once: bool = False) -> None:
         while True:

@@ -328,3 +328,55 @@ def test_upgrade_drain_timeout_failure_recovery_and_cleanup(cluster):
             ),
             60,
         ), {n: upgrade_label(client.get("v1", "Node", n)) for n in NODES}
+
+
+def test_operator_restart_mid_upgrade_resumes_fsm(cluster):
+    """Stateless-by-reconstruction over the wire: kill the operator while
+    the rolling upgrade is mid-flight (node 1 in an active FSM state,
+    nodes 2-3 still pending under maxParallelUpgrades=1) and start a
+    fresh process. The FSM must resume from the node labels alone — no
+    local persistence — and complete all three nodes (reference property:
+    node labels are the durable store,
+    ``node_upgrade_state_provider.go``; SURVEY §5 checkpoint/resume)."""
+    server, client = cluster
+
+    with running_operator(client):
+        assert wait_until(lambda: cr_state(client) == "ready", 90)
+        cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
+        cp["spec"]["libtpu"]["upgradePolicy"] = {
+            "autoUpgrade": True,
+            "maxParallelUpgrades": 1,
+            "maxUnavailable": 1,
+        }
+        cp["spec"]["libtpu"]["version"] = "2025.4.0"
+        client.update(cp)
+
+        def one_in_flight():
+            return any(
+                upgrade_label(client.get("v1", "Node", n)) in us.ACTIVE_STATES
+                for n in NODES
+            )
+
+        assert wait_until(one_in_flight, 60), "upgrade never started"
+    # operator killed here, mid-upgrade
+
+    labels_at_crash = {
+        n: upgrade_label(client.get("v1", "Node", n)) for n in NODES
+    }
+    assert any(s != us.STATE_DONE for s in labels_at_crash.values()), (
+        f"nothing left to resume: {labels_at_crash}"
+    )
+
+    with running_operator(client):
+        assert wait_until(
+            lambda: all(
+                upgrade_label(client.get("v1", "Node", n)) == us.STATE_DONE
+                for n in NODES
+            ),
+            120,
+        ), {n: upgrade_label(client.get("v1", "Node", n)) for n in NODES}
+        for name in NODES:
+            assert not client.get("v1", "Node", name).get("spec", {}).get(
+                "unschedulable", False
+            ), f"{name} left cordoned after the resumed upgrade"
+        assert wait_until(lambda: cr_state(client) == "ready", 60)

@@ -27,6 +27,8 @@ try:
 except ImportError:  # operator-core tests run fine without jax
     pass
 
+from contextlib import contextmanager  # noqa: E402
+
 import pytest  # noqa: E402
 
 from tpu_operator.kube import FakeClient  # noqa: E402
@@ -36,3 +38,62 @@ from tpu_operator.kube.testing import make_cpu_node, make_tpu_node  # noqa: E402
 @pytest.fixture()
 def fake_client():
     return FakeClient()
+
+
+def wait_until(pred, timeout_s=60.0, poll_s=0.1):
+    """Shared polling helper for the kubesim wire e2es."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll_s)
+    return False
+
+
+@contextmanager
+def running_operator(client, namespace, node_names, extra_threads=()):
+    """Wire-e2e scaffolding: the full Manager wired exactly as main()
+    ships it (both reconcilers, watch-fed queue), a faithful-OnDelete
+    kubelet per node, and an upgrade-reconciler pump (production re-queues
+    every 120 s, ``upgrade_controller.REQUEUE_S``; same level-triggered
+    loop at test cadence). ``extra_threads`` are ``fn(halt)`` loops joined
+    to the same halt event so every wire test stops identically."""
+    import threading
+    import time
+
+    from tpu_operator.kube.client import ConflictError, NotFoundError
+    from tpu_operator.kube.rest import TransientAPIError
+    from tpu_operator.kube.testing import simulate_kubelet_nodes
+    from tpu_operator.main import UPGRADE_KEY, build_manager, wire_event_sources
+
+    mgr, _, _ = build_manager(client, namespace, metrics_port=0, probe_port=0)
+    stop = threading.Event()
+    wire_event_sources(mgr, client, namespace, stop_event=stop)
+    mgr.start()
+    halt = threading.Event()
+
+    def kubelet():
+        while not halt.is_set():
+            try:
+                simulate_kubelet_nodes(client, namespace, node_names)
+            except (ConflictError, NotFoundError, TransientAPIError, OSError):
+                pass  # races with the reconciler/FSM; retried next pass
+            time.sleep(0.15)
+
+    def pump():
+        while not halt.is_set():
+            mgr.enqueue(UPGRADE_KEY)
+            time.sleep(0.25)
+
+    for fn in (kubelet, pump):
+        threading.Thread(target=fn, daemon=True).start()
+    for fn in extra_threads:
+        threading.Thread(target=fn, args=(halt,), daemon=True).start()
+    try:
+        yield mgr
+    finally:
+        halt.set()
+        stop.set()
+        mgr.stop()

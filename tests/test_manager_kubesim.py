@@ -13,6 +13,7 @@ import pytest
 os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
 os.environ.setdefault("UNIT_TEST", "true")
 
+from tpu_operator import consts
 from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
 from tpu_operator.kube.testing import simulate_kubelet_once
 from tpu_operator.main import build_manager, wire_event_sources
@@ -158,6 +159,120 @@ def test_leader_election_failover_over_the_wire(cluster):
     )
     stop_b.set()
     tb.join(timeout=5)
+
+
+def test_generation_fanout_and_gc_over_the_wire(cluster):
+    """Per-generation libtpu fan-out driven by cluster events, over the
+    wire: a v5p pool joins a v5e cluster -> one DS per generation with
+    per-generation image and nodeSelector (reference precompiled-driver
+    fan-out, ``controllers/object_controls.go:3405-3441``); the pool
+    leaving GCs the stale DS (``:3587-3744``) — all through watches on a
+    live apiserver, with the kubelet honoring the per-generation
+    selectors."""
+    from tests.conftest import running_operator, wait_until
+    from tpu_operator.kube.testing import make_tpu_node
+
+    server, client = cluster
+    nodes = ["tpu-node-1"]  # seeded v5e node; mutated as pools come and go
+
+    def ds_names():
+        return {
+            d["metadata"]["name"]
+            for d in client.list("apps/v1", "DaemonSet", NS)
+        }
+
+    with running_operator(client, NS, nodes):
+        assert wait_until(
+            lambda: (
+                client.get_or_none(CPV, "ClusterPolicy", "cluster-policy") or {}
+            )
+            .get("status", {})
+            .get("state")
+            == "ready",
+            90,
+        )
+
+        # a v5p node pool joins; per-generation images are configured
+        client.create(
+            make_tpu_node(
+                "tpu-node-2", accelerator="tpu-v5p-slice", topology="2x2x2"
+            )
+        )
+        nodes.append("tpu-node-2")
+        cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
+        cp["spec"]["libtpu"]["generationConfigs"] = {
+            "v5e": "2025.1.0-v5e",
+            "v5p": "2025.1.0-v5p",
+        }
+        client.update(cp)
+
+        assert wait_until(
+            lambda: {
+                "tpu-libtpu-daemonset-v5e",
+                "tpu-libtpu-daemonset-v5p",
+            }
+            <= ds_names()
+            and "tpu-libtpu-daemonset" not in ds_names(),
+            60,
+        ), ds_names()
+
+        for gen in ("v5e", "v5p"):
+            ds = client.get(
+                "apps/v1", "DaemonSet", f"tpu-libtpu-daemonset-{gen}", NS
+            )
+            img = [
+                c
+                for c in ds["spec"]["template"]["spec"]["containers"]
+                if c["name"] == "libtpu-ctr"
+            ][0]["image"]
+            assert img.endswith(f":2025.1.0-{gen}"), img
+            sel = ds["spec"]["template"]["spec"]["nodeSelector"]
+            assert sel[f"{consts.GROUP}/tpu.generation"] == gen
+
+        # with the kubelet honoring per-generation selectors the cluster
+        # re-converges: one operand pod per generation on its own node
+        # (waited, not asserted immediately — the status can read "ready"
+        # from before the fan-out while the kubelet is still scheduling)
+        def gen_pods_placed():
+            for gen, node in (("v5e", "tpu-node-1"), ("v5p", "tpu-node-2")):
+                pods = client.list(
+                    "v1",
+                    "Pod",
+                    NS,
+                    label_selector={"app": f"tpu-libtpu-daemonset-{gen}"},
+                )
+                if [p["spec"]["nodeName"] for p in pods] != [node]:
+                    return False
+            return True
+
+        assert wait_until(gen_pods_placed, 60)
+        assert wait_until(
+            lambda: (
+                client.get(CPV, "ClusterPolicy", "cluster-policy")
+                .get("status", {})
+                .get("state")
+                == "ready"
+            ),
+            90,
+        )
+
+        # the v5p pool is deleted: its generation DS must be GC'd
+        nodes.remove("tpu-node-2")
+        client.delete("v1", "Node", "tpu-node-2")
+        assert wait_until(
+            lambda: "tpu-libtpu-daemonset-v5p" not in ds_names()
+            and "tpu-libtpu-daemonset-v5e" in ds_names(),
+            60,
+        ), ds_names()
+        assert wait_until(
+            lambda: (
+                client.get(CPV, "ClusterPolicy", "cluster-policy")
+                .get("status", {})
+                .get("state")
+                == "ready"
+            ),
+            90,
+        )
 
 
 def test_kubesim_dev_mode_once_converges():

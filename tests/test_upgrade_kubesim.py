@@ -11,13 +11,12 @@ uncordon -> done, throttled to one node in flight by
 import os
 import threading
 import time
-from contextlib import contextmanager
-
 import pytest
 
 os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
 os.environ.setdefault("UNIT_TEST", "true")
 
+from tests.conftest import running_operator as _running_operator, wait_until
 from tpu_operator import consts
 from tpu_operator.kube.client import ConflictError, NotFoundError
 from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
@@ -29,15 +28,6 @@ from tpu_operator.upgrade import upgrade_state as us
 NS = "tpu-operator"
 CPV = "tpu.k8s.io/v1"
 NODES = ("up-node-1", "up-node-2", "up-node-3")
-
-
-def wait_until(pred, timeout_s=60.0, poll_s=0.1):
-    deadline = time.monotonic() + timeout_s
-    while time.monotonic() < deadline:
-        if pred():
-            return True
-        time.sleep(poll_s)
-    return False
 
 
 @pytest.fixture()
@@ -59,43 +49,8 @@ def upgrade_label(node):
     return (node["metadata"].get("labels") or {}).get(consts.UPGRADE_STATE_LABEL)
 
 
-@contextmanager
 def running_operator(client, extra_threads=()):
-    """The shared e2e scaffolding: the full Manager wired exactly as
-    main() ships it, plus a faithful-OnDelete kubelet per node and an
-    upgrade-reconciler pump (production re-queues every 120 s,
-    ``upgrade_controller.REQUEUE_S``; same level-triggered loop at test
-    cadence). ``extra_threads`` are ``fn(halt)`` loops joined to the same
-    halt event so both tests stop identically."""
-    mgr, _, _ = build_manager(client, NS, metrics_port=0, probe_port=0)
-    stop = threading.Event()
-    wire_event_sources(mgr, client, NS, stop_event=stop)
-    mgr.start()
-    halt = threading.Event()
-
-    def kubelet():
-        while not halt.is_set():
-            try:
-                simulate_kubelet_nodes(client, NS, NODES)
-            except (ConflictError, NotFoundError, TransientAPIError, OSError):
-                pass  # races with the reconciler/FSM; retried next pass
-            time.sleep(0.15)
-
-    def pump():
-        while not halt.is_set():
-            mgr.enqueue(UPGRADE_KEY)
-            time.sleep(0.25)
-
-    for fn in (kubelet, pump):
-        threading.Thread(target=fn, daemon=True).start()
-    for fn in extra_threads:
-        threading.Thread(target=fn, args=(halt,), daemon=True).start()
-    try:
-        yield mgr
-    finally:
-        halt.set()
-        stop.set()
-        mgr.stop()
+    return _running_operator(client, NS, NODES, extra_threads=extra_threads)
 
 
 def test_rolling_upgrade_three_nodes_over_the_wire(cluster):

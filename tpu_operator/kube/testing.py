@@ -157,13 +157,30 @@ def simulate_kubelet_nodes(client: Client, namespace: str, node_names) -> None:
     pods too, so single-node dev mode converges without the upgrade FSM;
     this variant is the one upgrade e2e tests must use, otherwise the
     kubelet would upgrade the driver behind the FSM's back and the rolling
-    upgrade would be untestable."""
+    upgrade would be untestable.
+
+    Scheduling honors each DaemonSet template's ``nodeSelector`` the way
+    the real DS controller does — a per-generation libtpu DS only gets
+    pods (and desired-counts) on nodes of its generation."""
     node_names = list(node_names)
+    node_labels = {
+        n["metadata"]["name"]: n["metadata"].get("labels", {}) or {}
+        for n in client.list("v1", "Node")
+    }
     for ds in client.list("apps/v1", "DaemonSet", namespace):
-        _stamp_ds_status(client, ds, len(node_names))
+        selector = (
+            ds["spec"]["template"]["spec"].get("nodeSelector", {}) or {}
+        )
+        matching = [
+            name
+            for name in node_names
+            if name in node_labels
+            and all(node_labels[name].get(k) == v for k, v in selector.items())
+        ]
+        _stamp_ds_status(client, ds, len(matching))
         on_delete = ds["spec"].get("updateStrategy", {}).get("type") == "OnDelete"
         app, h = _ds_app_and_hash(ds)
-        for node in node_names:
+        for node in matching:
             _ensure_operand_pod(
                 client,
                 namespace,

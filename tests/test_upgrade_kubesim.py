@@ -285,6 +285,77 @@ def test_upgrade_drain_timeout_failure_recovery_and_cleanup(cluster):
         ), {n: upgrade_label(client.get("v1", "Node", n)) for n in NODES}
 
 
+def test_rolling_upgrade_fleet_scale():
+    """Scale proof: a 25-node pool converges and rolls libtpu with
+    maxUnavailable=25% — the sampler must never observe more than
+    floor(25*0.25)=6 nodes in flight, and every node must finish. This is
+    the multi-node posture the reference only reaches on a real cluster;
+    kubesim makes it a unit-speed wire test."""
+    fleet = tuple(f"fleet-node-{i}" for i in range(25))
+    server = KubeSimServer(KubeSim(bookmark_interval_s=1.0)).start()
+    client = make_client(server.port)
+    client.GET_RETRY_BACKOFF_S = 0.05
+    seed_cluster(client, NS, node_names=fleet)
+    try:
+        max_active = [0]
+
+        def sampler(halt):
+            while not halt.is_set():
+                try:
+                    nodes = client.list("v1", "Node")
+                    active = sum(
+                        1
+                        for n in nodes
+                        if upgrade_label(n) in us.ACTIVE_STATES
+                    )
+                    max_active[0] = max(max_active[0], active)
+                except (TransientAPIError, OSError):
+                    pass
+                time.sleep(0.05)
+
+        with _running_operator(client, NS, fleet, extra_threads=(sampler,)):
+            assert wait_until(lambda: cr_state(client) == "ready", 180), (
+                "25-node pool never converged"
+            )
+
+            cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
+            cp["spec"]["libtpu"]["upgradePolicy"] = {
+                "autoUpgrade": True,
+                "maxParallelUpgrades": 6,
+                "maxUnavailable": "25%",
+            }
+            cp["spec"]["libtpu"]["version"] = "2025.5.0"
+            client.update(cp)
+
+            def all_done():
+                return all(
+                    upgrade_label(n) == us.STATE_DONE
+                    for n in client.list("v1", "Node")
+                )
+
+            assert wait_until(all_done, 240), sorted(
+                (
+                    n["metadata"]["name"],
+                    upgrade_label(n),
+                )
+                for n in client.list("v1", "Node")
+                if upgrade_label(n) != us.STATE_DONE
+            )
+            assert 1 <= max_active[0] <= 6, (
+                f"throttle violated at scale: {max_active[0]} in flight "
+                "(budget 6)"
+            )
+            for n in client.list("v1", "Node"):
+                assert not n.get("spec", {}).get("unschedulable", False), (
+                    f"{n['metadata']['name']} left cordoned"
+                )
+            assert wait_until(lambda: cr_state(client) == "ready", 120), (
+                "fleet not Ready after the rolling upgrade"
+            )
+    finally:
+        server.stop()
+
+
 def test_operator_restart_mid_upgrade_resumes_fsm(cluster):
     """Stateless-by-reconstruction over the wire: kill the operator while
     the rolling upgrade is mid-flight (node 1 in an active FSM state,

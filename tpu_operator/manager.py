@@ -33,10 +33,17 @@ class RateLimiter:
         self._failures = {}
         self._lock = threading.Lock()
 
+    # failure counts cap here: past this the delay is pinned at ``cap``
+    # anyway, and an unbounded count would overflow ``2**n`` float
+    # conversion past ~1024 failures (~51 min of persistent failure at the
+    # 3 s cap), raising OverflowError inside the worker's failure path and
+    # killing the only worker thread
+    MAX_EXPONENT = 16
+
     def when(self, item) -> float:
         with self._lock:
             n = self._failures.get(item, 0)
-            self._failures[item] = n + 1
+            self._failures[item] = min(n + 1, self.MAX_EXPONENT)
             return min(self.base * (2**n), self.cap)
 
     def forget(self, item) -> None:
@@ -344,20 +351,39 @@ class Manager:
         """MaxConcurrentReconciles=1 — one worker serializes everything
         (reference ``controllers/clusterpolicy_controller.go:319``)."""
         while not self._stop.is_set():
-            item = self.queue.get(timeout=0.5)
-            if item is None:
-                continue
-            fn = self._reconcilers.get(item)
-            if fn is None:
-                continue
+            item = None
             try:
-                result = fn(item)
-                self.rate_limiter.forget(item)
-                requeue = getattr(result, "requeue_after", None)
-                if requeue:
-                    self.queue.add(item, requeue)
-                self._last_reconcile_ok = True
+                item = self.queue.get(timeout=0.5)
+                if item is None:
+                    continue
+                fn = self._reconcilers.get(item)
+                if fn is None:
+                    continue
+                try:
+                    result = fn(item)
+                    self.rate_limiter.forget(item)
+                    requeue = getattr(result, "requeue_after", None)
+                    if requeue:
+                        self.queue.add(item, requeue)
+                    self._last_reconcile_ok = True
+                except Exception:
+                    log.exception("reconcile %s failed", item)
+                    self._last_reconcile_ok = False
+                    self.queue.add(item, self.rate_limiter.when(item))
             except Exception:
-                log.exception("reconcile %s failed", item)
+                # a bug in the queue/limiter machinery must never silently
+                # kill the ONLY worker while probes keep reporting healthy
+                # (controller-runtime's panic would crash the whole process
+                # and restart the pod; a dead daemon thread here would just
+                # stop all reconciling forever)
+                log.exception("worker loop error; continuing")
                 self._last_reconcile_ok = False
-                self.queue.add(item, self.rate_limiter.when(item))
+                if item is not None:
+                    # keep level-triggered retry semantics: without this,
+                    # the in-flight key is lost until an external event
+                    # re-enqueues it
+                    try:
+                        self.queue.add(item, 1.0)
+                    except Exception:
+                        log.exception("failed to requeue %s", item)
+                self._stop.wait(1)

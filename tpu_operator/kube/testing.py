@@ -167,6 +167,18 @@ def simulate_kubelet_nodes(client: Client, namespace: str, node_names) -> None:
         n["metadata"]["name"]: n["metadata"].get("labels", {}) or {}
         for n in client.list("v1", "Node")
     }
+    # DS-controller role first: delete operand pods bound to nodes that no
+    # longer exist. A pod created in a race with its node's deletion
+    # misses the apiserver's at-deletion cascade and would pin OnDelete
+    # readiness NotReady forever; on a real cluster the DaemonSet
+    # controller (and PodGC) clean exactly these.
+    for pod in client.list("v1", "Pod", namespace):
+        bound = pod.get("spec", {}).get("nodeName")
+        app = (pod["metadata"].get("labels") or {}).get("app")
+        if app and bound and bound not in node_labels:
+            client.delete_if_exists(
+                "v1", "Pod", pod["metadata"]["name"], namespace
+            )
     for ds in client.list("apps/v1", "DaemonSet", namespace):
         selector = (
             ds["spec"]["template"]["spec"].get("nodeSelector", {}) or {}

@@ -94,3 +94,67 @@ def test_libtpu_revalidation_open_probes_devices(tmp_path):
     assert wait_for(1)
     nm._stop.set()
     t.join(timeout=5)
+
+
+def test_libtpu_revalidation_survives_probe_exceptions(tmp_path, monkeypatch):
+    """An unexpected probe exception must read as UNHEALTHY (gauge 0) and
+    keep the watcher thread alive — a dead thread would freeze the gauge
+    at its last healthy value forever, the exact silent-wedge the live
+    re-validation exists to catch."""
+    import threading
+    import time
+
+    from prometheus_client import CollectorRegistry
+
+    from tpu_operator.native import tpuinfo
+
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    (dev / "accel0").touch()
+    lib = tmp_path / "libtpu"
+    lib.mkdir()
+    (lib / "libtpu.so").touch()
+
+    reg = CollectorRegistry()
+    nm = NodeMetrics(
+        node_name="n1",
+        status=StatusFiles(str(tmp_path)),
+        registry=reg,
+        install_dir=str(lib),
+        dev_root=str(dev),
+    )
+    nm.WATCH_LIBTPU_S = 0.02
+
+    broken = {"on": False}
+    real_probe = tpuinfo.device_probe_path
+
+    def flaky_probe(path):
+        if broken["on"]:
+            raise RuntimeError("native library wedged")
+        return real_probe(path)
+
+    monkeypatch.setattr(tpuinfo, "device_probe_path", flaky_probe)
+    t = threading.Thread(target=nm._watch_libtpu, daemon=True)
+    t.start()
+
+    def wait_for(value, timeout=3):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if (
+                reg.get_sample_value(
+                    "tpu_validator_libtpu_validation", {"node": "n1"}
+                )
+                == value
+            ):
+                return True
+            time.sleep(0.02)
+        return False
+
+    assert wait_for(1)
+    broken["on"] = True  # probe machinery now raises
+    assert wait_for(0), "probe exception did not read as unhealthy"
+    assert t.is_alive(), "watcher thread died on the probe exception"
+    broken["on"] = False  # machinery recovers -> healthy again
+    assert wait_for(1), "watcher never recovered after the exception"
+    nm._stop.set()
+    t.join(timeout=5)

@@ -119,14 +119,23 @@ class NodeMetrics:
         from tpu_operator.native import tpuinfo
 
         while not self._stop.is_set():
-            devs = find_tpu_devices(self.dev_root)
-            # device_probe_path itself stats (never opens) /dev/vfio/*
-            # groups — one open file per group is a kernel invariant
-            ok = (
-                bool(devs)
-                and all(tpuinfo.device_probe_path(p) for p in devs)
-                and bool(glob.glob(os.path.join(self.install_dir, "libtpu*.so")))
-            )
+            try:
+                devs = find_tpu_devices(self.dev_root)
+                # device_probe_path itself stats (never opens) /dev/vfio/*
+                # groups — one open file per group is a kernel invariant
+                ok = (
+                    bool(devs)
+                    and all(tpuinfo.device_probe_path(p) for p in devs)
+                    and bool(
+                        glob.glob(os.path.join(self.install_dir, "libtpu*.so"))
+                    )
+                )
+            except Exception:
+                # an unexpected probe failure must read as UNHEALTHY and
+                # keep the watcher alive — a dead thread would freeze the
+                # gauge at its last (possibly healthy) value forever
+                log.exception("libtpu re-validation pass failed")
+                ok = False
             self.g_libtpu_valid.labels(node=self.node_name).set(1 if ok else 0)
             self._stop.wait(self.WATCH_LIBTPU_S)
 
@@ -144,9 +153,12 @@ class NodeMetrics:
 
     def _watch_devices(self):
         while not self._stop.is_set():
-            self.g_devices.labels(node=self.node_name).set(
-                len(find_tpu_devices(self.dev_root))
-            )
+            try:
+                count = len(find_tpu_devices(self.dev_root))
+            except Exception:
+                log.exception("device count pass failed")
+                count = 0  # fail towards unhealthy, keep the watcher alive
+            self.g_devices.labels(node=self.node_name).set(count)
             self._stop.wait(self.WATCH_PCI_S)
 
     # ------------------------------------------------------------------

@@ -1,0 +1,145 @@
+"""Direct unit tests for the OpenAPI v3 structural-schema validator —
+the admission half kubesim and ``tpuop-cfg validate`` share. Until now
+it was only exercised transitively through CRD admission tests; these
+pin each rule's semantics (apiserver parity: unanchored patterns,
+bool-is-not-int, int-or-string rejecting floats, typed maps,
+preserve-unknown-fields) so a regression shows up here first, not as a
+mysteriously-admitted malformed CR."""
+
+from tpu_operator.cfg.schema_validate import crd_schema, validate, validate_cr
+
+
+def ok(schema, obj):
+    assert validate(schema, obj) == []
+
+
+def bad(schema, obj, fragment):
+    problems = validate(schema, obj)
+    assert problems, f"expected rejection of {obj!r}"
+    assert any(fragment in p for p in problems), (fragment, problems)
+
+
+def test_scalar_types():
+    ok({"type": "string"}, "x")
+    bad({"type": "string"}, 3, "expected string")
+    ok({"type": "integer"}, 3)
+    bad({"type": "integer"}, 3.5, "expected integer")
+    ok({"type": "number"}, 3.5)
+    ok({"type": "number"}, 3)
+    ok({"type": "boolean"}, True)
+    bad({"type": "boolean"}, "true", "expected boolean")
+
+
+def test_bool_is_not_an_integer():
+    """Python bool subclasses int; apiserver type checking does not."""
+    bad({"type": "integer"}, True, "expected integer")
+    bad({"type": "number"}, False, "expected number")
+    bad({"x-kubernetes-int-or-string": True}, True, "int-or-string")
+
+
+def test_int_or_string():
+    s = {"x-kubernetes-int-or-string": True, "pattern": r"^\d+%?$"}
+    ok(s, 3)
+    ok(s, "25%")
+    bad(s, "abc", "does not match")
+    bad(s, 3.5, "int-or-string")  # floats rejected, apiserver semantics
+    ok({"x-kubernetes-int-or-string": True}, "anything")  # no pattern arm
+
+
+def test_pattern_is_unanchored_like_the_apiserver():
+    # k8s applies `pattern` with search semantics; generated patterns
+    # anchor themselves
+    ok({"type": "string", "pattern": "b+"}, "abc")
+    bad({"type": "string", "pattern": "^b+$"}, "abc", "does not match")
+
+
+def test_enum_and_bounds():
+    ok({"type": "string", "enum": ["OnDelete", "RollingUpdate"]}, "OnDelete")
+    bad({"type": "string", "enum": ["OnDelete", "RollingUpdate"]}, "Never", "not in")
+    ok({"type": "integer", "minimum": 1, "maximum": 65535}, 8080)
+    bad({"type": "integer", "minimum": 1}, 0, "below minimum")
+    bad({"type": "integer", "maximum": 65535}, 70000, "above maximum")
+
+
+def test_object_unknown_fields_and_required():
+    s = {
+        "type": "object",
+        "properties": {"name": {"type": "string"}},
+        "required": ["name"],
+    }
+    ok(s, {"name": "x"})
+    bad(s, {"name": "x", "nmae": "typo"}, "unknown field")
+    bad(s, {}, "missing required")
+    # preserve-unknown-fields suppresses the unknown-field check
+    s_preserve = dict(s, **{"x-kubernetes-preserve-unknown-fields": True})
+    del s_preserve["required"]
+    ok(s_preserve, {"anything": 1})
+
+
+def test_typed_map_additional_properties():
+    s = {"type": "object", "additionalProperties": {"type": "string"}}
+    ok(s, {"a": "x", "b": "y"})
+    bad(s, {"a": 1}, "expected string")
+
+
+def test_array_items_with_paths():
+    s = {
+        "type": "array",
+        "items": {
+            "type": "object",
+            "properties": {"key": {"type": "string"}},
+        },
+    }
+    ok(s, [{"key": "a"}, {"key": "b"}])
+    problems = validate(s, [{"key": "a"}, {"key": 2}], path="spec.tolerations")
+    assert problems and "spec.tolerations[1].key" in problems[0], problems
+
+
+def test_nested_path_reporting():
+    s = {
+        "type": "object",
+        "properties": {
+            "libtpu": {
+                "type": "object",
+                "properties": {"version": {"type": "string"}},
+            }
+        },
+    }
+    problems = validate(s, {"libtpu": {"version": 1}})
+    assert problems[0].startswith("libtpu.version:"), problems
+
+
+def test_generated_crd_round_trip():
+    """The real generated CRD admits the sample CR and rejects a typo'd
+    field, a bad enum, and a bad int-or-string — the exact checks VERDICT
+    r1 asked the hardened schema to enforce."""
+    import yaml
+
+    from tpu_operator.cfg.crdgen import build_crd
+    from tpu_operator.kube.testing import sample_clusterpolicy_path
+
+    crd = build_crd()
+    with open(sample_clusterpolicy_path()) as f:
+        cr = yaml.safe_load(f)
+    assert validate_cr(crd, cr) == []
+
+    import copy
+
+    typo = copy.deepcopy(cr)
+    typo["spec"]["devicePlugin"]["verison"] = "oops"
+    assert any("verison" in p for p in validate_cr(crd, typo))
+
+    bad_enum = copy.deepcopy(cr)
+    bad_enum["spec"]["operator"]["defaultRuntime"] = "rkt"
+    assert any("rkt" in p for p in validate_cr(crd, bad_enum))
+
+    bad_pct = copy.deepcopy(cr)
+    bad_pct["spec"]["libtpu"]["upgradePolicy"] = {"maxUnavailable": "lots"}
+    assert any("lots" in p for p in validate_cr(crd, bad_pct))
+
+
+def test_crd_schema_missing_version():
+    import pytest
+
+    with pytest.raises(KeyError):
+        crd_schema({"spec": {"versions": [{"name": "v2"}]}})

@@ -42,6 +42,11 @@ OPERANDS = {
         False,
     ),
     "tfd": ("tpu-feature-discovery", consts.COMPONENT_TFD, False),
+    "maintenanceHandler": (
+        "tpu-maintenance-handler",
+        consts.COMPONENT_MAINTENANCE_HANDLER,
+        False,
+    ),
     "sliceManager": ("tpu-slice-manager", consts.COMPONENT_SLICE_MANAGER, False),
     "vfioManager": (
         "tpu-vfio-manager-daemonset",
@@ -119,6 +124,7 @@ def test_daemonset_common(spec_key, monkeypatch):
             "env": [{"name": "EXTRA_ENV", "value": "extra-value"}],
         }
     )
+    sub["enabled"] = True  # opt-in operands (maintenanceHandler) need it
     if sandbox:
         cr["spec"]["sandboxWorkloads"]["enabled"] = True
 

@@ -1,0 +1,277 @@
+"""TPU host-maintenance handler (TPU-specific operand; no reference
+analogue): metadata-driven cordon/label/evict ahead of a maintenance
+window, restore on all-clear, the upgrade FSM's initial-state pattern
+for pre-cordoned nodes, and crash recovery from the node label alone."""
+
+import os
+
+import pytest
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+from tests.conftest import make_tpu_node
+from tpu_operator import consts
+from tpu_operator.kube import FakeClient
+from tpu_operator.operands.maintenance import (
+    EVENT_NONE,
+    STATE_PENDING,
+    MaintenanceHandler,
+    read_maintenance_event,
+)
+
+NS = "tpu-operator"
+NODE = "m-node-1"
+
+
+def tpu_pod(name, owned=True, tpu=True):
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "nodeName": NODE,
+            "containers": [
+                {
+                    "name": "c",
+                    "resources": (
+                        {"limits": {consts.TPU_RESOURCE: "4"}} if tpu else {}
+                    ),
+                }
+            ],
+        },
+        "status": {"phase": "Running"},
+    }
+    if owned:
+        pod["metadata"]["ownerReferences"] = [
+            {"apiVersion": "batch/v1", "kind": "Job", "name": "j", "uid": "u1"}
+        ]
+    return pod
+
+
+@pytest.fixture()
+def env(monkeypatch):
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+    client = FakeClient(
+        [
+            {
+                "apiVersion": "v1",
+                "kind": "Namespace",
+                "metadata": {"name": NS},
+            },
+            make_tpu_node(NODE),
+        ]
+    )
+    client.create(tpu_pod("train-owned"))
+    client.create(tpu_pod("train-adhoc", owned=False))
+    client.create(tpu_pod("sidecar", tpu=False))
+
+    feed = {"event": EVENT_NONE}
+    handler = MaintenanceHandler(
+        client, NODE, reader=lambda url: feed["event"]
+    )
+    return client, handler, feed
+
+
+def node(client):
+    return client.get("v1", "Node", NODE)
+
+
+def test_window_cordons_labels_and_evicts(env):
+    client, handler, feed = env
+    feed["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+    handler.reconcile_once()
+
+    n = node(client)
+    assert n["spec"]["unschedulable"] is True
+    assert n["metadata"]["labels"][consts.MAINTENANCE_STATE_LABEL] == STATE_PENDING
+    assert (
+        n["metadata"]["annotations"][consts.MAINTENANCE_INITIAL_STATE_ANNOTATION]
+        == "false"
+    )
+    # owned TPU pod evicted; unmanaged skipped (non-force drain
+    # semantics); non-TPU pod untouched
+    assert client.get_or_none("v1", "Pod", "train-owned", "default") is None
+    assert client.get_or_none("v1", "Pod", "train-adhoc", "default") is not None
+    assert client.get_or_none("v1", "Pod", "sidecar", "default") is not None
+    # Warning Event names the window
+    events = client.list("v1", "Event", NS)
+    assert any(e.get("reason") == "HostMaintenanceImminent" for e in events)
+
+
+def test_all_clear_restores(env):
+    client, handler, feed = env
+    feed["event"] = "MIGRATE_ON_HOST_MAINTENANCE"
+    handler.reconcile_once()
+    feed["event"] = EVENT_NONE
+    handler.reconcile_once()
+
+    n = node(client)
+    assert not n["spec"].get("unschedulable", False)
+    assert consts.MAINTENANCE_STATE_LABEL not in n["metadata"]["labels"]
+    assert (
+        consts.MAINTENANCE_INITIAL_STATE_ANNOTATION
+        not in n["metadata"]["annotations"]
+    )
+    events = client.list("v1", "Event", NS)
+    assert any(e.get("reason") == "HostMaintenanceCleared" for e in events)
+
+
+def test_precordoned_node_stays_cordoned(env):
+    client, handler, feed = env
+    n = node(client)
+    n.setdefault("spec", {})["unschedulable"] = True  # admin cordoned it
+    client.update(n)
+
+    feed["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+    handler.reconcile_once()
+    feed["event"] = EVENT_NONE
+    handler.reconcile_once()
+
+    n = node(client)
+    assert n["spec"]["unschedulable"] is True, (
+        "all-clear must restore the state the node was found in"
+    )
+    assert consts.MAINTENANCE_STATE_LABEL not in n["metadata"]["labels"]
+
+
+def test_crash_recovery_from_label(env):
+    """A handler restart during a window loses in-memory state; a fresh
+    process must clean up from the node label alone once the window
+    clears."""
+    client, handler, feed = env
+    feed["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+    handler.reconcile_once()
+
+    fresh = MaintenanceHandler(client, NODE, reader=lambda url: EVENT_NONE)
+    fresh.reconcile_once()
+    n = node(client)
+    assert not n["spec"].get("unschedulable", False)
+    assert consts.MAINTENANCE_STATE_LABEL not in n["metadata"]["labels"]
+
+
+def test_restart_mid_window_reenters_idempotently(env):
+    """A fresh handler that starts while the window is still open re-runs
+    entry idempotently: the cordon/label no-op, the eviction sweep clears
+    stragglers a crashed predecessor left (the label proves the cordon
+    happened, NOT that eviction completed), the pre-cordon annotation is
+    preserved, and the Warning Event dedups instead of duplicating."""
+    client, handler, feed = env
+    feed["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+    handler.reconcile_once()
+
+    client.create(tpu_pod("train-straggler"))
+    fresh = MaintenanceHandler(
+        client, NODE, reader=lambda url: "TERMINATE_ON_HOST_MAINTENANCE"
+    )
+    fresh.reconcile_once()
+    # the straggler is evicted on re-entry
+    assert client.get_or_none("v1", "Pod", "train-straggler", "default") is None
+    n = node(client)
+    # initial-state annotation survives re-entry (restore still works)
+    assert (
+        n["metadata"]["annotations"][consts.MAINTENANCE_INITIAL_STATE_ANNOTATION]
+        == "false"
+    )
+    # deduped: one Event object, count bumped
+    events = [
+        e
+        for e in client.list("v1", "Event", NS)
+        if e.get("reason") == "HostMaintenanceImminent"
+    ]
+    assert len(events) == 1
+    assert int(events[0].get("count", 1)) >= 2
+
+    # and the all-clear still restores through the fresh process
+    fresh2 = MaintenanceHandler(client, NODE, reader=lambda url: EVENT_NONE)
+    fresh2.reconcile_once()
+    n = node(client)
+    assert not n["spec"].get("unschedulable", False)
+
+
+def test_metadata_outage_holds_state(env):
+    """EVENT_UNKNOWN (metadata unreachable) is neither an all-clear nor a
+    window: mid-window it must NOT uncordon the doomed node, and in
+    steady state it must not evict anything."""
+    client, handler, feed = env
+    feed["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+    handler.reconcile_once()
+
+    feed["event"] = None  # metadata server dies mid-window
+    handler.reconcile_once()
+    n = node(client)
+    assert n["spec"]["unschedulable"] is True, (
+        "a metadata outage mid-window must not read as an all-clear"
+    )
+    assert n["metadata"]["labels"][consts.MAINTENANCE_STATE_LABEL] == STATE_PENDING
+
+    feed["event"] = EVENT_NONE  # real all-clear arrives
+    handler.reconcile_once()
+    assert not node(client)["spec"].get("unschedulable", False)
+
+
+def test_no_evict_mode(env):
+    client, handler, feed = env
+    handler.evict = False
+    feed["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+    handler.reconcile_once()
+    n = node(client)
+    assert n["spec"]["unschedulable"] is True
+    assert client.get_or_none("v1", "Pod", "train-owned", "default") is not None
+
+
+def test_force_evicts_unmanaged(env):
+    client, handler, feed = env
+    handler.force = True
+    feed["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+    handler.reconcile_once()
+    assert client.get_or_none("v1", "Pod", "train-adhoc", "default") is None
+
+
+def test_metadata_unreachable_reads_unknown():
+    """A dead metadata server reads as UNKNOWN — never as a maintenance
+    signal, never as an all-clear."""
+    assert (
+        read_maintenance_event("http://127.0.0.1:1/nope", timeout_s=0.2)
+        is None
+    )
+
+
+def test_state_gating(monkeypatch):
+    """Disabled (the default) deploys nothing; enabling deploys the DS
+    with the deploy label driving its nodeSelector."""
+    import yaml
+
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        ClusterPolicyReconciler,
+    )
+    from tpu_operator.kube.testing import sample_clusterpolicy_path
+
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+    with open(sample_clusterpolicy_path()) as f:
+        cr = yaml.safe_load(f)
+    cr["metadata"]["uid"] = "uid-cp"
+    client = FakeClient(
+        [{"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}]
+    )
+    client.create(cr)
+    client.create(make_tpu_node(NODE))
+    rec = ClusterPolicyReconciler(client)
+    rec.reconcile()
+    names = {d["metadata"]["name"] for d in client.list("apps/v1", "DaemonSet", NS)}
+    assert "tpu-maintenance-handler" not in names  # opt-in default off
+
+    cp = client.get("tpu.k8s.io/v1", "ClusterPolicy", "cluster-policy")
+    cp["spec"]["maintenanceHandler"]["enabled"] = True
+    client.update(cp)
+    rec.reconcile()
+    names = {d["metadata"]["name"] for d in client.list("apps/v1", "DaemonSet", NS)}
+    assert "tpu-maintenance-handler" in names
+    # the deploy-label bus drives scheduling
+    n = client.get("v1", "Node", NODE)
+    assert (
+        n["metadata"]["labels"].get(
+            consts.DEPLOY_LABEL_PREFIX + consts.COMPONENT_MAINTENANCE_HANDLER
+        )
+        == "true"
+    )

@@ -151,9 +151,9 @@ def test_non_gke_nfd_detection(monkeypatch):
     assert consts.TPU_PRESENT_LABEL not in cpu["metadata"]["labels"]
 
 
-def test_all_17_states_load(ctrl):
+def test_all_18_states_load(ctrl):
     assert ctrl.state_names == STATE_ORDER
-    assert len(ctrl.state_names) == 17
+    assert len(ctrl.state_names) == 18  # 17 reference states + maintenance-handler
 
 
 def test_full_step_through_all_states(ctrl):

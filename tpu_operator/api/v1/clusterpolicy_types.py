@@ -473,6 +473,38 @@ class TFDSpec(_ImageSpec):
 
 
 @dataclass
+class MaintenanceHandlerSpec(_ImageSpec):
+    """Host-maintenance watcher (TPU-specific; no reference analogue).
+
+    Cloud TPU hosts announce maintenance through the GCE metadata server;
+    this operand cordons, labels, and evicts TPU workloads ahead of the
+    window (``tpu_operator/operands/maintenance.py``). Opt-in: absent or
+    ``enabled: false`` deploys nothing."""
+
+    enabled: Optional[bool] = None
+    repository: str = ""
+    image: str = "tpu-operator"
+    version: str = ""
+    image_pull_policy: Optional[str] = None
+    image_pull_secrets: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    resources: Optional[ResourceRequirements] = None
+    # metadata poll cadence; GCE gives >= 60 s of notice
+    poll_interval_seconds: int = 10
+    # also delete unmanaged (ownerless) TPU pods when a window opens
+    force_evict: Optional[bool] = None
+    # cordon/label only; leave workloads to ride out the window
+    evict_workloads: Optional[bool] = None
+
+    ENV_VAR = "TPU_OPERATOR_IMAGE"
+
+    def is_enabled(self) -> bool:
+        # opt-in, unlike most operands: maintenance eviction is a policy
+        # decision (it kills running training pods on purpose)
+        return bool(self.enabled)
+
+
+@dataclass
 class SliceSpec(SpecBase):
     """Subslice exposure strategy — the reference's ``MIGSpec``.
 
@@ -714,6 +746,9 @@ class ClusterPolicySpec(SpecBase):
         default_factory=NodeStatusExporterSpec
     )
     tfd: TFDSpec = field(default_factory=TFDSpec)
+    maintenance_handler: MaintenanceHandlerSpec = field(
+        default_factory=MaintenanceHandlerSpec
+    )
     slice: SliceSpec = field(default_factory=SliceSpec)
     slice_manager: SliceManagerSpec = field(default_factory=SliceManagerSpec)
     validator: ValidatorSpec = field(default_factory=ValidatorSpec)

@@ -81,6 +81,7 @@ COMPONENT_VFIO_MANAGER = "vfio-manager"
 COMPONENT_SANDBOX_DEVICE_PLUGIN = "sandbox-device-plugin"
 COMPONENT_SANDBOX_VALIDATOR = "sandbox-validator"
 COMPONENT_KATA_MANAGER = "kata-manager"
+COMPONENT_MAINTENANCE_HANDLER = "maintenance-handler"
 
 # container-workload components (reference gpuStateLabels["container"],
 # controllers/state_manager.go:72-86)
@@ -94,6 +95,7 @@ CONTAINER_WORKLOAD_COMPONENTS = [
     COMPONENT_SLICE_MANAGER,
     COMPONENT_OPERATOR_VALIDATOR,
     COMPONENT_NODE_STATUS_EXPORTER,
+    COMPONENT_MAINTENANCE_HANDLER,
 ]
 # vm-passthrough components (reference gpuStateLabels["vm-passthrough"],
 # controllers/state_manager.go:87-95)
@@ -110,6 +112,13 @@ VM_WORKLOAD_COMPONENTS = [
 WORKLOAD_CONFIG_LABEL = f"{GROUP}/tpu.workload.config"
 WORKLOAD_CONTAINER = "container"
 WORKLOAD_VM_PASSTHROUGH = "vm-passthrough"
+
+# host-maintenance handling (TPU-specific; no reference analogue):
+# pending while a metadata-announced window is imminent/active
+MAINTENANCE_STATE_LABEL = f"{GROUP}/maintenance"
+# whether the node was already cordoned when the window began (the
+# upgrade FSM's initial-state pattern: the all-clear restores, not resets)
+MAINTENANCE_INITIAL_STATE_ANNOTATION = f"{GROUP}/maintenance-initial-unschedulable"
 
 # slice partitioning label FSM (reference nvidia.com/mig.config[.state])
 SLICE_CONFIG_LABEL = f"{GROUP}/tpu.slice.config"

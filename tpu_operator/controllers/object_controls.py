@@ -858,6 +858,30 @@ def transform_slice_manager(n, ds: Obj, generation: Optional[str] = None) -> Non
                 vol["configMap"]["name"] = spec.chip_clients_config.name
 
 
+@_register("tpu-maintenance-handler")
+def transform_maintenance_handler(
+    n, ds: Obj, generation: Optional[str] = None
+) -> None:
+    """TPU-specific host-maintenance watcher (no reference analogue;
+    ``tpu_operator/operands/maintenance.py``)."""
+    spec = n.cp.spec.maintenance_handler
+    main = _apply_operand_image(n, ds, spec, "tpu-maintenance-handler")
+    _merge_env(main, spec.env)
+    _apply_resources(main, spec)
+    if spec.poll_interval_seconds:
+        _set_container_env(
+            main, "POLL_INTERVAL_S", str(spec.poll_interval_seconds)
+        )
+    if spec.force_evict is not None:
+        _set_container_env(
+            main, "FORCE_EVICT", "true" if spec.force_evict else "false"
+        )
+    if spec.evict_workloads is not None:
+        _set_container_env(
+            main, "EVICT_WORKLOADS", "true" if spec.evict_workloads else "false"
+        )
+
+
 @_register("tpu-vm-manager-daemonset")
 def transform_vm_manager(n, ds: Obj, generation: Optional[str] = None) -> None:
     spec = n.cp.spec.vm_manager

@@ -40,8 +40,9 @@ DEFAULT_ASSETS_DIR = os.environ.get(
     "TPU_OPERATOR_ASSETS", "/opt/tpu-operator"
 )
 
-# Ordered list of the 17 states (reference addState calls,
-# controllers/state_manager.go:784-801). Sandbox states run only when
+# Ordered list of the reference's 17 states (addState calls,
+# controllers/state_manager.go:784-801) plus the TPU-specific
+# state-maintenance-handler. Sandbox states run only when
 # sandboxWorkloads.enabled.
 STATE_ORDER: List[str] = [
     "pre-requisites",
@@ -55,6 +56,7 @@ STATE_ORDER: List[str] = [
     "tpu-feature-discovery",
     "state-slice-manager",
     "state-node-status-exporter",
+    "state-maintenance-handler",
     "state-vm-manager",
     "state-vm-device-manager",
     "state-sandbox-validation",
@@ -337,6 +339,8 @@ class ClusterPolicyController:
             "tpu-feature-discovery": spec.tfd.is_enabled(),
             "state-slice-manager": spec.slice_manager.is_enabled(),
             "state-node-status-exporter": spec.node_status_exporter.is_enabled(),
+            # TPU-specific 18th state (no reference analogue): opt-in
+            "state-maintenance-handler": spec.maintenance_handler.is_enabled(),
             "state-vm-manager": spec.sandbox_enabled()
             and spec.vm_manager.is_enabled(),
             "state-vm-device-manager": spec.sandbox_enabled()

@@ -33,6 +33,40 @@ class ConflictError(RuntimeError):
     """resourceVersion conflict on update (HTTP 409 analogue)."""
 
 
+def mutate_with_retry(
+    client: "Client",
+    api_version: str,
+    kind: str,
+    name: str,
+    namespace: str = "",
+    *,
+    mutate: Callable[[Obj], bool],
+    attempts: int = 5,
+    backoff_s: float = 0.05,
+) -> Obj:
+    """Optimistic-concurrency read-mutate-update: re-GET and re-apply on a
+    409 — the discipline every writer of a SHARED object (Nodes carry
+    labels from the deploy-label bus, the upgrade FSM, TFD, the slice and
+    maintenance operands) must follow. ``mutate(obj) -> bool`` returns
+    whether anything changed; False short-circuits without a write.
+    Raises the last ConflictError when the race outlasts ``attempts``."""
+    import time
+
+    last: Optional[Exception] = None
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(backoff_s * attempt)
+        obj = client.get(api_version, kind, name, namespace)
+        if not mutate(obj):
+            return obj
+        try:
+            client.update(obj)
+            return obj
+        except ConflictError as e:
+            last = e
+    raise last  # type: ignore[misc]
+
+
 def obj_key(obj: Obj) -> Tuple[str, str, str, str]:
     meta = obj.get("metadata", {})
     return (

@@ -1,0 +1,283 @@
+"""``tpu-maintenance-handler`` — host-maintenance watcher (TPU-specific;
+no reference analogue).
+
+Cloud TPU hosts receive scheduled-maintenance notices through the GCE
+metadata server (``instance/maintenance-event``), and a TPU VM under
+maintenance loses its chips mid-step — the TPU-specific failure mode the
+reference's GPU stack never faces. This node agent closes the gap in the
+operator's failure-detection story (SURVEY §5): it polls the metadata
+endpoint and, when maintenance is imminent,
+
+* labels the node ``tpu.k8s.io/maintenance=pending`` (ops visibility +
+  a scheduling signal),
+* cordons the node, remembering whether it was already cordoned so the
+  all-clear restores the state the node was found in (the upgrade FSM's
+  initial-state pattern, ``upgrade_state.go:419-429``),
+* evicts TPU-consuming pods with kubectl-drain semantics (unmanaged
+  pods are skipped unless ``force`` — reusing the upgrade engine's
+  ``PodManager``), letting checkpoint-aware trainers resume elsewhere
+  instead of dying with the host,
+* records a Warning Event naming the maintenance window.
+
+When the metadata server reports ``NONE`` again the handler uncordons
+(unless the node was cordoned before), clears the label, and records a
+Normal Event. All node writes are conflict-retried: the Node object is
+shared with the deploy-label bus, the upgrade FSM, and TFD.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+import urllib.request
+from typing import Callable, Optional
+
+from tpu_operator import consts
+from tpu_operator.kube.client import Client, ConflictError
+
+log = logging.getLogger("tpu-maintenance-handler")
+
+# GCE metadata semantics: NONE, or MIGRATE_ON_HOST_MAINTENANCE /
+# TERMINATE_ON_HOST_MAINTENANCE while a window is imminent/active
+DEFAULT_METADATA_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/maintenance-event"
+)
+EVENT_NONE = "NONE"
+# metadata server unreachable: NOT an all-clear and NOT a window — a
+# transient outage mid-window must never uncordon a host that is still
+# about to lose its chips, and must never trigger an eviction either
+EVENT_UNKNOWN = None
+
+STATE_PENDING = "pending"
+
+
+def read_maintenance_event(url: str, timeout_s: float = 5.0) -> Optional[str]:
+    """One metadata poll. Unreachable/odd answers read as ``EVENT_UNKNOWN``
+    (no state transition): a dead metadata server is neither a maintenance
+    signal nor an all-clear."""
+    req = urllib.request.Request(url, headers={"Metadata-Flavor": "Google"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return (r.read().decode() or EVENT_NONE).strip() or EVENT_NONE
+    except Exception:
+        log.warning("metadata poll failed for %s", url)
+        return EVENT_UNKNOWN
+
+
+class MaintenanceHandler:
+    def __init__(
+        self,
+        client: Client,
+        node_name: str,
+        metadata_url: str = DEFAULT_METADATA_URL,
+        force: bool = False,
+        evict: bool = True,
+        reader: Optional[Callable[[str], str]] = None,
+    ):
+        self.client = client
+        self.node_name = node_name
+        self.metadata_url = metadata_url
+        self.force = force
+        self.evict = evict
+        self.reader = reader or read_maintenance_event
+        self._active = False
+
+    # -- conflict-safe node writes (shared-Node discipline) -------------
+    def _mutate_node(self, mutate) -> None:
+        from tpu_operator.kube.client import mutate_with_retry
+
+        mutate_with_retry(
+            self.client, "v1", "Node", self.node_name, mutate=mutate
+        )
+
+    def _event(self, etype: str, reason: str, message: str) -> None:
+        from tpu_operator.kube.events import record_event
+
+        node = self.client.get("v1", "Node", self.node_name)
+        record_event(
+            self.client,
+            os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "default"),
+            node,
+            etype,
+            reason,
+            message,
+        )
+
+    # -- transitions -----------------------------------------------------
+    def _enter_maintenance(self, event: str) -> None:
+        log.warning("maintenance imminent on %s: %s", self.node_name, event)
+
+        def mutate(node):
+            changed = False
+            meta = node["metadata"]
+            labels = meta.setdefault("labels", {})
+            ann = meta.setdefault("annotations", {})
+            if labels.get(consts.MAINTENANCE_STATE_LABEL) != STATE_PENDING:
+                labels[consts.MAINTENANCE_STATE_LABEL] = STATE_PENDING
+                changed = True
+            spec = node.setdefault("spec", {})
+            if consts.MAINTENANCE_INITIAL_STATE_ANNOTATION not in ann:
+                ann[consts.MAINTENANCE_INITIAL_STATE_ANNOTATION] = (
+                    "true" if spec.get("unschedulable", False) else "false"
+                )
+                changed = True
+            if not spec.get("unschedulable", False):
+                spec["unschedulable"] = True
+                changed = True
+            return changed
+
+        self._mutate_node(mutate)
+        if self.evict:
+            from tpu_operator.upgrade.upgrade_state import PodManager
+
+            pods = PodManager(
+                self.client,
+                os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "default"),
+            )
+            victims = pods.tpu_pods_on_node(self.node_name)
+            if victims:
+                log.warning(
+                    "evicting %d TPU pod(s) ahead of maintenance", len(victims)
+                )
+                pods.delete_pods(victims, force=self.force)
+        from tpu_operator.kube.events import TYPE_WARNING
+
+        self._event(
+            TYPE_WARNING,
+            "HostMaintenanceImminent",
+            f"{event}: node cordoned and TPU workloads evicted ahead of "
+            "host maintenance",
+        )
+
+    def _leave_maintenance(self) -> None:
+        log.info("maintenance window cleared on %s", self.node_name)
+        was_cordoned = {"value": False}
+
+        def mutate(node):
+            changed = False
+            meta = node["metadata"]
+            labels = meta.setdefault("labels", {})
+            ann = meta.setdefault("annotations", {})
+            if consts.MAINTENANCE_STATE_LABEL in labels:
+                del labels[consts.MAINTENANCE_STATE_LABEL]
+                changed = True
+            initial = ann.pop(consts.MAINTENANCE_INITIAL_STATE_ANNOTATION, None)
+            if initial is not None:
+                changed = True
+            was_cordoned["value"] = initial == "true"
+            spec = node.setdefault("spec", {})
+            if not was_cordoned["value"] and spec.get("unschedulable", False):
+                spec["unschedulable"] = False
+                changed = True
+            return changed
+
+        self._mutate_node(mutate)
+        from tpu_operator.kube.events import TYPE_NORMAL
+
+        self._event(
+            TYPE_NORMAL,
+            "HostMaintenanceCleared",
+            "maintenance window cleared; node restored"
+            + (" (left cordoned: was cordoned before)" if was_cordoned["value"] else ""),
+        )
+
+    # -- the loop --------------------------------------------------------
+    def reconcile_once(self) -> Optional[str]:
+        event = self.reader(self.metadata_url)
+        if event is EVENT_UNKNOWN:
+            # metadata outage: hold the current state — neither an
+            # eviction trigger nor an all-clear
+            return event
+        if event != EVENT_NONE:
+            if not self._active:
+                # idempotent entry: a restart mid-window re-runs it — the
+                # cordon/label writes no-op when already applied, and the
+                # eviction sweep clears any straggler a crashed previous
+                # process (or a direct-nodeName placement) left holding
+                # the chips; a lingering label alone is NOT proof the
+                # eviction completed
+                try:
+                    self._enter_maintenance(event)
+                    self._active = True
+                except ConflictError:
+                    log.warning(
+                        "maintenance cordon hit persistent 409s; retrying"
+                    )
+        elif self._active:
+            try:
+                self._leave_maintenance()
+                self._active = False
+            except ConflictError:
+                log.warning("maintenance uncordon hit persistent 409s; retrying")
+        else:
+            # crash-recovery: a restart after the window cleared loses
+            # self._active; a lingering label means WE cordoned earlier
+            node = self.client.get("v1", "Node", self.node_name)
+            if (node["metadata"].get("labels") or {}).get(
+                consts.MAINTENANCE_STATE_LABEL
+            ):
+                try:
+                    self._leave_maintenance()
+                except ConflictError:
+                    log.warning("maintenance cleanup hit 409s; retrying")
+        return event
+
+    def run_loop(self, interval_s: float = 10.0, once: bool = False) -> None:
+        while True:
+            try:
+                self.reconcile_once()
+            except Exception:
+                log.exception("maintenance pass failed")
+            if once:
+                return
+            time.sleep(interval_s)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level="INFO")
+    p = argparse.ArgumentParser("tpu-maintenance-handler")
+    p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
+    p.add_argument(
+        "--metadata-url",
+        default=os.environ.get("METADATA_URL", DEFAULT_METADATA_URL),
+    )
+    p.add_argument(
+        "--poll-interval",
+        type=float,
+        default=float(os.environ.get("POLL_INTERVAL_S", "10")),
+    )
+    p.add_argument(
+        "--force",
+        action="store_true",
+        default=os.environ.get("FORCE_EVICT", "") == "true",
+        help="also delete unmanaged (ownerless) TPU pods",
+    )
+    p.add_argument(
+        "--no-evict",
+        action="store_true",
+        default=os.environ.get("EVICT_WORKLOADS", "true") == "false",
+        help="cordon and label only; leave workloads running",
+    )
+    p.add_argument("--once", action="store_true")
+    args = p.parse_args(argv)
+    if not args.node_name:
+        log.error("--node-name (or NODE_NAME) required")
+        return 1
+    from tpu_operator.kube.rest import RestClient
+
+    handler = MaintenanceHandler(
+        RestClient(),
+        args.node_name,
+        metadata_url=args.metadata_url,
+        force=args.force,
+        evict=not args.no_evict,
+    )
+    handler.run_loop(interval_s=args.poll_interval, once=args.once)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

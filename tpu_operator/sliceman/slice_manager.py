@@ -151,20 +151,15 @@ class SliceManager:
         concurrency: the Node object is shared with other label writers
         (the operator's deploy-label bus, the upgrade FSM, TFD), so a 409
         means re-GET and re-apply, not failure."""
-        last: Optional[Exception] = None
-        for attempt in range(5):
-            if attempt:
-                time.sleep(0.05 * attempt)
-            node = self._node()
-            labels = node["metadata"].setdefault("labels", {})
-            if not mutate(labels):
-                return
-            try:
-                self.client.update(node)
-                return
-            except ConflictError as e:
-                last = e
-        raise last  # type: ignore[misc]
+        from tpu_operator.kube.client import mutate_with_retry
+
+        mutate_with_retry(
+            self.client,
+            "v1",
+            "Node",
+            self.node_name,
+            mutate=lambda node: mutate(node["metadata"].setdefault("labels", {})),
+        )
 
     def _set_state(self, value: str) -> None:
         def mutate(labels: dict) -> bool:

@@ -115,3 +115,67 @@ def test_node_deletion_gcs_bound_pods_fake():
     client.delete("v1", "Node", "doomed")
     assert client.get_or_none("v1", "Pod", "on-doomed", "ns") is None
     assert client.get_or_none("v1", "Pod", "elsewhere", "ns") is not None
+
+
+# ---------------------------------------------------------------------------
+# mutate_with_retry — the shared conflict-retry discipline every Node
+# writer uses (deploy-label bus, upgrade FSM, TFD, slice/maintenance)
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedClient:
+    """get/update stub: fails `update` with ConflictError n times."""
+
+    def __init__(self, conflicts):
+        self.conflicts = conflicts
+        self.gets = 0
+        self.updates = 0
+        self.obj = {"metadata": {"name": "n", "labels": {}}}
+
+    def get(self, av, kind, name, namespace=""):
+        self.gets += 1
+        import copy
+
+        return copy.deepcopy(self.obj)
+
+    def update(self, obj):
+        self.updates += 1
+        if self.conflicts > 0:
+            self.conflicts -= 1
+            raise ConflictError("stale")
+        self.obj = obj
+
+
+def test_mutate_with_retry_retries_conflicts():
+    from tpu_operator.kube.client import mutate_with_retry
+
+    c = _ScriptedClient(conflicts=2)
+
+    def mutate(node):
+        node["metadata"]["labels"]["k"] = "v"
+        return True
+
+    out = mutate_with_retry(c, "v1", "Node", "n", mutate=mutate, backoff_s=0)
+    assert out["metadata"]["labels"]["k"] == "v"
+    assert c.gets == 3 and c.updates == 3  # re-GET before every attempt
+
+
+def test_mutate_with_retry_no_change_short_circuits():
+    from tpu_operator.kube.client import mutate_with_retry
+
+    c = _ScriptedClient(conflicts=0)
+    mutate_with_retry(c, "v1", "Node", "n", mutate=lambda node: False)
+    assert c.updates == 0
+
+
+def test_mutate_with_retry_raises_after_budget():
+    import pytest
+
+    from tpu_operator.kube.client import mutate_with_retry
+
+    c = _ScriptedClient(conflicts=99)
+    with pytest.raises(ConflictError):
+        mutate_with_retry(
+            c, "v1", "Node", "n", mutate=lambda n: True, backoff_s=0
+        )
+    assert c.updates == 5  # the attempt budget

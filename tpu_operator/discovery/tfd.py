@@ -110,9 +110,11 @@ def _libtpu_version(libtpu_dir: str) -> str:
 
 def apply_features(client, node_name: str, features: Dict[str, str]) -> bool:
     """Write labels to the node; prunes stale ``tpu.k8s.io/tpu.*`` TFD labels
-    we no longer assert. Returns True when anything changed."""
-    node = client.get("v1", "Node", node_name)
-    labels = node["metadata"].setdefault("labels", {})
+    we no longer assert. Conflict-retried — the Node is shared with the
+    deploy-label bus, the upgrade FSM and the slice/maintenance operands.
+    Returns True when anything changed."""
+    from tpu_operator.kube.client import mutate_with_retry
+
     managed_prefixes = (
         consts.TFD_CHIP_TYPE_LABEL,
         consts.TFD_CHIP_COUNT_LABEL,
@@ -124,18 +126,24 @@ def apply_features(client, node_name: str, features: Dict[str, str]) -> bool:
         consts.TFD_LIBTPU_VERSION_LABEL,
         consts.TFD_SLICE_ID_LABEL,
     )
-    changed = False
-    for key in managed_prefixes:
-        want = features.get(key)
-        if want is None and key in labels:
-            del labels[key]
-            changed = True
-        elif want is not None and labels.get(key) != want:
-            labels[key] = want
-            changed = True
-    if changed:
-        client.update(node)
-    return changed
+    result = {"changed": False}
+
+    def mutate(node):
+        labels = node["metadata"].setdefault("labels", {})
+        changed = False
+        for key in managed_prefixes:
+            want = features.get(key)
+            if want is None and key in labels:
+                del labels[key]
+                changed = True
+            elif want is not None and labels.get(key) != want:
+                labels[key] = want
+                changed = True
+        result["changed"] = changed
+        return changed
+
+    mutate_with_retry(client, "v1", "Node", node_name, mutate=mutate)
+    return result["changed"]
 
 
 def write_nfd_feature_file(

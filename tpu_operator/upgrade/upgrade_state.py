@@ -27,7 +27,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from tpu_operator import consts
-from tpu_operator.kube.client import Client, Obj
+from tpu_operator.kube.client import Client, Obj, mutate_with_retry
 
 log = logging.getLogger("tpu-operator.upgrade")
 
@@ -86,20 +86,28 @@ class NodeStateProvider:
         ).get(consts.UPGRADE_STATE_LABEL, STATE_UNKNOWN)
 
     def set_state(self, node: Obj, state: str) -> None:
-        fresh = self.client.get("v1", "Node", node["metadata"]["name"])
-        labels = fresh["metadata"].setdefault("labels", {})
-        if labels.get(consts.UPGRADE_STATE_LABEL) == state:
-            return
-        labels[consts.UPGRADE_STATE_LABEL] = state
-        # stamp state entry time; timed states (drain, validation) fail the
-        # node when they overstay their budget
-        fresh["metadata"].setdefault("annotations", {})[
-            consts.UPGRADE_STATE_SINCE_ANNOTATION
-        ] = _now_iso()
-        self.client.update(fresh)
-        log.info(
-            "node %s upgrade-state -> %s", node["metadata"]["name"], state
+        changed = {"value": False}
+
+        def mutate(fresh):
+            labels = fresh["metadata"].setdefault("labels", {})
+            if labels.get(consts.UPGRADE_STATE_LABEL) == state:
+                return False
+            labels[consts.UPGRADE_STATE_LABEL] = state
+            # stamp state entry time; timed states (drain, validation)
+            # fail the node when they overstay their budget
+            fresh["metadata"].setdefault("annotations", {})[
+                consts.UPGRADE_STATE_SINCE_ANNOTATION
+            ] = _now_iso()
+            changed["value"] = True
+            return True
+
+        mutate_with_retry(
+            self.client, "v1", "Node", node["metadata"]["name"], mutate=mutate
         )
+        if changed["value"]:
+            log.info(
+                "node %s upgrade-state -> %s", node["metadata"]["name"], state
+            )
 
     def state_age_s(self, node: Obj) -> float:
         """Seconds since the node entered its current state, read from the
@@ -124,18 +132,16 @@ class NodeStateProvider:
     def stamp_now(self, node: Obj) -> None:
         """(Re)write the state-entry timestamp for a node whose stamp is
         missing or unreadable."""
+        def mutate(fresh):
+            fresh["metadata"].setdefault("annotations", {})[
+                consts.UPGRADE_STATE_SINCE_ANNOTATION
+            ] = _now_iso()
+            return True
+
         try:
-            fresh = self.client.get("v1", "Node", node["metadata"]["name"])
-        except Exception:
-            log.exception(
-                "failed to stamp node %s", node["metadata"]["name"]
+            mutate_with_retry(
+                self.client, "v1", "Node", node["metadata"]["name"], mutate=mutate
             )
-            return
-        fresh["metadata"].setdefault("annotations", {})[
-            consts.UPGRADE_STATE_SINCE_ANNOTATION
-        ] = _now_iso()
-        try:
-            self.client.update(fresh)
         except Exception:
             log.exception(
                 "failed to stamp node %s", node["metadata"]["name"]
@@ -144,17 +150,21 @@ class NodeStateProvider:
     def set_annotation(self, node: Obj, key: str, value: Optional[str]) -> None:
         """Set (or, with ``value=None``, remove) a node annotation (reference
         ``ChangeNodeUpgradeAnnotation``, value "null" = delete)."""
-        fresh = self.client.get("v1", "Node", node["metadata"]["name"])
-        ann = fresh["metadata"].setdefault("annotations", {})
-        if value is None:
-            if key not in ann:
-                return
-            del ann[key]
-        else:
-            if ann.get(key) == value:
-                return
-            ann[key] = value
-        self.client.update(fresh)
+        def mutate(fresh):
+            ann = fresh["metadata"].setdefault("annotations", {})
+            if value is None:
+                if key not in ann:
+                    return False
+                del ann[key]
+            else:
+                if ann.get(key) == value:
+                    return False
+                ann[key] = value
+            return True
+
+        mutate_with_retry(
+            self.client, "v1", "Node", node["metadata"]["name"], mutate=mutate
+        )
         # keep the caller's in-hand object coherent for later steps this
         # reconcile
         node["metadata"].setdefault("annotations", {})
@@ -164,22 +174,25 @@ class NodeStateProvider:
             node["metadata"]["annotations"][key] = value
 
     def clear_state(self, node: Obj) -> None:
-        fresh = self.client.get("v1", "Node", node["metadata"]["name"])
-        labels = fresh["metadata"].setdefault("labels", {})
-        ann = fresh["metadata"].get("annotations", {}) or {}
-        changed = False
-        if consts.UPGRADE_STATE_LABEL in labels:
-            del labels[consts.UPGRADE_STATE_LABEL]
-            changed = True
-        for key in (
-            consts.UPGRADE_STATE_SINCE_ANNOTATION,
-            consts.UPGRADE_INITIAL_STATE_ANNOTATION,
-        ):
-            if key in ann:
-                del ann[key]
+        def mutate(fresh):
+            labels = fresh["metadata"].setdefault("labels", {})
+            ann = fresh["metadata"].get("annotations", {}) or {}
+            changed = False
+            if consts.UPGRADE_STATE_LABEL in labels:
+                del labels[consts.UPGRADE_STATE_LABEL]
                 changed = True
-        if changed:
-            self.client.update(fresh)
+            for key in (
+                consts.UPGRADE_STATE_SINCE_ANNOTATION,
+                consts.UPGRADE_INITIAL_STATE_ANNOTATION,
+            ):
+                if key in ann:
+                    del ann[key]
+                    changed = True
+            return changed
+
+        mutate_with_retry(
+            self.client, "v1", "Node", node["metadata"]["name"], mutate=mutate
+        )
 
 
 def _now_iso() -> str:
@@ -201,11 +214,13 @@ class CordonManager:
         self._set_unschedulable(node_name, False)
 
     def _set_unschedulable(self, node_name: str, value: bool) -> None:
-        node = self.client.get("v1", "Node", node_name)
-        if node.get("spec", {}).get("unschedulable", False) == value:
-            return
-        node.setdefault("spec", {})["unschedulable"] = value
-        self.client.update(node)
+        def mutate(node):
+            if node.get("spec", {}).get("unschedulable", False) == value:
+                return False
+            node.setdefault("spec", {})["unschedulable"] = value
+            return True
+
+        mutate_with_retry(self.client, "v1", "Node", node_name, mutate=mutate)
 
 
 class PodManager:

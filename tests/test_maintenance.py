@@ -237,6 +237,39 @@ def test_metadata_unreachable_reads_unknown():
     )
 
 
+def test_fleet_gauge_counts_nodes_under_maintenance(env, monkeypatch):
+    """The operator's fleet metrics expose how many nodes sit in an
+    active maintenance window."""
+    from prometheus_client import REGISTRY
+
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        ClusterPolicyReconciler,
+    )
+    from tpu_operator.kube.testing import sample_clusterpolicy_path
+
+    import yaml
+
+    client, handler, feed = env
+    with open(sample_clusterpolicy_path()) as f:
+        cr = yaml.safe_load(f)
+    cr["metadata"]["uid"] = "uid-cp"
+    client.create(cr)
+    rec = ClusterPolicyReconciler(client)
+
+    rec.reconcile()
+    assert REGISTRY.get_sample_value("tpu_operator_nodes_under_maintenance") == 0
+
+    feed["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+    handler.reconcile_once()
+    rec.reconcile()
+    assert REGISTRY.get_sample_value("tpu_operator_nodes_under_maintenance") == 1
+
+    feed["event"] = EVENT_NONE
+    handler.reconcile_once()
+    rec.reconcile()
+    assert REGISTRY.get_sample_value("tpu_operator_nodes_under_maintenance") == 0
+
+
 def test_state_gating(monkeypatch):
     """Disabled (the default) deploys nothing; enabling deploys the DS
     with the deploy label driving its nodeSelector."""

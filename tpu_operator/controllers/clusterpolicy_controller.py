@@ -170,6 +170,14 @@ class ClusterPolicyReconciler:
             self.metrics.libtpu_generations_total.set(
                 len(self.ctrl.tpu_generations)
             )
+            under_maintenance = sum(
+                1
+                for n in self.ctrl._nodes_cache
+                if (n.get("metadata", {}).get("labels") or {}).get(
+                    consts.MAINTENANCE_STATE_LABEL
+                )
+            )
+            self.metrics.nodes_under_maintenance.set(under_maintenance)
 
     def _set_status(self, cp_obj, state: str, slice_summary=None) -> None:
         """reference ``updateCRState`` (``:198``) + a Ready condition + the

@@ -74,6 +74,12 @@ class OperatorMetrics:
         self.slices_ready = g(
             "tpu_slices_ready", "TPU slices with every member host validated"
         )
+        # host-maintenance visibility (TPU-specific; no reference analogue)
+        self.nodes_under_maintenance = g(
+            "nodes_under_maintenance",
+            "TPU nodes with an active metadata-announced maintenance window "
+            "(tpu.k8s.io/maintenance=pending)",
+        )
         # upgrade FSM gauges (reference :142-185)
         self.upgrades_in_progress = g(
             "libtpu_upgrades_in_progress", "Nodes currently upgrading libtpu"

@@ -383,6 +383,109 @@ def main() -> int:
     assert res is not None and res.ready, f"no recovery on node arrival: {res}"
     print("ok: node departure/arrival posture over the wire")
 
+    print("=== host-maintenance handler (metadata window over the wire)")
+    # enable the opt-in 18th state; the DS must appear and the node get
+    # its deploy label
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    cp["spec"]["maintenanceHandler"] = {
+        "enabled": True,
+        "repository": "gcr.io/tpu-operator",
+        "image": "tpu-operator",
+        "version": "0.9.0",
+    }
+    client.update(cp)
+    converge()
+    ds_names = {d["metadata"]["name"] for d in client.list("apps/v1", "DaemonSet", NS)}
+    assert "tpu-maintenance-handler" in ds_names, sorted(ds_names)
+    mh_node = client.get("v1", "Node", nodes[0])
+    assert (
+        mh_node["metadata"]["labels"].get(
+            consts.DEPLOY_LABEL_PREFIX + consts.COMPONENT_MAINTENANCE_HANDLER
+        )
+        == "true"
+    )
+
+    # drive the node agent against a REAL metadata stub: window -> cordon
+    # + label + evict; outage -> state held; all-clear -> restore
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from tpu_operator.operands.maintenance import MaintenanceHandler
+
+    meta_state = {"event": "NONE", "dead": False}
+
+    class MetaStub(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if meta_state["dead"]:
+                self.send_response(500)
+                self.end_headers()
+                return
+            assert self.headers.get("Metadata-Flavor") == "Google"
+            body = meta_state["event"].encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    meta_srv = ThreadingHTTPServer(("127.0.0.1", 0), MetaStub)
+    threading.Thread(target=meta_srv.serve_forever, daemon=True).start()
+    client.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": "mh-train",
+                "namespace": "default",
+                "ownerReferences": [
+                    {
+                        "apiVersion": "batch/v1",
+                        "kind": "Job",
+                        "name": "j",
+                        "uid": "mh-u",
+                    }
+                ],
+            },
+            "spec": {
+                "nodeName": nodes[0],
+                "containers": [
+                    {
+                        "name": "t",
+                        "resources": {"limits": {consts.TPU_RESOURCE: "4"}},
+                    }
+                ],
+            },
+            "status": {"phase": "Running"},
+        }
+    )
+    mh = MaintenanceHandler(
+        client,
+        nodes[0],
+        metadata_url=f"http://127.0.0.1:{meta_srv.server_port}/maintenance-event",
+    )
+    meta_state["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+    mh.reconcile_once()
+    n = client.get("v1", "Node", nodes[0])
+    assert n["spec"]["unschedulable"] is True
+    assert n["metadata"]["labels"][consts.MAINTENANCE_STATE_LABEL] == "pending"
+    assert client.get_or_none("v1", "Pod", "mh-train", "default") is None
+    meta_state["dead"] = True  # metadata outage mid-window: hold state
+    mh.reconcile_once()
+    assert client.get("v1", "Node", nodes[0])["spec"]["unschedulable"] is True
+    meta_state["dead"] = False
+    meta_state["event"] = "NONE"
+    mh.reconcile_once()
+    n = client.get("v1", "Node", nodes[0])
+    assert not n["spec"].get("unschedulable", False)
+    assert consts.MAINTENANCE_STATE_LABEL not in n["metadata"]["labels"]
+    meta_srv.shutdown()
+    # readiness unharmed by the excursion
+    res = converge()
+    assert res is not None and res.ready, f"maintenance flow broke readiness: {res}"
+    print("ok: maintenance window → cordon+evict → outage held → restored")
+
     print("=== uninstall (CR delete → SERVER-side ownerRef GC)")
     client.delete(CP, "ClusterPolicy", "cluster-policy")
     wait_for(

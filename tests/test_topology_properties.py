@@ -1,0 +1,129 @@
+"""Property tests (hypothesis) for the topology math under the device
+plugin's allocation and the slice manager's partitioning — the invariants
+every caller assumes: coordinate round-trips, exact tiling, allocation
+contracts (count, uniqueness, must-include, contiguity when possible),
+and maxUnavailable scaling bounds."""
+
+import itertools
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from tpu_operator.upgrade.upgrade_state import parse_max_unavailable
+from tpu_operator.workloads import topology as topo
+
+# realistic TPU host topologies: 1-3 dims, small axes
+dims_strategy = st.lists(st.integers(1, 8), min_size=1, max_size=3)
+generations = st.sampled_from(["v4", "v5e", "v5p", "v6e"])
+
+
+def to_str(dims):
+    return "x".join(str(d) for d in dims)
+
+
+@given(dims=dims_strategy)
+def test_coord_index_round_trip(dims):
+    n = 1
+    for d in dims:
+        n *= d
+    for i in range(n):
+        c = topo.index_to_coord(i, dims)
+        assert topo.coord_to_index(c, dims) == i
+        assert all(0 <= x < d for x, d in zip(c, dims))
+
+
+@given(dims=dims_strategy, data=st.data())
+def test_subslices_tile_exactly(dims, data):
+    """Tiles are disjoint, cover every chip, and each is ICI-contiguous."""
+    shape = tuple(
+        data.draw(st.sampled_from([s for s in range(1, d + 1) if d % s == 0]))
+        for d in dims
+    )
+    tiles = topo.enumerate_subslices(to_str(dims), shape)
+    seen = set()
+    for t in tiles:
+        coords = t.coords()
+        assert topo.contiguous(coords, to_str(dims), "v5p"), (t, dims)
+        for c in coords:
+            assert c not in seen, "tiles overlap"
+            seen.add(c)
+    assert len(seen) == topo.chip_count(to_str(dims)), "tiles don't cover"
+
+
+@given(
+    dims=dims_strategy,
+    gen=generations,
+    data=st.data(),
+)
+@settings(max_examples=200)
+def test_pick_chips_contract(dims, gen, data):
+    """pick_chips returns None only when unsatisfiable; otherwise exactly
+    ``count`` unique ids from ``available`` including every must-include."""
+    n = topo.chip_count(to_str(dims))
+    available = data.draw(
+        st.lists(
+            st.integers(0, max(0, n - 1)), unique=True, min_size=0, max_size=n
+        )
+    )
+    count = data.draw(st.integers(1, max(1, n)))
+    must = data.draw(
+        st.lists(
+            st.sampled_from(available) if available else st.nothing(),
+            unique=True,
+            min_size=0,
+            max_size=min(3, len(available)),
+        )
+        if available
+        else st.just([])
+    )
+    out = topo.pick_chips(to_str(dims), gen, count, available, must)
+    if out is None:
+        # must be genuinely unsatisfiable
+        assert len(available) < count or len(must) > count
+        return
+    assert len(out) == count
+    assert len(set(out)) == count, "duplicate ids"
+    assert set(out) <= set(available), "picked an un-offered id"
+    assert set(must) <= set(out), "must-include dropped"
+
+
+@given(dims=dims_strategy, gen=generations, data=st.data())
+@settings(max_examples=100)
+def test_pick_chips_contiguous_when_everything_available(dims, gen, data):
+    """With the full topology available and a tiling block size, the
+    allocation must be ICI-contiguous."""
+    n = topo.chip_count(to_str(dims))
+    # pick a count that is a product of divisors of each axis => a block
+    # shape exists that tiles the topology
+    shape = tuple(
+        data.draw(st.sampled_from([s for s in range(1, d + 1) if d % s == 0]))
+        for d in dims
+    )
+    count = 1
+    for s in shape:
+        count *= s
+    out = topo.pick_chips(to_str(dims), gen, count, list(range(n)))
+    assert out is not None and len(out) == count
+    coords = [topo.index_to_coord(i, dims) for i in out]
+    assert topo.contiguous(coords, to_str(dims), gen), (out, dims, count)
+
+
+@given(
+    total=st.integers(0, 500),
+    value=st.one_of(
+        st.none(),
+        st.integers(-10, 600),
+        st.from_regex(r"\A\d{1,3}%\Z"),
+        st.sampled_from(["0%", "100%", "25%", "garbage", ""]),
+    ),
+)
+def test_parse_max_unavailable_bounds(total, value):
+    out = parse_max_unavailable(value, total)
+    assert 0 <= out <= max(total, 0)
+    if total > 0:
+        if value == "100%":
+            assert out == total
+        if value is None:
+            assert out == total  # unset = no throttle
+        if isinstance(value, int):
+            assert out == max(0, min(value, total))

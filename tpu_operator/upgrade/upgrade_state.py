@@ -338,7 +338,12 @@ def parse_max_unavailable(value, total: int) -> int:
             pct = float(s[:-1])
         except ValueError:
             return total
-        return max(1, math.floor(total * pct / 100.0)) if pct > 0 else 0
+        if pct <= 0:
+            return 0
+        # clamp like the int branch: the CRD pattern admits "200%", and a
+        # budget above the node count would break every consumer's
+        # budget arithmetic
+        return min(max(1, math.floor(total * pct / 100.0)), total)
     try:
         return max(0, min(int(s), total))
     except ValueError:

@@ -33,7 +33,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from tpu_operator import consts
-from tpu_operator.kube.client import Client, Obj
+from tpu_operator.kube.client import Client, NotFoundError, Obj, mutate_with_retry
 
 log = logging.getLogger("tpu-operator.slices")
 
@@ -175,20 +175,22 @@ def aggregate(
             )
             if cached_labels.get(consts.SLICE_READY_LABEL) == verdict:
                 continue
-            try:
-                node = client.get("v1", "Node", node_name)
-            except Exception:
-                log.exception("failed to fetch node %s", node_name)
-                continue
-            labels = node["metadata"].setdefault("labels", {})
-            if labels.get(consts.SLICE_READY_LABEL) != verdict:
+
+            def mutate(node, verdict=verdict):
+                labels = node["metadata"].setdefault("labels", {})
+                if labels.get(consts.SLICE_READY_LABEL) == verdict:
+                    return False
                 labels[consts.SLICE_READY_LABEL] = verdict
-                try:
-                    client.update(node)
-                except Exception:
-                    log.exception(
-                        "failed to label node %s slice.ready=%s",
-                        node_name,
-                        verdict,
-                    )
+                return True
+
+            try:
+                mutate_with_retry(client, "v1", "Node", node_name, mutate=mutate)
+            except NotFoundError:
+                # node deleted mid-pass: normal churn, next reconcile
+                # regroups the slices without it
+                continue
+            except Exception:
+                log.exception(
+                    "failed to label node %s slice.ready=%s", node_name, verdict
+                )
     return SliceSummary(slices=slices)

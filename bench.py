@@ -238,6 +238,37 @@ def run_convergence() -> dict:
     return out
 
 
+def run_fleet_convergence(n_nodes: int = 16) -> dict:
+    """Fleet-scale time-to-Ready: an ``n_nodes`` pool converged by the
+    full Manager against the kubesim apiserver with a faithful per-node
+    kubelet (``tests/scripts/fleet_converge.py``). Tracks the operator's
+    horizontal-scaling cost round-over-round; the single-node axis above
+    covers the depth dimension."""
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "tests", "scripts", "fleet_converge.py"),
+                "--nodes", str(n_nodes),
+            ],
+            cwd=REPO,
+            env=dict(os.environ, OPERATOR_NAMESPACE="tpu-operator"),
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": "fleet converge timed out after 180s"}
+    try:
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception:
+        return {
+            "ok": False,
+            "error": (proc.stderr or proc.stdout)[-512:],
+        }
+    return out
+
+
 def main() -> int:
     from tpu_operator.workloads.matmul import run_matmul_validation
     from tpu_operator.workloads.membw import run_membw_probe
@@ -251,24 +282,46 @@ def main() -> int:
         # end-of-chain sync is amortized (measured 96% of v5e peak vs 87%
         # for 8192/8/16)
         res = run_matmul_validation(size=16384, depth=16, iters=8, expect_tpu=True)
-        # transient chip/tunnel degradation has been observed to produce
-        # one-off ~7%-of-peak runs that recover immediately: re-measure up
-        # to twice and keep the best (best-of-N is the honest comparator
-        # for a sustained-capable rate; a persistently sick chip still
-        # reports sick)
+        # transient chip/tunnel degradation produces one-off ~7%-of-peak
+        # runs that recover immediately, and timing-sync pollution can
+        # produce IMPOSSIBLE >peak readings: re-measure up to twice and
+        # keep the best PLAUSIBLE run (within membw.PLAUSIBILITY_MARGIN of peak — a reading
+        # above hardware peak is a broken measurement, not a fast chip)
+        from tpu_operator.workloads.membw import PLAUSIBILITY_MARGIN
+
+        def plausible(r):
+            return r.ok and (
+                r.utilization is None or r.utilization <= PLAUSIBILITY_MARGIN
+            )
+
         attempts = 0
         while (
-            res.ok
-            and res.utilization is not None  # unmapped gen: nothing to judge
-            and res.utilization < 0.5
+            res.utilization is not None  # unmapped gen: nothing to judge
+            and (
+                not plausible(res)
+                or (res.ok and res.utilization < 0.5)
+            )
             and attempts < 2
         ):
             attempts += 1
             retry = run_matmul_validation(
                 size=16384, depth=16, iters=8, expect_tpu=True
             )
-            if retry.ok and (retry.utilization or 0) > (res.utilization or 0):
+            if plausible(retry) and (
+                not plausible(res)
+                or (retry.utilization or 0) > (res.utilization or 0)
+            ):
                 res = retry
+        if (
+            res.ok
+            and res.utilization is not None
+            and res.utilization > PLAUSIBILITY_MARGIN
+        ):
+            res.ok = False
+            res.error = (
+                f"implausible TFLOPS measurement ({res.tflops:.1f} vs peak "
+                f"{res.peak_tflops}); timing sync failure"
+            )
     else:
         res = run_matmul_validation(size=1024, depth=2, iters=2, expect_tpu=False)
 
@@ -328,8 +381,9 @@ def main() -> int:
     }
     telemetry = run_telemetry_chain(sample)
 
-    # operator convergence axis (subprocess; leaves this JAX state alone)
+    # operator convergence axes (subprocesses; leave this JAX state alone)
     convergence = run_convergence()
+    fleet = run_fleet_convergence()
 
     # ICI axis last: it re-binds JAX to the CPU mesh
     ici = run_ici_on_cpu_mesh()
@@ -355,6 +409,7 @@ def main() -> int:
         "membw_utilization": round(mem.utilization or 0.0, 4),
         "telemetry": telemetry,
         "convergence": convergence,
+        "convergence_fleet": fleet,
         "ici_cpu_mesh": ici,
     }
     if not mem.ok and mem.error:
@@ -362,7 +417,12 @@ def main() -> int:
     print(json.dumps(out))
     # a failed axis is a failed bench — zeros must never be recorded as
     # a successful run (same policy as the telemetry assertion)
-    return 0 if telemetry.get("ok") and mem.ok and convergence.get("ok") else 1
+    return 0 if (
+        telemetry.get("ok")
+        and mem.ok
+        and convergence.get("ok")
+        and fleet.get("ok")
+    ) else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,89 @@
+"""Fleet time-to-Ready: converge an N-node TPU pool against the kubesim
+apiserver with the full Manager runtime (watch-fed queue, both
+reconcilers) and a faithful per-node kubelet, and print ONE JSON line
+``{"ok": ..., "nodes": N, "time_to_ready_s": ...}``.
+
+bench.py runs this as the fleet-scale convergence axis (the single-node
+axis is ``tpu_operator.main --kubesim --once``); the reference's only
+comparable signal is its 45-min e2e pod-ready ceiling on one node."""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+from tpu_operator.kube.client import ConflictError, NotFoundError
+from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+from tpu_operator.kube.rest import TransientAPIError
+from tpu_operator.kube.testing import seed_cluster, simulate_kubelet_nodes
+from tpu_operator.main import build_manager, wire_event_sources
+
+NS = "tpu-operator"
+CPV = "tpu.k8s.io/v1"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("fleet-converge")
+    p.add_argument("--nodes", type=int, default=16)
+    p.add_argument("--timeout", type=float, default=120.0)
+    args = p.parse_args(argv)
+
+    nodes = tuple(f"fleet-{i}" for i in range(args.nodes))
+    server = KubeSimServer(KubeSim()).start()
+    client = make_client(server.port)
+    client.GET_RETRY_BACKOFF_S = 0.05
+    seed_cluster(client, NS, node_names=nodes)
+
+    t0 = time.monotonic()
+    mgr, _, _ = build_manager(client, NS, metrics_port=0, probe_port=0)
+    stop = threading.Event()
+    wire_event_sources(mgr, client, NS, stop_event=stop)
+    mgr.start()
+    halt = threading.Event()
+
+    def kubelet():
+        while not halt.is_set():
+            try:
+                simulate_kubelet_nodes(client, NS, nodes)
+            except (ConflictError, NotFoundError, TransientAPIError, OSError):
+                pass
+            time.sleep(0.1)
+
+    threading.Thread(target=kubelet, daemon=True).start()
+    mgr.enqueue("clusterpolicy")
+
+    ok = False
+    deadline = time.monotonic() + args.timeout
+    while time.monotonic() < deadline:
+        cp = client.get_or_none(CPV, "ClusterPolicy", "cluster-policy") or {}
+        if cp.get("status", {}).get("state") == "ready":
+            ok = True
+            break
+        time.sleep(0.1)
+    elapsed = time.monotonic() - t0
+
+    halt.set()
+    stop.set()
+    mgr.stop()
+    server.stop()
+    print(
+        json.dumps(
+            {
+                "ok": ok,
+                "nodes": args.nodes,
+                "time_to_ready_s": round(elapsed, 2),
+            }
+        )
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -110,3 +110,27 @@ def test_membw_copy_kernel_exact():
         rows, LANES
     )
     assert np.array_equal(np.asarray(fn(x)), np.asarray(x))
+
+
+def test_membw_plausibility_gate():
+    """A bandwidth reading above hardware peak is a timing-sync failure,
+    not a fast chip: the gate discards implausible paths and refuses to
+    report when no path is physically possible."""
+    import pytest
+
+    from tpu_operator.workloads.membw import best_plausible_gbps
+
+    # both plausible: the better one wins
+    assert best_plausible_gbps(600.0, 700.0, 819.0) == 700.0
+    # one path bogus (3x peak): the valid one wins
+    assert best_plausible_gbps(650.0, 2800.0, 819.0) == 650.0
+    assert best_plausible_gbps(2800.0, 650.0, 819.0) == 650.0
+    # spec-rounding tolerance: just over peak passes
+    assert best_plausible_gbps(820.0, 0.0, 819.0) == 820.0
+    # no known peak (CPU CI): anything positive is accepted
+    assert best_plausible_gbps(123.0, 456.0, None) == 456.0
+    # everything implausible: invalid measurement, never recorded
+    with pytest.raises(RuntimeError):
+        best_plausible_gbps(2800.0, 3000.0, 819.0)
+    with pytest.raises(RuntimeError):
+        best_plausible_gbps(0.0, 0.0, None)

@@ -16,6 +16,9 @@ chip and track every perf axis round-over-round):
   (``native/out/tpu_metricsd``) merges it and serves :port; the
   Prometheus exporter scrapes the hostengine; the rendered series must
   be non-zero or the bench exits 1;
+* ``convergence`` / ``convergence_fleet`` — operator time-to-Ready
+  (single node via the shipped dev loop; a 16-node pool over the kubesim
+  wire) — BASELINE's second headline metric;
 * ``ici_cpu_mesh`` — the ring-collective probe on the virtual 8-device
   CPU mesh (one real chip has no ICI neighbors; the CPU number tracks
   probe regressions, not hardware).
@@ -205,7 +208,7 @@ def run_convergence() -> dict:
     """BASELINE's second headline metric — node time-to-Ready. Times the
     shipped process (``tpu_operator.main --kubesim --simulate-kubelet
     --once``): in-process apiserver with wire semantics, full reconcile of
-    all 17 states to ClusterPolicy Ready, exit 0 on converged. The
+    all states to ClusterPolicy Ready, exit 0 on converged. The
     reference's implicit ceiling is the 45-min e2e pod-ready poll
     (``tests/scripts/checks.sh:24``); hardware bring-up time (image pulls,
     libtpu install) is out of scope here — this tracks the operator's own

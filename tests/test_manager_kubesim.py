@@ -278,14 +278,14 @@ def test_generation_fanout_and_gc_over_the_wire(cluster):
 def test_kubesim_dev_mode_once_converges():
     """`tpu-operator --kubesim --simulate-kubelet --once` is the dev loop
     with wire semantics: one process, in-process apiserver, exit 0 on
-    Ready."""
+    Ready — including at fleet scale via --nodes."""
     import subprocess
     import sys
 
     res = subprocess.run(
         [
             sys.executable, "-m", "tpu_operator.main",
-            "--kubesim", "--simulate-kubelet", "--once",
+            "--kubesim", "--simulate-kubelet", "--once", "--nodes", "3",
             "--metrics-port", "0", "--probe-port", "0",
         ],
         env=dict(os.environ, OPERATOR_NAMESPACE="tpu-operator"),
@@ -295,3 +295,4 @@ def test_kubesim_dev_mode_once_converges():
     )
     assert res.returncode == 0, res.stderr[-2000:]
     assert "ready=True" in res.stderr
+    assert "3 nodes" in res.stderr

@@ -61,6 +61,13 @@ def build_args(argv=None):
         help="(with --fake/--kubesim) mark DaemonSets scheduled/available "
         "and run their pods, so the cluster converges to Ready",
     )
+    p.add_argument(
+        "--nodes",
+        type=int,
+        default=1,
+        help="(with --kubesim) how many simulated TPU nodes to seed — the "
+        "dev loop at fleet scale",
+    )
     p.add_argument("--log-level", default="INFO")
     p.add_argument(
         "--once",
@@ -149,17 +156,24 @@ def wire_event_sources(mgr, client, namespace: str, stop_event=None) -> None:
         threading.Thread(target=poll, daemon=True).start()
 
 
-def make_kubesim_client():
+def make_kubesim_client(n_nodes: int = 1):
     """An in-process kubesim apiserver seeded like ``make_fake_client``
-    (namespace, CRD, one TPU node, the sample CR), reached through the
-    production ``RestClient`` — the dev loop with wire semantics."""
+    (namespace, CRD, ``n_nodes`` TPU nodes, the sample CR), reached
+    through the production ``RestClient`` — the dev loop with wire
+    semantics."""
     from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
     from tpu_operator.kube.testing import seed_cluster
 
     ns = os.environ.setdefault(consts.OPERATOR_NAMESPACE_ENV, consts.DEFAULT_NAMESPACE)
     server = KubeSimServer(KubeSim()).start()
     client = make_client(server.port)
-    seed_cluster(client, ns)
+    seed_cluster(
+        client,
+        ns,
+        node_names=tuple(
+            f"fake-tpu-node-{i + 1}" for i in range(max(1, n_nodes))
+        ),
+    )
     client._kubesim_server = server  # keep the server alive with the client
     return client
 
@@ -184,14 +198,24 @@ def make_fake_client():
     return client
 
 
-def _simulate_kubelet(client, namespace: str) -> None:
-    """Dev-mode kubelet loop (shared single-pass helper keeps this in sync
-    with the test suite's simulation)."""
-    from tpu_operator.kube.testing import simulate_kubelet_once
+def _simulate_kubelet(client, namespace: str, node_names=None) -> None:
+    """Dev-mode kubelet loop (shared single-pass helpers keep this in sync
+    with the test suite's simulation). Multi-node pools get the faithful
+    per-node kubelet (nodeSelector-aware, real OnDelete semantics — a
+    libtpu spec change then rolls through the upgrade FSM, as on a real
+    cluster); the single-node loop keeps the stale-refresh shortcut so
+    quick spec edits converge without enabling autoUpgrade."""
+    from tpu_operator.kube.testing import (
+        simulate_kubelet_nodes,
+        simulate_kubelet_once,
+    )
 
     while True:
         try:
-            simulate_kubelet_once(client, namespace)
+            if node_names and len(node_names) > 1:
+                simulate_kubelet_nodes(client, namespace, node_names)
+            else:
+                simulate_kubelet_once(client, namespace)
         except Exception:
             logging.getLogger("tpu-operator").exception("kubelet sim error")
         time.sleep(1)
@@ -205,11 +229,17 @@ def main(argv=None) -> int:
     )
     log = logging.getLogger("tpu-operator")
 
+    node_names = None
     if args.fake:
         client = make_fake_client()
     elif args.kubesim:
-        client = make_kubesim_client()
-        log.info("kubesim apiserver started in-process")
+        client = make_kubesim_client(args.nodes)
+        node_names = [f"fake-tpu-node-{i + 1}" for i in range(max(1, args.nodes))]
+        log.info(
+            "kubesim apiserver started in-process (%d node%s)",
+            max(1, args.nodes),
+            "s" if args.nodes > 1 else "",
+        )
     else:
         from tpu_operator.kube.rest import RestClient
 
@@ -240,12 +270,18 @@ def main(argv=None) -> int:
 
     if args.once:
         if (args.fake or args.kubesim) and args.simulate_kubelet:
-            from tpu_operator.kube.testing import simulate_kubelet_once
+            from tpu_operator.kube.testing import (
+                simulate_kubelet_nodes,
+                simulate_kubelet_once,
+            )
 
             # converge like the fake e2e: reconcile + kubelet sim rounds
             for _ in range(30):
                 res = reconciler.reconcile()
-                simulate_kubelet_once(client, namespace)
+                if node_names and len(node_names) > 1:
+                    simulate_kubelet_nodes(client, namespace, node_names)
+                else:
+                    simulate_kubelet_once(client, namespace)
                 if res.ready:
                     break
         else:
@@ -258,7 +294,9 @@ def main(argv=None) -> int:
 
     if (args.fake or args.kubesim) and args.simulate_kubelet:
         threading.Thread(
-            target=_simulate_kubelet, args=(client, namespace), daemon=True
+            target=_simulate_kubelet,
+            args=(client, namespace, node_names),
+            daemon=True,
         ).start()
 
     mgr.enqueue(CP_KEY)

@@ -134,10 +134,13 @@ def test_chaos_churn_then_converge():
                 pass
             time.sleep(rng.uniform(0.02, 0.15))
 
+    chaos_halt = threading.Event()
+    chaos_thread = threading.Thread(
+        target=chaos, args=(chaos_halt,), daemon=True
+    )
     try:
-        with running_operator(
-            client, NS, nodes, extra_threads=(chaos,)
-        ) as mgr:
+        chaos_thread.start()
+        with running_operator(client, NS, nodes):
             # enable rolling upgrades so version bumps drive the FSM
             # through the whole storm
             mutate_cp(
@@ -149,9 +152,13 @@ def test_chaos_churn_then_converge():
                     }
                 )
             )
-
-            # let the storm blow itself out
-            time.sleep(CHURN_S + 1.0)
+            time.sleep(CHURN_S / 2)
+        # the operator CRASHES in the middle of the storm (the storm keeps
+        # raging); a fresh process must pick everything up from cluster
+        # state alone
+        with running_operator(client, NS, nodes) as mgr:
+            # let the rest of the storm blow itself out
+            time.sleep(CHURN_S / 2 + 1.0)
 
             # restore a deterministic goal state: exporter on, and
             # whatever nodes survived stay
@@ -240,4 +247,6 @@ def test_chaos_churn_then_converge():
                 lambda: mgr._last_reconcile_ok, 30
             ), "worker wedged after chaos"
     finally:
+        chaos_halt.set()
+        chaos_thread.join(timeout=5)
         server.stop()

@@ -203,6 +203,46 @@ def test_skip_drain_label(cluster):
     # (drain skip only skips the drain step)
 
 
+def test_uncordon_defers_during_host_maintenance(cluster):
+    """A maintenance window opening mid-upgrade owns the cordon: the FSM
+    parks in uncordon-required (uncordoning would hand the scheduler a
+    node about to lose its chips, and the maintenance handler — which
+    found the node already cordoned — will not restore it at all-clear)
+    and finishes only once the window clears."""
+    client = cluster
+    mgr = us.ClusterUpgradeStateManager(client, NS)
+    for i in (1, 2, 3, 4):
+        client.create(validator_pod(f"node-{i}"))
+    policy = UpgradePolicySpec(auto_upgrade=True, max_parallel_upgrades=4,
+                               max_unavailable="100%")
+
+    # walk node-1 to validation-required, then open a maintenance window
+    for _ in range(6):
+        mgr.apply_state(mgr.build_state(), policy)
+    node = client.get("v1", "Node", "node-1")
+    node["metadata"]["labels"][consts.MAINTENANCE_STATE_LABEL] = "pending"
+    client.update(node)
+
+    for _ in range(6):
+        mgr.apply_state(mgr.build_state(), policy)
+    # everyone else finished; node-1 parks cordoned in uncordon-required
+    for i in (2, 3, 4):
+        assert node_state(client, f"node-{i}") == us.STATE_DONE
+    assert node_state(client, "node-1") == us.STATE_UNCORDON_REQUIRED
+    assert client.get("v1", "Node", "node-1")["spec"]["unschedulable"] is True
+
+    # the window clears (the handler leaves the node cordoned: it found
+    # it cordoned); the FSM then finishes its own cordon
+    node = client.get("v1", "Node", "node-1")
+    del node["metadata"]["labels"][consts.MAINTENANCE_STATE_LABEL]
+    client.update(node)
+    mgr.apply_state(mgr.build_state(), policy)
+    assert node_state(client, "node-1") == us.STATE_DONE
+    assert not client.get("v1", "Node", "node-1")["spec"].get(
+        "unschedulable", False
+    )
+
+
 def test_parse_max_unavailable():
     assert us.parse_max_unavailable("25%", 4) == 1
     assert us.parse_max_unavailable("50%", 4) == 2

@@ -573,6 +573,20 @@ class ClusterUpgradeStateManager:
                 )
 
         for ns in state.node_states.get(STATE_UNCORDON_REQUIRED, []):
+            labels = ns.node["metadata"].get("labels", {}) or {}
+            if labels.get(consts.MAINTENANCE_STATE_LABEL):
+                # an active host-maintenance window owns the cordon now:
+                # uncordoning would hand the scheduler a node about to
+                # lose its chips, and the maintenance handler (which
+                # found the node already cordoned by this FSM) will NOT
+                # uncordon at all-clear. Stay in uncordon-required; the
+                # level-triggered reconcile finishes the upgrade once the
+                # window clears.
+                log.info(
+                    "node %s: deferring uncordon during host maintenance",
+                    ns.node["metadata"]["name"],
+                )
+                continue
             self.cordon.uncordon(ns.node["metadata"]["name"])
             self.provider.set_state(ns.node, STATE_DONE)
 

@@ -227,6 +227,36 @@ def test_slice_partition_2x2(slice_env):
     assert labels[consts.DEPLOY_LABEL_PREFIX + "device-plugin"] == "true"
 
 
+def test_sliceman_partition_drives_plugin_resources(slice_env):
+    """The MIG-slot handoff end to end: a slice-manager partition lands in
+    the partition file, and the device plugin's manager derives the
+    advertised resources from it under both strategies (reference MIG
+    single/mixed semantics)."""
+    from tpu_operator import consts as c
+    from tpu_operator.plugin.manager import PluginManager
+
+    client, mgr, tmp = slice_env
+    set_config(client, "all-2x2")
+    assert mgr.reconcile_once() == sm.STATE_SUCCESS
+
+    part = str(tmp / "partitions.json")
+    mixed = PluginManager(strategy="mixed", partition_file=part)
+    res = mixed.desired_resources()
+    assert set(res) == {c.TPU_SUBSLICE_RESOURCE_PREFIX + "2x2"}
+    assert len(res[c.TPU_SUBSLICE_RESOURCE_PREFIX + "2x2"]["subslices"]) == 2
+
+    single = PluginManager(strategy="single", partition_file=part)
+    res = single.desired_resources()
+    assert set(res) == {c.TPU_RESOURCE}
+    assert res[c.TPU_RESOURCE]["kind"] == "subslice"
+
+    # de-partitioning restores whole-chip advertisement
+    set_config(client, "all-disabled")
+    assert mgr.reconcile_once() == sm.STATE_SUCCESS
+    res = mixed.desired_resources()
+    assert res == {c.TPU_RESOURCE: {"kind": "chips"}}
+
+
 def test_slice_lingering_pause_recovers(slice_env):
     """A crash (or 409 storm) between apply and unpause leaves chip
     clients paused with the state label already success; the paused-client

@@ -305,9 +305,12 @@ def test_validator_workload_pod_spawn_over_the_wire(cluster):
     resources, ownerRef to the validator DS) survives real admission and
     the pod is GC'd with the DaemonSet."""
     from tpu_operator.validator.workload_pods import (
+        _per_node_name,
         jax_workload_pod,
         run_to_completion,
     )
+
+    pod_name = _per_node_name("tpu-jax-validator", "tpu-node-1")
 
     _, client = cluster
     ds = client.create(
@@ -320,7 +323,7 @@ def test_validator_workload_pod_spawn_over_the_wire(cluster):
         # the kubelet's role: run the scheduled pod to completion
         deadline = time.time() + 10
         while time.time() < deadline:
-            pod = client.get_or_none("v1", "Pod", "tpu-jax-validator", NS)
+            pod = client.get_or_none("v1", "Pod", pod_name, NS)
             if pod is not None:
                 pod["status"] = {"phase": "Succeeded"}
                 client.update_status(pod)
@@ -332,7 +335,7 @@ def test_validator_workload_pod_spawn_over_the_wire(cluster):
     pod = jax_workload_pod("tpu-node-1", NS)
     phase = run_to_completion(client, pod, retries=100, sleep_s=0.1)
     assert phase == "Succeeded"
-    live = client.get("v1", "Pod", "tpu-jax-validator", NS)
+    live = client.get("v1", "Pod", pod_name, NS)
     refs = live["metadata"]["ownerReferences"]
     assert refs[0]["uid"] == ds["metadata"]["uid"]
     assert live["spec"]["tolerations"][0]["key"] == "google.com/tpu"
@@ -341,7 +344,7 @@ def test_validator_workload_pod_spawn_over_the_wire(cluster):
     ] == "1"
     # deleting the validator DS GCs the workload pod server-side
     client.delete("apps/v1", "DaemonSet", "tpu-operator-validator", NS)
-    assert client.get_or_none("v1", "Pod", "tpu-jax-validator", NS) is None
+    assert client.get_or_none("v1", "Pod", pod_name, NS) is None
 
 
 def test_node_deletion_gcs_bound_pods(cluster):

@@ -145,9 +145,12 @@ def test_validate_plugin_with_workload(status, monkeypatch):
     info = comp.validate_plugin(
         status, client, "n1", with_workload=True, namespace=NS, retries=1, sleep_s=0
     )
-    assert info["workload"] == "tpu-plugin-validator"
+    from tpu_operator.validator.workload_pods import _per_node_name
+
+    expect = _per_node_name("tpu-plugin-validator", "n1")
+    assert info["workload"] == expect
     # pod resources request exactly one chip (reference plugin-workload pod)
-    pod = client.get("v1", "Pod", "tpu-plugin-validator", NS)
+    pod = client.get("v1", "Pod", expect, NS)
     assert pod["spec"]["containers"][0]["resources"]["limits"] == {
         consts.TPU_RESOURCE: "1"
     }
@@ -390,3 +393,28 @@ def test_vm_device_manager_to_validator_roundtrip(tmp_path, status):
         retries=1,
     )
     assert info["devices"] == 1
+
+
+def test_workload_pod_names_are_per_node():
+    """Concurrent bring-up on a multi-host pool: each node's validator
+    spawns its OWN workload pod — a fixed name would have validators
+    deleting each other's in-flight pods. Names stay DNS-1123-safe and
+    under the 63-char label limit even for long node names."""
+    from tpu_operator.validator.workload_pods import (
+        jax_workload_pod,
+        plugin_workload_pod,
+    )
+
+    names = set()
+    long_node = "gke-tpu-cluster-np-v5p-64-very-long-pool-name-abcdef012345-node-7"
+    for node in ("host-0", "host-1", long_node, long_node + "x"):
+        for factory in (jax_workload_pod, plugin_workload_pod):
+            pod = factory(node, "tpu-operator")
+            name = pod["metadata"]["name"]
+            assert name not in names, f"collision for {node}"
+            names.add(name)
+            assert len(name) <= 63
+            import re
+
+            assert re.fullmatch(r"[a-z0-9]([a-z0-9-]*[a-z0-9])?", name), name
+            assert pod["metadata"]["labels"]["app"] == name

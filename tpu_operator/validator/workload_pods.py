@@ -89,6 +89,22 @@ def _workload_pod(
     }
 
 
+def _per_node_name(base: str, node_name: str) -> str:
+    """Pod name unique PER NODE: every TPU node's validator spawns its own
+    workload pod into the shared operator namespace, and a fixed name
+    would make concurrent bring-up (a 16-host v5p pool) delete each
+    other's in-flight pods. Sanitized + length-bounded (DNS-1123), with a
+    short hash so truncation cannot collide."""
+    import hashlib
+    import re
+
+    safe = re.sub(r"[^a-z0-9-]", "-", node_name.lower()).strip("-")
+    suffix = hashlib.sha1(node_name.encode()).hexdigest()[:5]
+    # the name doubles as the pod's `app` label value: stay under the
+    # 63-char label limit (longest base 20 + 1 + 30 + 1 + 5 = 57)
+    return f"{base}-{safe[:30].rstrip('-')}-{suffix}"
+
+
 def jax_workload_pod(
     node_name: str, namespace: str, image: str = ""
 ) -> dict:
@@ -98,7 +114,11 @@ def jax_workload_pod(
         "JAX_WORKLOAD_IMAGE", consts.DEFAULT_JAX_WORKLOAD_IMAGE
     )
     return _workload_pod(
-        "tpu-jax-validator", node_name, namespace, JAX_MATMUL_SCRIPT, image
+        _per_node_name("tpu-jax-validator", node_name),
+        node_name,
+        namespace,
+        JAX_MATMUL_SCRIPT,
+        image,
     )
 
 
@@ -111,7 +131,11 @@ def plugin_workload_pod(
         "JAX_WORKLOAD_IMAGE", consts.DEFAULT_JAX_WORKLOAD_IMAGE
     )
     return _workload_pod(
-        "tpu-plugin-validator", node_name, namespace, PLUGIN_SMOKE_SCRIPT, image
+        _per_node_name("tpu-plugin-validator", node_name),
+        node_name,
+        namespace,
+        PLUGIN_SMOKE_SCRIPT,
+        image,
     )
 
 
@@ -144,6 +168,11 @@ def run_to_completion(
     meta = pod["metadata"]
     ns, name = meta["namespace"], meta["name"]
     client.delete_if_exists("v1", "Pod", name, ns)
+    # pre-per-node-naming leftovers: a stuck pod from an older operator
+    # still holds its chip request and would starve the new pod forever
+    for legacy in ("tpu-jax-validator", "tpu-plugin-validator"):
+        if name != legacy and name.startswith(legacy + "-"):
+            client.delete_if_exists("v1", "Pod", legacy, ns)
     set_owner_daemonset(client, pod, ns, "tpu-operator-validator")
     client.create(pod)
     for _ in range(retries):

@@ -28,7 +28,8 @@ from tpu_operator.upgrade import upgrade_state as us
 
 NS = "tpu-operator"
 CPV = "tpu.k8s.io/v1"
-CHURN_S = 12.0
+# default storm length; override CHAOS_DURATION_S for longer local soaks
+CHURN_S = float(os.environ.get("CHAOS_DURATION_S", "12"))
 
 API_ERRORS = (ConflictError, NotFoundError, TransientAPIError, OSError)
 
@@ -44,7 +45,9 @@ def test_chaos_churn_then_converge():
     # deterministic in CI; override CHAOS_SEED to shake new interleavings
     rng = random.Random(int(os.environ.get("CHAOS_SEED", "20260730")))
     next_node = [len(base)]
-    versions = iter(f"2026.{i}.0" for i in range(1, 50))
+    import itertools
+
+    versions = (f"2026.{i}.0" for i in itertools.count(1))  # unbounded: long soaks bump >49 times
 
     def mutate_cp(fn):
         for _ in range(10):

@@ -13,8 +13,6 @@ import random
 import threading
 import time
 
-import pytest
-
 os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
 os.environ.setdefault("UNIT_TEST", "true")
 

@@ -4,8 +4,6 @@ every caller assumes: coordinate round-trips, exact tiling, allocation
 contracts (count, uniqueness, must-include, contiguity when possible),
 and maxUnavailable scaling bounds."""
 
-import itertools
-
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -9,8 +9,8 @@ uncordon -> done, throttled to one node in flight by
 ``maxParallelUpgrades``."""
 
 import os
-import threading
 import time
+
 import pytest
 
 os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
@@ -18,11 +18,9 @@ os.environ.setdefault("UNIT_TEST", "true")
 
 from tests.conftest import running_operator as _running_operator, wait_until
 from tpu_operator import consts
-from tpu_operator.kube.client import ConflictError, NotFoundError
 from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
 from tpu_operator.kube.rest import TransientAPIError
-from tpu_operator.kube.testing import seed_cluster, simulate_kubelet_nodes
-from tpu_operator.main import CP_KEY, UPGRADE_KEY, build_manager, wire_event_sources
+from tpu_operator.kube.testing import seed_cluster
 from tpu_operator.upgrade import upgrade_state as us
 
 NS = "tpu-operator"

@@ -17,7 +17,6 @@ from datetime import datetime, timezone
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
-from tpu_operator import consts
 from tpu_operator.kube.client import Client
 
 log = logging.getLogger("tpu-operator.manager")

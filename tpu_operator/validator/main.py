@@ -28,6 +28,7 @@ COMPONENTS = (
     "pipeline",
     "moe",
     "membw",
+    "flashattn",
     "vfio-pci",
     "vm-manager",
     "vm-devices",
@@ -86,6 +87,18 @@ def build_parser():
         type=int,
         default=int(os.environ.get("RINGATTN_SEQ_LEN", "2048")),
         help="total sequence length for the context-parallel probe",
+    )
+    p.add_argument(
+        "--flashattn-seq",
+        type=int,
+        default=2048,
+        help="flash-attention probe sequence length (shrink for CPU/dev)",
+    )
+    p.add_argument(
+        "--flashattn-heads",
+        type=int,
+        default=4,
+        help="flash-attention probe head count",
     )
     p.add_argument(
         "--membw-min-utilization",
@@ -189,6 +202,13 @@ def main(argv=None) -> int:
         elif args.component == "moe":
             info = comp.validate_moe(
                 status, expect_devices=args.expect_devices
+            )
+        elif args.component == "flashattn":
+            info = comp.validate_flashattn(
+                status,
+                seq=args.flashattn_seq,
+                heads=args.flashattn_heads,
+                expect_tpu=not args.allow_cpu,
             )
         elif args.component == "membw":
             info = comp.validate_membw(

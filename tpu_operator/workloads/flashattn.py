@@ -1,0 +1,274 @@
+"""Flash-attention forward as a pallas TPU kernel — the hot-op depth
+probe.
+
+The reference's validation workloads stop at CUDA ``vectorAdd``; the
+TPU-native validator already proves the MXU (matmul), HBM (pallas DMA
+memcpy) and ICI (ring/collective probes). This kernel proves the
+``pallas`` path XLA cannot fuse on its own: blockwise attention with
+ONLINE softmax — running max + denominator carried in f32 across K/V
+blocks while the MXU consumes bf16 tiles — the memory-bound pattern that
+dominates long-context serving (same math the ring-attention probe runs
+ACROSS chips via ppermute, here tiled WITHIN one chip's VMEM).
+
+Numerics are validated against naive full attention in f32; throughput
+is reported as achieved TFLOPS over the exact FLOPs the causal tiling
+performs (skipped upper-triangle blocks are not counted).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+LANES = 128  # TPU lane width; head_dim is kept lane-aligned
+
+
+def diag_stop(i, block_q: int, block_k: int):
+    """K-blocks a causal q-block ``i`` must process: through the block
+    containing its last row. The single source for both the kernel's loop
+    bound and the FLOPs accounting — they must never drift, or reported
+    TFLOPS is computed against the wrong work. ``seq % block_k == 0``
+    (enforced at build) keeps this <= n_k_blocks. Works on python ints
+    and traced values alike."""
+    return ((i + 1) * block_q + block_k - 1) // block_k
+
+
+@dataclass
+class FlashAttnResult:
+    ok: bool
+    platform: str = ""
+    device_kind: str = ""
+    seq: int = 0
+    heads: int = 0
+    head_dim: int = 0
+    causal: bool = True
+    max_err: float = 0.0
+    tflops: float = 0.0
+    elapsed_s: float = 0.0
+    error: str = ""
+
+    def to_dict(self):
+        return {
+            "ok": self.ok,
+            "platform": self.platform,
+            "device_kind": self.device_kind,
+            "seq": self.seq,
+            "heads": self.heads,
+            "head_dim": self.head_dim,
+            "causal": self.causal,
+            "max_err": round(self.max_err, 6),
+            "tflops": round(self.tflops, 2),
+            "elapsed_s": round(self.elapsed_s, 4),
+        }
+
+
+def make_flash_fn(
+    seq: int,
+    heads: int,
+    head_dim: int = LANES,
+    block_q: int = 256,
+    block_k: int = 1024,
+    causal: bool = True,
+    interpret: bool = False,
+):
+    """Build the jitted flash-attention forward over ``(H, S, D)`` bf16
+    Q/K/V. Grid is (head, q-block); each kernel instance streams K/V
+    blocks for its head with a running-max/denominator carry (the flash
+    recurrence), masking nothing it can skip: causal q-blocks stop at
+    their diagonal block."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    if seq % block_q or seq % block_k:
+        raise ValueError(f"seq={seq} must tile by {block_q}/{block_k}")
+    scale = 1.0 / (head_dim**0.5)
+    n_k_blocks = seq // block_k
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        i = pl.program_id(1)
+        q = q_ref[0]  # (block_q, D) bf16 — stays bf16 for the MXU
+
+        if causal:
+            # blocks fully above the diagonal contribute nothing
+            hi = diag_stop(i, block_q, block_k)
+        else:
+            hi = n_k_blocks
+
+        def body(j, carry):
+            m, l, acc = carry
+            k = k_ref[0, pl.ds(j * block_k, block_k), :]
+            s = (
+                lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )
+            if causal:
+                qpos = i * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                kpos = j * block_k + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                s = jnp.where(qpos >= kpos, s, -jnp.inf)
+            m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = alpha * l + p.sum(axis=-1, keepdims=True)
+            v = v_ref[0, pl.ds(j * block_k, block_k), :]
+            acc_new = acc * alpha + lax.dot_general(
+                p.astype(jnp.bfloat16), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((block_q, 1), jnp.float32)
+        acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+        m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, acc0))
+        o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+    def flash(q, k, v):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((heads, seq, head_dim), q.dtype),
+            grid=(heads, seq // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, head_dim), lambda h, i: (h, i, 0)),
+                pl.BlockSpec((1, seq, head_dim), lambda h, i: (h, 0, 0)),
+                pl.BlockSpec((1, seq, head_dim), lambda h, i: (h, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, block_q, head_dim), lambda h, i: (h, i, 0)
+            ),
+            interpret=interpret,
+        )(q, k, v)
+
+    return jax.jit(flash)
+
+
+def reference_attention(q, k, v, causal: bool = True):
+    """Naive full attention in f32 — the numerics oracle."""
+    import jax.numpy as jnp
+
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("hqd,hkd->hqk", qf, kf) * scale
+    if causal:
+        seq = q.shape[1]
+        mask = jnp.tril(jnp.ones((seq, seq), bool))
+        s = jnp.where(mask[None], s, -jnp.inf)
+    p = jnp.exp(s - s.max(axis=-1, keepdims=True))
+    p = p / p.sum(axis=-1, keepdims=True)
+    return jnp.einsum("hqk,hkd->hqd", p, vf)
+
+
+def causal_flops(seq: int, heads: int, head_dim: int, block_q: int, block_k: int) -> float:
+    """Exact FLOPs the causal tiling performs: two bf16 matmuls per
+    processed (q-block, k-block) pair, skipped blocks not counted."""
+    n_q = seq // block_q
+    total_blocks = sum(diag_stop(i, block_q, block_k) for i in range(n_q))
+    return 4.0 * heads * total_blocks * block_q * block_k * head_dim
+
+
+def run_flashattn_probe(
+    seq: int = 2048,
+    heads: int = 8,
+    head_dim: int = LANES,
+    block_q: int = 256,
+    block_k: Optional[int] = None,
+    causal: bool = True,
+    iters: int = 64,
+    expect_tpu: bool = False,
+    tol: float = 2e-2,
+) -> FlashAttnResult:
+    """Correctness vs the f32 oracle, then throughput (fixed-overhead-
+    cancelling chain timing, like the matmul/membw probes; ``iters``
+    defaults high because one flash pass is only a few ms and tunnel
+    round-trips must be amortized). A reading above the chip's rated
+    matmul peak is a broken measurement and fails the probe, same policy
+    as bench's plausibility gates."""
+    try:
+        import jax
+        import jax.numpy as jnp
+    except Exception as e:  # pragma: no cover
+        return FlashAttnResult(False, error=str(e))
+    try:
+        dev = jax.devices()[0]
+        on_tpu = dev.platform == "tpu"
+        if expect_tpu and not on_tpu:
+            raise RuntimeError(f"expected TPU, found platform={dev.platform}")
+        interpret = not on_tpu
+        bk = block_k if block_k is not None else min(1024, seq)
+
+        key = jax.random.PRNGKey(11)
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (heads, seq, head_dim)
+        q = jax.random.normal(kq, shape, jnp.bfloat16)
+        k = jax.random.normal(kk, shape, jnp.bfloat16)
+        v = jax.random.normal(kv, shape, jnp.bfloat16)
+
+        flash = make_flash_fn(
+            seq, heads, head_dim, block_q, bk, causal, interpret
+        )
+        out = flash(q, k, v)
+        ref = reference_attention(q, k, v, causal)
+        max_err = float(
+            jnp.max(jnp.abs(out.astype(jnp.float32) - ref))
+        )
+        if not max_err < tol:
+            raise RuntimeError(
+                f"flash attention diverged from the oracle: max_err={max_err}"
+            )
+
+        flops = (
+            causal_flops(seq, heads, head_dim, block_q, bk)
+            if causal
+            else 4.0 * heads * seq * seq * head_dim
+        )
+        if on_tpu:
+            from tpu_operator.workloads.timing import chain_per_iter_seconds
+
+            # chain through q so iterations can't overlap on-device
+            def step(x):
+                return flash(x, k, v)
+
+            def force(x):
+                return float(jnp.sum(x[0, 0, :8]))
+
+            per_iter = chain_per_iter_seconds(step, q, force, iters)
+            tflops = flops / per_iter / 1e12
+            elapsed = per_iter * iters
+            from tpu_operator.workloads.matmul import device_generation
+            from tpu_operator.workloads.topology import PEAK_BF16_TFLOPS
+
+            gen = device_generation(dev.device_kind)
+            peak = PEAK_BF16_TFLOPS.get(gen) if gen else None
+            if peak and tflops > peak * 1.05:
+                raise RuntimeError(
+                    f"implausible flash-attention rate ({tflops:.0f} TFLOPS "
+                    f"vs peak {peak}); timing sync failure — rerun"
+                )
+        else:
+            tflops = 0.0  # interpret mode: numerics only
+            elapsed = 0.0
+        return FlashAttnResult(
+            ok=True,
+            platform=dev.platform,
+            device_kind=dev.device_kind,
+            seq=seq,
+            heads=heads,
+            head_dim=head_dim,
+            causal=causal,
+            max_err=max_err,
+            tflops=tflops,
+            elapsed_s=elapsed,
+        )
+    except Exception as e:
+        return FlashAttnResult(False, error=str(e))

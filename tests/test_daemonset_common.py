@@ -403,22 +403,24 @@ def test_ringattn_validation_opt_in(monkeypatch):
     cr["spec"]["validator"]["ici"] = {"enabled": True}
     cr["spec"]["validator"]["pipeline"] = {"enabled": True}
     cr["spec"]["validator"]["moe"] = {"enabled": True}
+    cr["spec"]["validator"]["flashattn"] = {"enabled": True}
     client = reconcile_with(cr, monkeypatch)
     ds = get_ds(client, "tpu-operator-validator")
     inits = ds["spec"]["template"]["spec"]["initContainers"]
     names = [c["name"] for c in inits]
     jax_idx = names.index("jax-validation")
-    assert names[jax_idx + 1 : jax_idx + 6] == [
+    assert names[jax_idx + 1 : jax_idx + 7] == [
         "membw-validation",
         "ringattn-validation",
         "ici-validation",
         "pipeline-validation",
         "moe-validation",
+        "flashattn-validation",
     ]
     ra = inits[names.index("ringattn-validation")]
     assert ra["args"] == ["tpu-validator --component ringattn"]
     env = {e["name"]: e.get("value") for e in ra.get("env", [])}
     assert env.get("RINGATTN_SEQ_LEN") == "4096"
-    for comp in ("ici", "pipeline", "moe"):
+    for comp in ("ici", "pipeline", "moe", "flashattn"):
         c = inits[names.index(f"{comp}-validation")]
         assert c["args"] == [f"tpu-validator --component {comp}"]

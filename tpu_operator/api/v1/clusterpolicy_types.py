@@ -568,6 +568,10 @@ class ValidatorSpec(_ImageSpec):
     pipeline: Optional[Dict[str, Any]] = None
     # optional expert-parallel probe: MoE all_to_all dispatch/combine
     moe: Optional[Dict[str, Any]] = None
+    # optional pallas hot-op probe: single-chip flash attention with
+    # online softmax checked against full attention (see
+    # workloads/flashattn.py); off by default (chip-holding)
+    flashattn: Optional[Dict[str, Any]] = None
 
     ENV_VAR = "TPU_VALIDATOR_IMAGE"
 

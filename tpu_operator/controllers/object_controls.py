@@ -702,6 +702,7 @@ def transform_validator(n, ds: Obj, generation: Optional[str] = None) -> None:
         ("ici", spec.ici),
         ("pipeline", spec.pipeline),
         ("moe", spec.moe),
+        ("flashattn", spec.flashattn),
     )
     diag_ctr_names = tuple(f"{name}-validation" for name, _ in optional_diags)
     for comp_name, comp_spec in optional_diags:
@@ -737,6 +738,7 @@ def transform_validator(n, ds: Obj, generation: Optional[str] = None) -> None:
             "ici-validation": spec.ici,
             "pipeline-validation": spec.pipeline,
             "moe-validation": spec.moe,
+            "flashattn-validation": spec.flashattn,
         }.get(c["name"])
         for e in (component_env or {}).get("env", []) or []:
             _set_container_env(c, e["name"], e["value"])

@@ -423,6 +423,9 @@ def main() -> int:
             assert self.headers.get("Metadata-Flavor") == "Google"
             body = meta_state["event"].encode()
             self.send_response(200)
+            # real GCE responses carry the flavor marker; the handler now
+            # rejects responses without it (captive-portal hardening)
+            self.send_header("Metadata-Flavor", "Google")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

@@ -237,6 +237,130 @@ def test_metadata_unreachable_reads_unknown():
     )
 
 
+def _metadata_server(body: bytes, flavor: bool = True):
+    """One-shot local HTTP server standing in for the GCE metadata
+    endpoint; returns (thread, url)."""
+    import http.server
+    import threading
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            if flavor:
+                self.send_header("Metadata-Flavor", "Google")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, f"http://127.0.0.1:{srv.server_port}/maintenance-event"
+
+
+def test_known_bodies_pass_through():
+    for body in (b"NONE", b"MIGRATE_ON_HOST_MAINTENANCE", b""):
+        srv, url = _metadata_server(body)
+        try:
+            got = read_maintenance_event(url, timeout_s=2)
+            assert got == (body.decode() or EVENT_NONE)
+        finally:
+            srv.shutdown()
+
+
+def test_arbitrary_200_body_reads_unknown():
+    """A captive portal / proxy error page answering 200 with arbitrary
+    text must NOT read as an active window — that would evict live
+    training workloads on every poll (advisor finding, round 2)."""
+    srv, url = _metadata_server(b"<html>hotel wifi login</html>")
+    try:
+        assert read_maintenance_event(url, timeout_s=2) is None
+    finally:
+        srv.shutdown()
+
+
+def test_missing_metadata_flavor_header_reads_unknown():
+    """A 200 lacking the Metadata-Flavor: Google marker is not the GCE
+    metadata server — even if the body happens to say NONE."""
+    srv, url = _metadata_server(b"NONE", flavor=False)
+    try:
+        assert read_maintenance_event(url, timeout_s=2) is None
+    finally:
+        srv.shutdown()
+
+
+def test_all_clear_defers_uncordon_to_upgrade_fsm(env):
+    """If the upgrade FSM cordoned the node mid-window, the maintenance
+    all-clear must not uncordon it mid-drain/mid-libtpu-swap — the FSM
+    owns the cordon until its own uncordon step (advisor finding,
+    round 2: the reverse interleaving of upgrade_state's maintenance
+    deferral)."""
+    from tpu_operator.kube.client import mutate_with_retry
+    from tpu_operator.upgrade.upgrade_state import STATE_DRAIN_REQUIRED
+
+    client, handler, feed = env
+    feed["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+    handler.reconcile_once()
+
+    # upgrade FSM takes the node mid-window: it finds the node already
+    # cordoned (by us) and records initial-state=cordoned, exactly as
+    # build_state does (upgrade_state.py:397-404)
+    def fsm_cordon(node_obj):
+        node_obj["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] = (
+            STATE_DRAIN_REQUIRED
+        )
+        node_obj["metadata"].setdefault("annotations", {})[
+            consts.UPGRADE_INITIAL_STATE_ANNOTATION
+        ] = "true"
+        node_obj["spec"]["unschedulable"] = True
+        return True
+
+    mutate_with_retry(client, "v1", "Node", NODE, mutate=fsm_cordon)
+
+    feed["event"] = EVENT_NONE
+    handler.reconcile_once()
+    n = node(client)
+    assert n["spec"]["unschedulable"] is True, (
+        "all-clear uncordoned a node the upgrade FSM still holds"
+    )
+    # maintenance bookkeeping is still cleaned up
+    assert consts.MAINTENANCE_STATE_LABEL not in n["metadata"]["labels"]
+    # ownership transfer, not just deferral: the FSM recorded OUR cordon
+    # as the node's initial state; with the maintenance annotation popped,
+    # nobody would ever uncordon unless the all-clear also clears the
+    # FSM's initial-state memory so the FSM uncordons at completion
+    assert (
+        consts.UPGRADE_INITIAL_STATE_ANNOTATION
+        not in n["metadata"].get("annotations", {})
+    ), "FSM would skip its uncordon forever (permanent capacity loss)"
+    events = client.list("v1", "Event", NS)
+    assert any(
+        "upgrade in progress" in e.get("message", "")
+        for e in events
+        if e.get("reason") == "HostMaintenanceCleared"
+    )
+
+
+def test_event_message_reflects_what_happened(env):
+    """The Imminent event must not claim evictions that never happened
+    (cordon-only mode / empty node)."""
+    client, handler, feed = env
+    handler.evict = False
+    feed["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+    handler.reconcile_once()
+    events = client.list("v1", "Event", NS)
+    msgs = [
+        e["message"]
+        for e in events
+        if e.get("reason") == "HostMaintenanceImminent"
+    ]
+    assert msgs and all("eviction disabled" in m for m in msgs)
+    assert not any("evicted" in m for m in msgs)
+
+
 def test_fleet_gauge_counts_nodes_under_maintenance(env, monkeypatch):
     """The operator's fleet metrics expose how many nodes sit in an
     active maintenance window."""

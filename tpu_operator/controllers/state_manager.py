@@ -32,7 +32,13 @@ from tpu_operator.controllers.resource_manager import (
     Resources,
     add_resources_controls,
 )
-from tpu_operator.kube.client import Client, Obj
+from tpu_operator.kube.client import (
+    Client,
+    ConflictError,
+    NotFoundError,
+    Obj,
+    mutate_with_retry,
+)
 
 log = logging.getLogger("tpu-operator.state")
 
@@ -212,29 +218,66 @@ class ClusterPolicyController:
             labels = node["metadata"].setdefault("labels", {})
             if any(k.startswith("feature.node.kubernetes.io/") for k in labels):
                 self.has_nfd_labels = True
-            changed = False
             if has_tpu_labels(node):
                 self.has_tpu_nodes = True
                 self.tpu_node_count += 1
                 gen = node_generation(node)
                 if gen:
                     self.tpu_generations.add(gen)
-                    if labels.get(f"{consts.GROUP}/tpu.generation") != gen:
-                        labels[f"{consts.GROUP}/tpu.generation"] = gen
-                        changed = True
-                if labels.get(consts.TPU_PRESENT_LABEL) != "true":
-                    labels[consts.TPU_PRESENT_LABEL] = "true"
+            if self._apply_node_labels(node):
+                # Node labels are the shared bus: TFD, the slice manager,
+                # the maintenance handler and the upgrade FSM all write
+                # concurrently. Fast path writes the listed snapshot; a
+                # 409 re-GETs and re-applies instead of aborting init()
+                # and failing the whole reconcile to the rate limiter
+                # (every other Node writer already follows this
+                # discipline — kube/client.py mutate_with_retry).
+                name = node["metadata"]["name"]
+                try:
+                    self.client.update(node)
+                except ConflictError:
+                    try:
+                        mutate_with_retry(
+                            self.client,
+                            "v1",
+                            "Node",
+                            name,
+                            mutate=self._apply_node_labels,
+                        )
+                    except ConflictError:
+                        log.warning(
+                            "node %s label write kept conflicting; the "
+                            "requeue will converge it",
+                            name,
+                        )
+                    except NotFoundError:
+                        # deleted between the 409 and the re-GET
+                        log.info("node %s vanished during labeling", name)
+                except NotFoundError:
+                    log.info("node %s vanished during labeling", name)
+
+    def _apply_node_labels(self, node: Obj) -> bool:
+        """Mutate one Node's operator labels in place; returns whether
+        anything changed (the ``mutate_with_retry`` contract)."""
+        labels = node["metadata"].setdefault("labels", {})
+        changed = False
+        if has_tpu_labels(node):
+            gen = node_generation(node)
+            if gen and labels.get(f"{consts.GROUP}/tpu.generation") != gen:
+                labels[f"{consts.GROUP}/tpu.generation"] = gen
+                changed = True
+            if labels.get(consts.TPU_PRESENT_LABEL) != "true":
+                labels[consts.TPU_PRESENT_LABEL] = "true"
+                changed = True
+            changed |= self._update_state_labels(node)
+        elif labels.get(consts.TPU_PRESENT_LABEL):
+            # TPU removed from node: strip all operator labels
+            # (reference removeAllGPUStateLabels)
+            for key in list(labels):
+                if key.startswith(f"{consts.GROUP}/"):
+                    del labels[key]
                     changed = True
-                changed |= self._update_state_labels(node)
-            elif labels.get(consts.TPU_PRESENT_LABEL):
-                # TPU removed from node: strip all operator labels
-                # (reference removeAllGPUStateLabels)
-                for key in list(labels):
-                    if key.startswith(f"{consts.GROUP}/"):
-                        del labels[key]
-                        changed = True
-            if changed:
-                self.client.update(node)
+        return changed
 
     def _update_state_labels(self, node: Obj) -> bool:
         """Per-workload-config deploy labels (reference

@@ -51,20 +51,43 @@ EVENT_NONE = "NONE"
 # about to lose its chips, and must never trigger an eviction either
 EVENT_UNKNOWN = None
 
+# the only values the GCE metadata server emits for
+# instance/maintenance-event; anything else (captive portal, proxy error
+# page, misconfigured METADATA_URL answering 200 with arbitrary text)
+# must NOT be read as an active window — it would evict live training
+# workloads on every poll
+KNOWN_EVENTS = frozenset(
+    {EVENT_NONE, "MIGRATE_ON_HOST_MAINTENANCE", "TERMINATE_ON_HOST_MAINTENANCE"}
+)
+
 STATE_PENDING = "pending"
 
 
 def read_maintenance_event(url: str, timeout_s: float = 5.0) -> Optional[str]:
     """One metadata poll. Unreachable/odd answers read as ``EVENT_UNKNOWN``
     (no state transition): a dead metadata server is neither a maintenance
-    signal nor an all-clear."""
+    signal nor an all-clear. "Odd" includes a 200 whose body is not one of
+    the documented GCE values or whose response lacks the
+    ``Metadata-Flavor: Google`` header — the anti-SSRF marker every real
+    metadata response carries."""
     req = urllib.request.Request(url, headers={"Metadata-Flavor": "Google"})
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as r:
-            return (r.read().decode() or EVENT_NONE).strip() or EVENT_NONE
+            if r.headers.get("Metadata-Flavor") != "Google":
+                log.warning(
+                    "metadata response from %s lacks Metadata-Flavor: Google; "
+                    "treating as unknown",
+                    url,
+                )
+                return EVENT_UNKNOWN
+            body = (r.read().decode() or EVENT_NONE).strip() or EVENT_NONE
     except Exception:
         log.warning("metadata poll failed for %s", url)
         return EVENT_UNKNOWN
+    if body not in KNOWN_EVENTS:
+        log.warning("unrecognized maintenance-event body %r; treating as unknown", body)
+        return EVENT_UNKNOWN
+    return body
 
 
 class MaintenanceHandler:
@@ -130,6 +153,7 @@ class MaintenanceHandler:
             return changed
 
         self._mutate_node(mutate)
+        evicted = 0
         if self.evict:
             from tpu_operator.upgrade.upgrade_state import PodManager
 
@@ -143,18 +167,27 @@ class MaintenanceHandler:
                     "evicting %d TPU pod(s) ahead of maintenance", len(victims)
                 )
                 pods.delete_pods(victims, force=self.force)
+                evicted = len(victims)
         from tpu_operator.kube.events import TYPE_WARNING
 
+        # the Event must report what actually happened: cordon-only mode
+        # and an empty node must not claim workloads were evicted
+        if not self.evict:
+            action = "node cordoned (eviction disabled)"
+        elif evicted:
+            action = f"node cordoned and {evicted} TPU workload pod(s) evicted"
+        else:
+            action = "node cordoned; no TPU workload pods to evict"
         self._event(
             TYPE_WARNING,
             "HostMaintenanceImminent",
-            f"{event}: node cordoned and TPU workloads evicted ahead of "
-            "host maintenance",
+            f"{event}: {action} ahead of host maintenance",
         )
 
     def _leave_maintenance(self) -> None:
         log.info("maintenance window cleared on %s", self.node_name)
         was_cordoned = {"value": False}
+        fsm_holds = {"value": False}
 
         def mutate(node):
             changed = False
@@ -168,8 +201,37 @@ class MaintenanceHandler:
             if initial is not None:
                 changed = True
             was_cordoned["value"] = initial == "true"
+            # the reverse interleaving of upgrade_state's maintenance
+            # deferral: if the upgrade FSM cordoned the node while our
+            # window was open, the all-clear must NOT uncordon mid-drain /
+            # mid-libtpu-swap — the FSM owns the cordon until it reaches
+            # uncordon itself (or terminal-fails, which keeps the cordon
+            # for the operator to surface)
+            from tpu_operator.upgrade.upgrade_state import (
+                ACTIVE_STATES,
+                STATE_FAILED,
+            )
+
+            fsm_state = labels.get(consts.UPGRADE_STATE_LABEL, "")
+            fsm_holds["value"] = (
+                fsm_state in ACTIVE_STATES or fsm_state == STATE_FAILED
+            )
+            if fsm_holds["value"] and not was_cordoned["value"]:
+                # hand the cordon over, don't just defer: the FSM entered
+                # while WE held the cordon, so it recorded
+                # initial-state=cordoned and would skip its own uncordon
+                # at completion (upgrade_state._to_uncordon_or_done) —
+                # with our annotation now popped, nobody would ever
+                # uncordon. Clearing the FSM's initial-state annotation
+                # makes the FSM treat the node as its own cordon and
+                # uncordon it when the upgrade finishes.
+                ann.pop(consts.UPGRADE_INITIAL_STATE_ANNOTATION, None)
             spec = node.setdefault("spec", {})
-            if not was_cordoned["value"] and spec.get("unschedulable", False):
+            if (
+                not was_cordoned["value"]
+                and not fsm_holds["value"]
+                and spec.get("unschedulable", False)
+            ):
                 spec["unschedulable"] = False
                 changed = True
             return changed
@@ -177,11 +239,16 @@ class MaintenanceHandler:
         self._mutate_node(mutate)
         from tpu_operator.kube.events import TYPE_NORMAL
 
+        if fsm_holds["value"]:
+            detail = " (left cordoned: libtpu upgrade in progress)"
+        elif was_cordoned["value"]:
+            detail = " (left cordoned: was cordoned before)"
+        else:
+            detail = ""
         self._event(
             TYPE_NORMAL,
             "HostMaintenanceCleared",
-            "maintenance window cleared; node restored"
-            + (" (left cordoned: was cordoned before)" if was_cordoned["value"] else ""),
+            "maintenance window cleared; node restored" + detail,
         )
 
     # -- the loop --------------------------------------------------------

@@ -91,13 +91,13 @@ def build_parser():
     p.add_argument(
         "--flashattn-seq",
         type=int,
-        default=2048,
+        default=int(os.environ.get("FLASHATTN_SEQ", "2048")),
         help="flash-attention probe sequence length (shrink for CPU/dev)",
     )
     p.add_argument(
         "--flashattn-heads",
         type=int,
-        default=4,
+        default=int(os.environ.get("FLASHATTN_HEADS", "4")),
         help="flash-attention probe head count",
     )
     p.add_argument(

@@ -401,6 +401,10 @@ def main() -> int:
     # operator convergence axes (subprocesses; leave this JAX state alone)
     convergence = run_convergence()
     fleet = run_fleet_convergence()
+    # 200-node fleet: proves the informer-cache read path holds its O(1)
+    # steady state (apiserver_requests_per_reconcile ≈ 0) at a scale
+    # where the round-2 live-LIST loop was O(states × nodes) per pass
+    fleet_200 = run_fleet_convergence(n_nodes=200)
 
     # ICI axis last: it re-binds JAX to the CPU mesh
     ici = run_ici_on_cpu_mesh()
@@ -427,6 +431,7 @@ def main() -> int:
         "telemetry": telemetry,
         "convergence": convergence,
         "convergence_fleet": fleet,
+        "convergence_fleet_200": fleet_200,
         "flashattn": {
             "ok": bool(fa.ok),
             "tflops": round(fa.tflops, 1),
@@ -447,6 +452,7 @@ def main() -> int:
         and mem.ok
         and convergence.get("ok")
         and fleet.get("ok")
+        and fleet_200.get("ok")
         and fa.ok
     ) else 1
 

@@ -25,6 +25,19 @@ from tpu_operator.upgrade import upgrade_state as us
 
 NS = "tpu-operator"
 CPV = "tpu.k8s.io/v1"
+
+def edit_cp(client, fn):
+    """Spec edit racing a live operator (which annotates/status-writes the
+    same CR): conflict-retried like any real controller-side writer."""
+    from tpu_operator.kube.client import mutate_with_retry
+
+    def mutate(cp):
+        fn(cp)
+        return True
+
+    mutate_with_retry(client, CPV, "ClusterPolicy", "cluster-policy", mutate=mutate)
+
+
 NODES = ("up-node-1", "up-node-2", "up-node-3")
 
 
@@ -121,20 +134,23 @@ def test_rolling_upgrade_three_nodes_over_the_wire(cluster):
             }
         )
 
-        cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
-        cp["spec"]["libtpu"]["upgradePolicy"] = {
-            "autoUpgrade": True,
-            "maxParallelUpgrades": 1,
-            "maxUnavailable": 1,
-            "drain": {"enable": True, "timeoutSeconds": 300},
-        }
-        client.update(cp)
+        edit_cp(
+            client,
+            lambda cp: cp["spec"]["libtpu"].update(
+                upgradePolicy={
+                    "autoUpgrade": True,
+                    "maxParallelUpgrades": 1,
+                    "maxUnavailable": 1,
+                    "drain": {"enable": True, "timeoutSeconds": 300},
+                }
+            ),
+        )
 
         # the version bump lands via the CR watch; the CP reconciler
         # restamps the DS template hash and the FSM takes over
-        cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
-        cp["spec"]["libtpu"]["version"] = "2025.2.0"
-        client.update(cp)
+        edit_cp(
+            client, lambda cp: cp["spec"]["libtpu"].update(version="2025.2.0")
+        )
 
         def all_done():
             nodes = [client.get("v1", "Node", n) for n in NODES]
@@ -219,15 +235,18 @@ def test_upgrade_drain_timeout_failure_recovery_and_cleanup(cluster):
             }
         )
 
-        cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
-        cp["spec"]["libtpu"]["upgradePolicy"] = {
-            "autoUpgrade": True,
-            "maxParallelUpgrades": 3,
-            "maxUnavailable": "100%",
-            "drain": {"enable": True, "timeoutSeconds": 1},
-        }
-        cp["spec"]["libtpu"]["version"] = "2025.3.0"
-        client.update(cp)
+        edit_cp(
+            client,
+            lambda cp: cp["spec"]["libtpu"].update(
+                upgradePolicy={
+                    "autoUpgrade": True,
+                    "maxParallelUpgrades": 3,
+                    "maxUnavailable": "100%",
+                    "drain": {"enable": True, "timeoutSeconds": 1},
+                },
+                version="2025.3.0",
+            ),
+        )
 
         def settled():
             labels = {n: upgrade_label(client.get("v1", "Node", n)) for n in NODES}
@@ -272,9 +291,12 @@ def test_upgrade_drain_timeout_failure_recovery_and_cleanup(cluster):
         )
 
         # disabling autoUpgrade strips the per-node FSM labels
-        cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
-        cp["spec"]["libtpu"]["upgradePolicy"]["autoUpgrade"] = False
-        client.update(cp)
+        edit_cp(
+            client,
+            lambda cp: cp["spec"]["libtpu"]["upgradePolicy"].update(
+                autoUpgrade=False
+            ),
+        )
         assert wait_until(
             lambda: all(
                 upgrade_label(client.get("v1", "Node", n)) is None for n in NODES
@@ -316,14 +338,17 @@ def test_rolling_upgrade_fleet_scale():
                 "25-node pool never converged"
             )
 
-            cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
-            cp["spec"]["libtpu"]["upgradePolicy"] = {
-                "autoUpgrade": True,
-                "maxParallelUpgrades": 6,
-                "maxUnavailable": "25%",
-            }
-            cp["spec"]["libtpu"]["version"] = "2025.5.0"
-            client.update(cp)
+            edit_cp(
+                client,
+                lambda cp: cp["spec"]["libtpu"].update(
+                    upgradePolicy={
+                        "autoUpgrade": True,
+                        "maxParallelUpgrades": 6,
+                        "maxUnavailable": "25%",
+                    },
+                    version="2025.5.0",
+                ),
+            )
 
             def all_done():
                 return all(
@@ -366,14 +391,17 @@ def test_operator_restart_mid_upgrade_resumes_fsm(cluster):
 
     with running_operator(client):
         assert wait_until(lambda: cr_state(client) == "ready", 90)
-        cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
-        cp["spec"]["libtpu"]["upgradePolicy"] = {
-            "autoUpgrade": True,
-            "maxParallelUpgrades": 1,
-            "maxUnavailable": 1,
-        }
-        cp["spec"]["libtpu"]["version"] = "2025.4.0"
-        client.update(cp)
+        edit_cp(
+            client,
+            lambda cp: cp["spec"]["libtpu"].update(
+                upgradePolicy={
+                    "autoUpgrade": True,
+                    "maxParallelUpgrades": 1,
+                    "maxUnavailable": 1,
+                },
+                version="2025.4.0",
+            ),
+        )
 
         def one_in_flight():
             return any(

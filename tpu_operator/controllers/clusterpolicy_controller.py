@@ -235,7 +235,9 @@ class ClusterPolicyReconciler:
             # fresh revision — standard status-writer retry, no logspam
             try:
                 meta = cp_obj.get("metadata", {})
-                fresh = self.client.get(
+                # live read: behind an informer cache, re-reading the
+                # cached revision would carry the same stale rv forever
+                fresh = getattr(self.client, "get_live", self.client.get)(
                     cp_obj["apiVersion"], cp_obj["kind"], meta["name"],
                     meta.get("namespace", ""),
                 )

@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional
 
 from tpu_operator import consts
 from tpu_operator.api.v1.clusterpolicy_types import State
+from tpu_operator.kube.client import ConflictError
 
 log = logging.getLogger("tpu-operator.controls")
 
@@ -132,7 +133,20 @@ def apply_with_hash(n, obj: Obj) -> str:
     merged["metadata"]["resourceVersion"] = existing["metadata"].get(
         "resourceVersion"
     )
-    n.client.update(merged)
+    try:
+        n.client.update(merged)
+    except ConflictError:
+        # the rv can be stale behind an informer cache (or the kubelet
+        # stamped status between our read and write): one live refresh —
+        # the operator owns everything but status on its operands, so
+        # re-applying the rendered manifest at the fresh rv is safe
+        fresh = getattr(n.client, "get_live", n.client.get)(
+            av, kind, meta["name"], meta.get("namespace", "")
+        )
+        merged["metadata"]["resourceVersion"] = fresh["metadata"].get(
+            "resourceVersion"
+        )
+        n.client.update(merged)
     return h
 
 

@@ -313,18 +313,22 @@ class ClusterPolicyController:
     # PSA labeling (reference setPodSecurityLabelsForNamespace, :590-638)
     # ------------------------------------------------------------------
     def set_pod_security_labels_for_namespace(self) -> None:
-        ns = self.client.get_or_none("v1", "Namespace", self.namespace)
-        if ns is None:
+        if self.client.get_or_none("v1", "Namespace", self.namespace) is None:
             return
-        labels = ns["metadata"].setdefault("labels", {})
         desired = {
             consts.PSA_LABEL_PREFIX + "enforce": "privileged",
             consts.PSA_LABEL_PREFIX + "audit": "privileged",
             consts.PSA_LABEL_PREFIX + "warn": "privileged",
         }
-        if any(labels.get(k) != v for k, v in desired.items()):
+
+        def mutate(ns):
+            labels = ns["metadata"].setdefault("labels", {})
+            if all(labels.get(k) == v for k, v in desired.items()):
+                return False
             labels.update(desired)
-            self.client.update(ns)
+            return True
+
+        mutate_with_retry(self.client, "v1", "Namespace", self.namespace, mutate=mutate)
 
     # ------------------------------------------------------------------
     # upgrade annotation (reference applyDriverAutoUpgradeAnnotation, :416-469)
@@ -332,19 +336,34 @@ class ClusterPolicyController:
     def apply_upgrade_auto_annotation(self) -> None:
         pol = self.cp.spec.libtpu.upgrade_policy
         enabled = bool(pol and pol.is_auto_upgrade_enabled())
-        obj = self.client.get_or_none(
-            consts.API_VERSION, consts.CLUSTER_POLICY_KIND, self.cp.name
-        )
-        if obj is None:
+        if (
+            self.client.get_or_none(
+                consts.API_VERSION, consts.CLUSTER_POLICY_KIND, self.cp.name
+            )
+            is None
+        ):
             return
-        ann = obj["metadata"].setdefault("annotations", {})
         want = "true" if enabled else None
-        if want is None and consts.UPGRADE_ENABLED_ANNOTATION in ann:
-            del ann[consts.UPGRADE_ENABLED_ANNOTATION]
-            self.client.update(obj)
-        elif want and ann.get(consts.UPGRADE_ENABLED_ANNOTATION) != want:
-            ann[consts.UPGRADE_ENABLED_ANNOTATION] = want
-            self.client.update(obj)
+
+        def mutate(obj):
+            ann = obj["metadata"].setdefault("annotations", {})
+            if want is None and consts.UPGRADE_ENABLED_ANNOTATION in ann:
+                del ann[consts.UPGRADE_ENABLED_ANNOTATION]
+                return True
+            if want and ann.get(consts.UPGRADE_ENABLED_ANNOTATION) != want:
+                ann[consts.UPGRADE_ENABLED_ANNOTATION] = want
+                return True
+            return False
+
+        # the CR is shared with the user's spec edits and the status
+        # writer: conflict-retried like every shared-object write
+        mutate_with_retry(
+            self.client,
+            consts.API_VERSION,
+            consts.CLUSTER_POLICY_KIND,
+            self.cp.name,
+            mutate=mutate,
+        )
 
     # ------------------------------------------------------------------
     # runtime discovery (reference getRuntime, :704-741)

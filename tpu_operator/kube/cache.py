@@ -1,0 +1,403 @@
+"""Watch-backed informer cache — the reconcile read path.
+
+The reference serves every ``Get``/``List`` from controller-runtime's
+shared, watch-fed cache (``/root/reference/main.go:88-108`` wires the
+manager's cache; the watches at
+``controllers/clusterpolicy_controller.go:317-344`` keep it warm). Without
+it, one reconcile pass re-LISTs Nodes per DaemonSet readiness check,
+re-LISTs them again for labeling, slice aggregation and upgrade
+``build_state``, and fetches all pods per node — O(states × nodes)
+apiserver reads per pass, a different complexity class than the
+reference at fleet scale.
+
+``CachedClient`` wraps any ``Client`` (the production ``RestClient`` or
+the ``FakeClient`` double) and serves reads for the operator's hot kinds
+from per-kind in-memory stores fed by list+watch streams:
+
+* **reads** (``get``/``list``) come from the informer store once that
+  kind is synced; unsynced/uncached kinds pass through live, so the
+  wrapper is a transparent proxy until ``start_informers`` runs;
+* **writes** pass through and write-through the store with the
+  apiserver's response (the new resourceVersion), so the common
+  read-your-write patterns (apply → readiness check) see fresh data
+  without waiting a watch round-trip;
+* **event hooks** observe every watch event *after* the store is
+  updated — the manager feeds its workqueue from the same streams that
+  keep the cache warm (one set of watches, exactly like
+  controller-runtime), and a reconcile triggered by an event can never
+  read a cache older than that event;
+* a per-object resourceVersion monotonicity guard drops stale events
+  racing write-throughs.
+
+Writers that need read-modify-write freshness use ``get_live`` — the
+conflict-retry path of ``mutate_with_retry`` re-GETs live after a 409,
+keeping the shared-Node discipline correct under a cache.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tpu_operator.kube.client import (
+    Client,
+    ConflictError,
+    NotFoundError,
+    Obj,
+    match_fields,
+    match_labels,
+    obj_key,
+)
+
+log = logging.getLogger("tpu-operator.cache")
+
+# (api_version, kind, namespace) — namespace "" means cluster-scoped or
+# all-namespaces. One watch stream per entry. The set mirrors what one
+# reconcile pass actually reads (state machine, object controls, upgrade
+# FSM, slice aggregation); Lease is deliberately NOT cached — leader
+# election must read live or two replicas could both believe they hold
+# an expired lease.
+def default_cache_specs(
+    api_version: str, namespace: str
+) -> List[Tuple[str, str, str]]:
+    return [
+        (api_version, "ClusterPolicy", ""),
+        ("v1", "Node", ""),
+        ("v1", "Namespace", ""),
+        ("apps/v1", "DaemonSet", namespace),
+        ("v1", "Pod", namespace),
+        ("v1", "Service", namespace),
+        ("v1", "ServiceAccount", namespace),
+        ("v1", "ConfigMap", namespace),
+        ("v1", "Event", namespace),
+        ("rbac.authorization.k8s.io/v1", "Role", namespace),
+        ("rbac.authorization.k8s.io/v1", "RoleBinding", namespace),
+        ("rbac.authorization.k8s.io/v1", "ClusterRole", ""),
+        ("rbac.authorization.k8s.io/v1", "ClusterRoleBinding", ""),
+        ("node.k8s.io/v1", "RuntimeClass", ""),
+        ("policy/v1beta1", "PodSecurityPolicy", ""),
+        ("monitoring.coreos.com/v1", "ServiceMonitor", namespace),
+        ("monitoring.coreos.com/v1", "PrometheusRule", namespace),
+    ]
+
+
+def _rv_int(obj: Obj) -> Optional[int]:
+    rv = obj.get("metadata", {}).get("resourceVersion")
+    try:
+        return int(rv)
+    except (TypeError, ValueError):
+        return None
+
+
+class Informer:
+    """One kind's watch-fed store. Thread-safe; ``synced`` is set after
+    the first full list has been delivered."""
+
+    def __init__(self, api_version: str, kind: str, namespace: str):
+        self.api_version = api_version
+        self.kind = kind
+        self.namespace = namespace
+        self.synced = threading.Event()
+        self._lock = threading.Lock()
+        self._store: Dict[Tuple[str, str], Obj] = {}  # (ns, name) -> obj
+        # deletions observed before the initial seed lands: a concurrent
+        # DELETED between list() and replace() must not be resurrected by
+        # the older snapshot
+        self._tombstones: Dict[Tuple[str, str], int] = {}
+
+    # -- event ingestion -------------------------------------------------
+    def on_event(self, etype: str, obj: Obj) -> None:
+        meta = obj.get("metadata", {})
+        key = (meta.get("namespace", ""), meta.get("name", ""))
+        if not key[1]:
+            return
+        with self._lock:
+            have = self._store.get(key)
+            # monotonicity guard: a watch event older than what a
+            # write-through already stored must not roll the cache back
+            if have is not None:
+                old_rv, new_rv = _rv_int(have), _rv_int(obj)
+                if old_rv is not None and new_rv is not None and new_rv < old_rv:
+                    return
+            if etype == "DELETED":
+                self._store.pop(key, None)
+                if not self.synced.is_set():
+                    self._tombstones[key] = _rv_int(obj) or 0
+            elif etype in ("ADDED", "MODIFIED"):
+                self._store[key] = copy.deepcopy(obj)
+
+    def replace(self, objs: List[Obj]) -> None:
+        """Guarded seed from an initial list. Events may already have
+        flowed (subscription precedes the list so nothing is missed):
+        newer store entries win over the snapshot, and keys deleted since
+        the snapshot was taken stay deleted."""
+        with self._lock:
+            for o in objs:
+                meta = o.get("metadata", {})
+                key = (meta.get("namespace", ""), meta.get("name", ""))
+                rv = _rv_int(o)
+                dead_rv = self._tombstones.get(key)
+                if dead_rv is not None and (rv is None or rv <= dead_rv):
+                    continue  # deleted after this snapshot was cut
+                have = self._store.get(key)
+                if have is not None:
+                    old_rv = _rv_int(have)
+                    if old_rv is not None and rv is not None and rv < old_rv:
+                        continue  # a live event already delivered newer state
+                self._store[key] = copy.deepcopy(o)
+            self._tombstones.clear()
+        self.synced.set()
+
+    # -- reads -----------------------------------------------------------
+    def get(self, name: str, namespace: str = "") -> Obj:
+        with self._lock:
+            obj = self._store.get((namespace or "", name))
+            if obj is None:
+                raise NotFoundError(
+                    f"{self.kind} {namespace}/{name} not found (cache)"
+                )
+            return copy.deepcopy(obj)
+
+    def list(
+        self,
+        namespace: str = "",
+        label_selector=None,
+        field_selector=None,
+    ) -> List[Obj]:
+        with self._lock:
+            out = []
+            for (ns, _), obj in sorted(self._store.items()):
+                if namespace and ns != namespace:
+                    continue
+                if not match_labels(obj, label_selector):
+                    continue
+                if field_selector and not match_fields(obj, field_selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def __len__(self):
+        with self._lock:
+            return len(self._store)
+
+
+class CachedClient(Client):
+    """``Client`` whose reads are served from watch-fed informers.
+
+    Transparent proxy until ``start_informers`` has synced a kind; after
+    that, ``get``/``list`` for cached kinds never touch the apiserver.
+    """
+
+    def __init__(
+        self,
+        client: Client,
+        namespace: str = "",
+        specs: Optional[List[Tuple[str, str, str]]] = None,
+    ):
+        from tpu_operator import consts
+
+        self.live = client
+        self.namespace = namespace
+        if specs is None:
+            specs = default_cache_specs(consts.API_VERSION, namespace)
+        self._informers: Dict[Tuple[str, str], Informer] = {
+            (av, kind): Informer(av, kind, ns) for av, kind, ns in specs
+        }
+        self._hooks: List[Callable[[str, Obj], None]] = []
+        self._started = False
+        self._threads: List[threading.Thread] = []
+
+    # -- wiring ----------------------------------------------------------
+    def add_event_hook(self, fn: Callable[[str, Obj], None]) -> None:
+        """``fn(event_type, obj)`` runs after the cache ingested the
+        event — the workqueue feed rides the same streams as the cache."""
+        self._hooks.append(fn)
+
+    def _dispatch(self, inf: Informer, etype: str, obj: Obj) -> None:
+        inf.on_event(etype, obj)
+        for fn in list(self._hooks):
+            try:
+                fn(etype, obj)
+            except Exception:
+                log.exception("cache event hook failed for %s %s", etype, inf.kind)
+
+    def start_informers(
+        self, stop_event: Optional[threading.Event] = None, timeout_s: float = 30.0
+    ) -> bool:
+        """Warm the cache before the first reconcile. Returns whether all
+        informers synced within ``timeout_s`` (on False the unsynced kinds
+        keep passing reads through live — degraded, never wrong)."""
+        if self._started:
+            return True
+        self._started = True
+        stop_event = stop_event or threading.Event()
+        if hasattr(self.live, "add_watcher"):
+            # FakeClient: synchronous in-process events; seed then subscribe
+            def fan_out(etype, obj):
+                inf = self._informers.get(
+                    (obj.get("apiVersion", ""), obj.get("kind", ""))
+                )
+                if inf is not None:
+                    self._dispatch(inf, etype, obj)
+
+            self.live.add_watcher(fan_out)
+            for (av, kind), inf in self._informers.items():
+                inf.replace(self.live.list(av, kind, inf.namespace))
+            return True
+        if not hasattr(self.live, "watch"):
+            log.warning("underlying client has no watch; cache stays passthrough")
+            return False
+        for (av, kind), inf in self._informers.items():
+            t = threading.Thread(
+                target=self.live.watch,
+                args=(av, kind, lambda e, o, i=inf: self._dispatch(i, e, o)),
+                kwargs={
+                    "namespace": inf.namespace,
+                    "stop_event": stop_event,
+                    "on_sync": inf.synced.set,
+                },
+                daemon=True,
+                name=f"informer-{kind}",
+            )
+            t.start()
+            self._threads.append(t)
+        deadline = timeout_s
+        ok = True
+        import time as _time
+
+        t0 = _time.monotonic()
+        for (_, kind), inf in self._informers.items():
+            remaining = max(0.0, deadline - (_time.monotonic() - t0))
+            if not inf.synced.wait(remaining):
+                log.warning("informer for %s not synced after %.0fs", kind, timeout_s)
+                ok = False
+        return ok
+
+    def _informer_for(
+        self, api_version: str, kind: str, namespace: str
+    ) -> Optional[Informer]:
+        inf = self._informers.get((api_version, kind))
+        if inf is None or not inf.synced.is_set():
+            return None
+        # a namespaced informer can only answer for its own namespace;
+        # "" (all) informers answer anything
+        if inf.namespace and namespace and namespace != inf.namespace:
+            return None
+        if inf.namespace and not namespace:
+            return None  # caller wants all namespaces; we hold one
+        return inf
+
+    def cache_info(self) -> Dict[str, int]:
+        return {
+            f"{kind}": len(inf)
+            for (_, kind), inf in self._informers.items()
+            if inf.synced.is_set()
+        }
+
+    # -- reads -----------------------------------------------------------
+    def get(self, api_version, kind, name, namespace=""):
+        inf = self._informer_for(api_version, kind, namespace)
+        if inf is None:
+            return self.live.get(api_version, kind, name, namespace)
+        return inf.get(name, namespace)
+
+    def get_live(self, api_version, kind, name, namespace=""):
+        """Bypass the cache — read-modify-write retry paths after a 409."""
+        return self.live.get(api_version, kind, name, namespace)
+
+    def list(
+        self,
+        api_version,
+        kind,
+        namespace="",
+        label_selector=None,
+        field_selector=None,
+    ):
+        inf = self._informer_for(api_version, kind, namespace)
+        if inf is None:
+            return self.live.list(
+                api_version, kind, namespace, label_selector, field_selector
+            )
+        return inf.list(namespace, label_selector, field_selector)
+
+    # -- writes (pass through + write-through the response) --------------
+    def _write_through(self, obj: Obj) -> None:
+        inf = self._informers.get((obj.get("apiVersion", ""), obj.get("kind", "")))
+        if inf is not None and inf.synced.is_set():
+            inf.on_event("MODIFIED", obj)
+
+    def create(self, obj):
+        created = self.live.create(obj)
+        if isinstance(created, dict):
+            self._write_through(created)
+        return created
+
+    def update(self, obj):
+        updated = self.live.update(obj)
+        if isinstance(updated, dict):
+            self._write_through(updated)
+        return updated
+
+    def update_status(self, obj):
+        updated = self.live.update_status(obj)
+        if isinstance(updated, dict):
+            self._write_through(updated)
+        return updated
+
+    def delete(self, api_version, kind, name, namespace=""):
+        self.live.delete(api_version, kind, name, namespace)
+        inf = self._informers.get((api_version, kind))
+        if inf is not None and inf.synced.is_set():
+            # immediate removal so delete→recreate flows don't trip over
+            # a cached ghost; the watch DELETED event is then a no-op
+            inf.on_event(
+                "DELETED",
+                {
+                    "apiVersion": api_version,
+                    "kind": kind,
+                    "metadata": {"namespace": namespace, "name": name},
+                },
+            )
+
+    def delete_if_exists(self, api_version, kind, name, namespace=""):
+        """Probe the cache before issuing the DELETE: disabled-state
+        controls call this every pass for operands that were never
+        deployed, and a blind DELETE-then-404 per pass defeats the O(0)
+        steady state (the reference reads its cache before deleting,
+        object_controls.go:3753-3761). A stale-cache miss self-heals:
+        the ADDED watch event re-enqueues a reconcile."""
+        inf = self._informer_for(api_version, kind, namespace)
+        if inf is not None:
+            try:
+                inf.get(name, namespace)
+            except NotFoundError:
+                return False
+        return super().delete_if_exists(api_version, kind, name, namespace)
+
+    def apply(self, obj):
+        """Create-or-update where the existence probe may be cached: a
+        stale miss turning into 409 AlreadyExists falls back to a live
+        read + update instead of failing the reconcile."""
+        av, kind, ns, name = obj_key(obj)
+        existing = self.get_or_none(av, kind, name, ns)
+        if existing is None:
+            try:
+                return self.create(obj)
+            except ConflictError:
+                existing = self.live.get(av, kind, name, ns)
+        obj = copy.deepcopy(obj)
+        obj.setdefault("metadata", {})["resourceVersion"] = existing[
+            "metadata"
+        ].get("resourceVersion")
+        try:
+            return self.update(obj)
+        except ConflictError:
+            # cached rv was stale; one live refresh, then give up to the
+            # level-triggered requeue
+            fresh = self.live.get(av, kind, name, ns)
+            obj["metadata"]["resourceVersion"] = fresh["metadata"].get(
+                "resourceVersion"
+            )
+            return self.update(obj)

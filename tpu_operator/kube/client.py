@@ -56,7 +56,15 @@ def mutate_with_retry(
     for attempt in range(attempts):
         if attempt:
             time.sleep(backoff_s * attempt)
-        obj = client.get(api_version, kind, name, namespace)
+        if attempt == 0:
+            obj = client.get(api_version, kind, name, namespace)
+        else:
+            # after a 409 the read MUST be live: a CachedClient's store
+            # may not have ingested the conflicting write yet, and
+            # re-reading the same stale object would 409 forever
+            obj = getattr(client, "get_live", client.get)(
+                api_version, kind, name, namespace
+            )
         if not mutate(obj):
             return obj
         try:
@@ -75,6 +83,20 @@ def obj_key(obj: Obj) -> Tuple[str, str, str, str]:
         meta.get("namespace", ""),
         meta.get("name", ""),
     )
+
+
+def match_fields(obj: Obj, selector: Dict[str, str]) -> bool:
+    """Dotted-path field-selector match (shared by FakeClient and the
+    informer cache so both doubles filter identically)."""
+    for path, want in selector.items():
+        cur: Any = obj
+        for part in path.split("."):
+            if not isinstance(cur, dict) or part not in cur:
+                return False
+            cur = cur[part]
+        if str(cur) != str(want):
+            return False
+    return True
 
 
 def match_labels(obj: Obj, selector: Optional[Dict[str, str]]) -> bool:
@@ -136,6 +158,14 @@ class Client:
         raise NotImplementedError
 
     # -- conveniences shared by all implementations ---------------------
+    def get_live(
+        self, api_version: str, kind: str, name: str, namespace: str = ""
+    ) -> Obj:
+        """Cache-bypassing read. On plain clients this IS ``get``; the
+        informer-backed ``CachedClient`` overrides it — conflict-retry
+        paths call this after a 409 to observe the conflicting write."""
+        return self.get(api_version, kind, name, namespace)
+
     def get_or_none(
         self, api_version: str, kind: str, name: str, namespace: str = ""
     ) -> Optional[Obj]:
@@ -229,15 +259,7 @@ class FakeClient(Client):
 
     @staticmethod
     def _match_fields(obj: Obj, selector: Dict[str, str]) -> bool:
-        for path, want in selector.items():
-            cur: Any = obj
-            for part in path.split("."):
-                if not isinstance(cur, dict) or part not in cur:
-                    return False
-                cur = cur[part]
-            if str(cur) != str(want):
-                return False
-        return True
+        return match_fields(obj, selector)
 
     # -- writes ---------------------------------------------------------
     def _stamp(self, obj: Obj) -> None:

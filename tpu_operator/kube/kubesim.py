@@ -76,6 +76,23 @@ class KubeSim:
         self.bookmark_interval_s = bookmark_interval_s
         # CRD name -> schema (installed via the real CRD API)
         self._cr_schemas: Dict[str, dict] = {}
+        # HTTP request accounting (reads vs writes vs watch streams) —
+        # the informer-cache bench axis counts apiserver requests per
+        # reconcile against these
+        self.request_counts: Dict[str, int] = {}
+
+    def count_request(self, verb: str, is_watch: bool = False) -> None:
+        key = "WATCH" if is_watch else verb
+        with self._lock:
+            self.request_counts[key] = self.request_counts.get(key, 0) + 1
+
+    def requests_total(self, include_watch: bool = False) -> int:
+        with self._lock:
+            return sum(
+                n
+                for k, n in self.request_counts.items()
+                if include_watch or k != "WATCH"
+            )
 
     # -- helpers ---------------------------------------------------------
     def _bump(self) -> str:
@@ -456,10 +473,13 @@ class _Handler(BaseHTTPRequestHandler):
         group, version, plural, namespace, name, _ = route
         qs = parse_qs(urlparse(self.path).query)
         if name:
+            self.sim.count_request("GET")
             code, obj = self.sim.get(group, version, plural, namespace, name)
             return self._json(code, obj)
         if qs.get("watch", ["false"])[0] == "true":
+            self.sim.count_request("GET", is_watch=True)
             return self._watch(group, version, plural, namespace, qs)
+        self.sim.count_request("LIST")
         code, obj = self.sim.list(
             group,
             version,
@@ -504,6 +524,7 @@ class _Handler(BaseHTTPRequestHandler):
         route = self._route()
         if route is None:
             return self._json(404, _status(404, "NotFound", self.path))
+        self.sim.count_request("POST")
         group, version, plural, namespace, name, sub = route
         body = self._body()
         if plural == "pods" and sub == "eviction":
@@ -518,6 +539,7 @@ class _Handler(BaseHTTPRequestHandler):
         route = self._route()
         if route is None:
             return self._json(404, _status(404, "NotFound", self.path))
+        self.sim.count_request("PUT")
         group, version, plural, namespace, name, sub = route
         code, obj = self.sim.update(
             group, version, plural, namespace, name, self._body(),
@@ -529,6 +551,7 @@ class _Handler(BaseHTTPRequestHandler):
         route = self._route()
         if route is None:
             return self._json(404, _status(404, "NotFound", self.path))
+        self.sim.count_request("DELETE")
         group, version, plural, namespace, name, _ = route
         code, obj = self.sim.delete(group, version, plural, namespace, name)
         return self._json(code, obj)

@@ -251,10 +251,13 @@ class RestClient(Client):
         namespace: str = "",
         stop_event=None,
         timeout_s: int = 300,
+        on_sync=None,
     ) -> None:
         """Blocking list+watch loop: calls ``callback(event_type, obj)`` for
         ADDED/MODIFIED/DELETED. Re-lists on expiry/disconnect (the
-        controller-runtime informer contract, minus caching)."""
+        controller-runtime informer contract, minus caching).
+        ``on_sync()`` fires after each full list has been delivered — the
+        informer cache uses it as its HasSynced barrier."""
         import logging
         import threading
 
@@ -271,9 +274,36 @@ class RestClient(Client):
         known = set()
         while not stop_event.is_set():
             try:
-                listing = self._request(
-                    "GET", _resource_path(api_version, kind, namespace)
-                )
+                try:
+                    listing = self._request(
+                        "GET", _resource_path(api_version, kind, namespace)
+                    )
+                except NotFoundError:
+                    # the kind is not served (optional CRD not installed,
+                    # e.g. ServiceMonitor without prometheus-operator, or
+                    # PSP on k8s >= 1.25): "nothing exists" IS the
+                    # authoritative state — sync empty, poll slowly for
+                    # the CRD to appear, and never log-spam a traceback
+                    for ns_name in known:
+                        deliver(
+                            "DELETED",
+                            {
+                                "apiVersion": api_version,
+                                "kind": kind,
+                                "metadata": {
+                                    "namespace": ns_name[0],
+                                    "name": ns_name[1],
+                                },
+                            },
+                        )
+                    known = set()
+                    if on_sync is not None:
+                        try:
+                            on_sync()
+                        except Exception:
+                            log.exception("watch on_sync callback failed")
+                    stop_event.wait(30)
+                    continue
                 rv = listing.get("metadata", {}).get("resourceVersion", "")
                 seen = set()
                 for item in listing.get("items", []):
@@ -296,6 +326,11 @@ class RestClient(Client):
                         },
                     )
                 known = seen
+                if on_sync is not None:
+                    try:
+                        on_sync()
+                    except Exception:
+                        log.exception("watch on_sync callback failed")
                 # stream, RESUMING from the last seen resourceVersion on
                 # clean expiry (server timeoutSeconds) — the informer
                 # contract: only a 410 Gone forces the full re-list above

@@ -87,12 +87,23 @@ def build_manager(
     leader_election: bool = False,
     debug_endpoints: bool = False,
     assets_dir=None,
+    informer_cache: bool = True,
 ):
     """Manager + both reconcilers, registered exactly as the process runs
     them — shared by main() and the kubesim manager e2e so the tested
     wiring IS the shipped wiring. Returns (manager, cp_reconciler,
-    upgrade_reconciler)."""
+    upgrade_reconciler).
+
+    By default the client is wrapped in the watch-fed ``CachedClient``
+    (reference: controller-runtime's shared cache, ``main.go:88-108``) so
+    every reconcile read is served from informers; ``Manager.start``
+    warms it before the first reconcile."""
     from tpu_operator.upgrade.upgrade_controller import UpgradeReconciler
+
+    if informer_cache and not hasattr(client, "add_event_hook"):
+        from tpu_operator.kube.cache import CachedClient
+
+        client = CachedClient(client, namespace=namespace)
 
     mgr = Manager(
         client,
@@ -130,7 +141,22 @@ def wire_event_sources(mgr, client, namespace: str, stop_event=None) -> None:
             # owned-operand drift (reference watch on owned DaemonSets)
             mgr.enqueue(CP_KEY, delay=0.1)
 
-    if hasattr(client, "add_watcher"):
+    # when the manager runs behind the informer cache, the workqueue is
+    # fed from the SAME list+watch streams that keep the cache warm —
+    # one set of watches, and a reconcile triggered by an event can
+    # never read a cache older than that event (the controller-runtime
+    # source-from-cache contract)
+    cached = next(
+        (
+            c
+            for c in (getattr(mgr, "client", None), client)
+            if hasattr(c, "add_event_hook")
+        ),
+        None,
+    )
+    if cached is not None:
+        cached.add_event_hook(on_event)
+    elif hasattr(client, "add_watcher"):
         # fake client pushes events in-process
         client.add_watcher(on_event)
     elif hasattr(client, "watch"):

@@ -329,6 +329,14 @@ class Manager:
             t = threading.Thread(target=renew, daemon=True)
             t.start()
             self._threads.append(t)
+        if hasattr(self.client, "start_informers"):
+            # warm the informer cache before the first reconcile so the
+            # hot loop reads O(1) from the start (controller-runtime's
+            # WaitForCacheSync before workers, main.go:155); on timeout
+            # the cache degrades to live passthrough, never to staleness
+            synced = self.client.start_informers(self._stop)
+            if not synced:
+                log.warning("informer cache did not fully sync; reads degrade to live")
         worker = threading.Thread(target=self._run_worker, daemon=True)
         worker.start()
         self._threads.append(worker)

@@ -36,6 +36,29 @@ COMPONENTS = (
 )
 
 
+def _env_int(name: str, default: int) -> int:
+    """Env-backed int flag default; a malformed value (e.g. an unresolved
+    Helm template rendering to "") must fall back, not crash the
+    initContainer before argparse can even print usage."""
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        logging.getLogger("tpu-validator").warning(
+            "ignoring non-integer %s=%r", name, os.environ.get(name)
+        )
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        logging.getLogger("tpu-validator").warning(
+            "ignoring non-numeric %s=%r", name, os.environ.get(name)
+        )
+        return default
+
+
 def build_parser():
     p = argparse.ArgumentParser("tpu-validator")
     p.add_argument(
@@ -85,37 +108,37 @@ def build_parser():
     p.add_argument(
         "--ringattn-seq-len",
         type=int,
-        default=int(os.environ.get("RINGATTN_SEQ_LEN", "2048")),
+        default=_env_int("RINGATTN_SEQ_LEN", 2048),
         help="total sequence length for the context-parallel probe",
     )
     p.add_argument(
         "--flashattn-seq",
         type=int,
-        default=int(os.environ.get("FLASHATTN_SEQ", "2048")),
+        default=_env_int("FLASHATTN_SEQ", 2048),
         help="flash-attention probe sequence length (shrink for CPU/dev)",
     )
     p.add_argument(
         "--flashattn-heads",
         type=int,
-        default=int(os.environ.get("FLASHATTN_HEADS", "4")),
+        default=_env_int("FLASHATTN_HEADS", 4),
         help="flash-attention probe head count",
     )
     p.add_argument(
         "--membw-min-utilization",
         type=float,
-        default=float(os.environ.get("MEMBW_MIN_UTILIZATION", "0.5")),
+        default=_env_float("MEMBW_MIN_UTILIZATION", 0.5),
         help="fail membw validation below this fraction of spec HBM bandwidth",
     )
     p.add_argument(
         "--membw-size-mb",
         type=int,
-        default=int(os.environ.get("MEMBW_SIZE_MB", "0")),
+        default=_env_int("MEMBW_SIZE_MB", 0),
         help="probe buffer MiB (0 = auto: 2048 on TPU, tiny off-TPU)",
     )
     p.add_argument(
         "--expect-devices",
         type=int,
-        default=int(os.environ.get("EXPECT_TPU_DEVICES", "0")) or None,
+        default=_env_int("EXPECT_TPU_DEVICES", 0) or None,
     )
     p.add_argument(
         "--allow-cpu",

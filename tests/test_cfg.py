@@ -192,8 +192,15 @@ def test_crd_schema_hardening():
     ]
     assert spec["metricsd"]["properties"]["hostPort"]["maximum"] == 65535
     up = spec["libtpu"]["properties"]["upgradePolicy"]["properties"]
-    assert up["maxUnavailable"] == {"x-kubernetes-int-or-string": True, "pattern": r"^\d+%?$"}
+    assert up["maxUnavailable"] == {
+        "x-kubernetes-int-or-string": True,
+        "pattern": r"^\d+%?$",
+        # structural-schema defaulting: the dataclass default is stamped
+        # into the schema so the apiserver materializes it at admission
+        "default": "25%",
+    }
     assert up["maxParallelUpgrades"]["minimum"] == 0
+    assert up["maxParallelUpgrades"]["default"] == 1
     # the vestigial GPU-ism is gone
     assert "useOcpDriverToolkit" not in spec["operator"]["properties"]
 

@@ -409,3 +409,166 @@ def test_every_asset_manifest_is_server_admissible():
         finally:
             server.stop()
     assert total >= 60, total  # every operand object round-tripped
+
+
+def _workload_pod(name, labels=None, ready=True):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": NS, "labels": labels or {}},
+        "spec": {"nodeName": "n1"},
+        "status": {
+            "phase": "Running" if ready else "Pending",
+            "conditions": [
+                {"type": "Ready", "status": "True" if ready else "False"}
+            ],
+        },
+    }
+
+
+def test_eviction_respects_pdb_min_available(cluster):
+    """Documented apiserver behavior: an eviction that would violate a
+    PodDisruptionBudget answers 429 TooManyRequests; a bare DELETE
+    bypasses budgets (which is exactly why operator code must evict)."""
+    from tpu_operator.kube.client import EvictionBlockedError
+
+    _, client = cluster
+    for i in range(2):
+        client.create(_workload_pod(f"train-{i}", labels={"app": "train"}))
+    client.create(
+        {
+            "apiVersion": "policy/v1",
+            "kind": "PodDisruptionBudget",
+            "metadata": {"name": "train-pdb", "namespace": NS},
+            "spec": {
+                "minAvailable": 2,
+                "selector": {"matchLabels": {"app": "train"}},
+            },
+        }
+    )
+    with pytest.raises(EvictionBlockedError) as exc:
+        client.evict("train-0", NS)
+    assert "disruption budget" in str(exc.value)
+    assert client.get("v1", "Pod", "train-0", NS) is not None
+
+    # a pod the selector does not cover evicts freely
+    client.create(_workload_pod("other", labels={"app": "other"}))
+    client.evict("other", NS)
+    with pytest.raises(NotFoundError):
+        client.get("v1", "Pod", "other", NS)
+
+    # loosening the budget unblocks the eviction
+    pdb = client.get("policy/v1", "PodDisruptionBudget", "train-pdb", NS)
+    pdb["spec"]["minAvailable"] = 1
+    client.update(pdb)
+    client.evict("train-0", NS)
+    with pytest.raises(NotFoundError):
+        client.get("v1", "Pod", "train-0", NS)
+    # now at the floor again: the next eviction is vetoed
+    with pytest.raises(EvictionBlockedError):
+        client.evict("train-1", NS)
+
+
+def test_eviction_respects_pdb_max_unavailable_percent(cluster):
+    from tpu_operator.kube.client import EvictionBlockedError
+
+    _, client = cluster
+    for i in range(4):
+        client.create(
+            _workload_pod(f"w-{i}", labels={"app": "w"}, ready=(i != 3))
+        )
+    client.create(
+        {
+            "apiVersion": "policy/v1",
+            "kind": "PodDisruptionBudget",
+            "metadata": {"name": "w-pdb", "namespace": NS},
+            "spec": {
+                "maxUnavailable": "25%",
+                "selector": {
+                    "matchExpressions": [
+                        {"key": "app", "operator": "In", "values": ["w"]}
+                    ]
+                },
+            },
+        }
+    )
+    # 25% of 4 = 1 disruption allowed, already consumed by the unready
+    # pod: every further eviction is vetoed
+    with pytest.raises(EvictionBlockedError):
+        client.evict("w-0", NS)
+    # the unready pod recovers -> one disruption available again
+    p = client.get("v1", "Pod", "w-3", NS)
+    p["status"] = {
+        "phase": "Running",
+        "conditions": [{"type": "Ready", "status": "True"}],
+    }
+    client.update(p)
+    client.evict("w-0", NS)
+
+
+def test_set_based_label_selectors(cluster):
+    """Documented apiserver selector grammar: in/notin/!key/key!=v — the
+    set-based half the round-2 kubesim only approximated (equality +
+    existence)."""
+    _, client = cluster
+    for name, labels in (
+        ("a", {"app": "train", "tier": "gpu"}),
+        ("b", {"app": "batch"}),
+        ("c", {"app": "serve", "tier": "tpu"}),
+    ):
+        client.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": name, "namespace": NS, "labels": labels},
+                "spec": {},
+            }
+        )
+
+    def names(sel):
+        return {
+            p["metadata"]["name"]
+            for p in client.list("v1", "Pod", NS, label_selector=sel)
+        }
+
+    assert names("app in (train, batch)") == {"a", "b"}
+    assert names("app notin (train)") == {"b", "c"}
+    assert names("!tier") == {"b"}
+    assert names("tier") == {"a", "c"}
+    assert names("app!=batch") == {"a", "c"}
+    assert names("app in (train,serve), tier") == {"a", "c"}
+    # dict convenience forms ride the same wire encoding
+    assert names({"app": ["train", "serve"]}) == {"a", "c"}
+    assert names({"!tier": None}) == {"b"}
+    # a malformed selector is 400 Bad Request, not an empty result
+    with pytest.raises(RuntimeError):
+        client.list("v1", "Pod", NS, label_selector="app in train)")
+
+
+def test_crd_schema_defaulting_at_admission(cluster):
+    """Structural-schema defaults are materialized by the apiserver at
+    admission (create AND update), within present objects only — an
+    absent sub-spec is not conjured into existence."""
+    _, client = cluster
+    client.create(build_crd())
+    created = client.create(
+        _cp(
+            spec={
+                "libtpu": {
+                    "enabled": True,
+                    "upgradePolicy": {"autoUpgrade": True},
+                }
+            }
+        )
+    )
+    up = created["spec"]["libtpu"]["upgradePolicy"]
+    assert up["maxUnavailable"] == "25%", up
+    assert up["maxParallelUpgrades"] == 1
+    assert created["spec"]["libtpu"]["installDir"] == "/home/kubernetes/lib/tpu"
+    # absent sub-spec stays absent (k8s defaulting scoping)
+    assert "metricsd" not in created["spec"] or created["spec"]["metricsd"]
+    # defaulting also runs on update: a field the user deletes snaps back
+    cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
+    del cp["spec"]["libtpu"]["upgradePolicy"]["maxUnavailable"]
+    updated = client.update(cp)
+    assert updated["spec"]["libtpu"]["upgradePolicy"]["maxUnavailable"] == "25%"

@@ -432,3 +432,76 @@ def test_state_gating(monkeypatch):
         )
         == "true"
     )
+
+
+def _guard_pdb(client, min_available=1):
+    client.create(
+        {
+            "apiVersion": "policy/v1",
+            "kind": "PodDisruptionBudget",
+            "metadata": {"name": "train-pdb", "namespace": "default"},
+            "spec": {"minAvailable": min_available, "selector": {}},
+        }
+    )
+
+
+def test_pdb_vetoed_eviction_retries_while_window_open(env):
+    """A disruption budget vetoing the pre-maintenance sweep must not be
+    one-shot: the handler keeps retrying every poll while the window is
+    open (the budget may free up before the host dies), and the Event
+    reports the veto instead of claiming success."""
+    client, handler, feed = env
+    client.delete("v1", "Pod", "train-adhoc", "default")  # focus on owned
+    # the empty selector covers the sidecar too: 2 healthy pods, so
+    # minAvailable=2 means zero disruptions allowed
+    _guard_pdb(client, min_available=2)
+
+    feed["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+    handler.reconcile_once()
+    # vetoed: the pod survives, the event tells the truth
+    assert client.get_or_none("v1", "Pod", "train-owned", "default") is not None
+    events = client.list("v1", "Event", NS)
+    msgs = [
+        e["message"]
+        for e in events
+        if e.get("reason") == "HostMaintenanceImminent"
+    ]
+    assert msgs and any("vetoed by a disruption budget" in m for m in msgs)
+    assert not any("1 TPU workload pod(s) evicted" in m for m in msgs)
+
+    # the budget frees up mid-window -> the NEXT poll evicts
+    pdb = client.get("policy/v1", "PodDisruptionBudget", "train-pdb", "default")
+    pdb["spec"]["minAvailable"] = 0
+    client.update(pdb)
+    handler.reconcile_once()
+    assert client.get_or_none("v1", "Pod", "train-owned", "default") is None
+
+
+def test_force_evicts_past_pdb_on_doomed_host(env):
+    """FORCE_EVICT=true means force: with the host termination imminent,
+    a PDB veto falls back to deletion (kubectl --disable-eviction
+    semantics) rather than stranding the pod to die with the node."""
+    client, handler, feed = env
+    handler.force = True
+    _guard_pdb(client, min_available=3)
+
+    feed["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+    handler.reconcile_once()
+    assert client.get_or_none("v1", "Pod", "train-owned", "default") is None
+    assert client.get_or_none("v1", "Pod", "train-adhoc", "default") is None
+
+
+def test_skipped_unmanaged_not_reported_as_evicted(env):
+    """The Event must not count skipped unmanaged pods as evictions."""
+    client, handler, feed = env
+    client.delete("v1", "Pod", "train-owned", "default")  # leave only adhoc
+    feed["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+    handler.reconcile_once()
+    events = client.list("v1", "Event", NS)
+    msgs = [
+        e["message"]
+        for e in events
+        if e.get("reason") == "HostMaintenanceImminent"
+    ]
+    assert msgs and all("unmanaged pod(s) left alone" in m for m in msgs)
+    assert not any("evicted" in m for m in msgs)

@@ -22,16 +22,7 @@ from tpu_operator.manager import LeaderElector
 NS = "tpu-operator"
 CPV = "tpu.k8s.io/v1"
 
-def edit_cp(client, fn):
-    """Spec edit racing the live operator (annotation/status writers on
-    the same CR): conflict-retried like any real controller-side writer."""
-    from tpu_operator.kube.client import mutate_with_retry
-
-    def mutate(cp):
-        fn(cp)
-        return True
-
-    mutate_with_retry(client, CPV, "ClusterPolicy", "cluster-policy", mutate=mutate)
+from tpu_operator.kube.testing import edit_clusterpolicy as edit_cp
 
 
 

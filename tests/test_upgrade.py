@@ -564,3 +564,60 @@ def test_stale_initial_state_annotation_cleared_on_reentry(cluster):
     assert consts.UPGRADE_INITIAL_STATE_ANNOTATION not in node["metadata"].get(
         "annotations", {}
     )
+
+
+def test_wait_for_jobs_set_based_selector(cluster):
+    """waitForCompletion.podSelector is user-authored apiserver grammar:
+    a set-based term like ``job-class in (batch, train)`` must hold the
+    node in wait-for-jobs while a matching pod runs (the round-2 parser
+    silently dropped non-equality terms, matching nothing)."""
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    cluster.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": "setsel-job",
+                "namespace": "default",
+                "labels": {"job-class": "train"},
+                "ownerReferences": [{"kind": "Job", "name": "j", "uid": "u"}],
+            },
+            "spec": {"nodeName": "node-1"},
+            "status": {"phase": "Running"},
+        }
+    )
+    policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable="100%",
+        wait_for_completion={
+            "podSelector": "job-class in (batch, train)",
+            "timeoutSeconds": 600,
+        },
+    )
+    pump(mgr, policy, times=4)
+    assert node_state(cluster, "node-1") == us.STATE_WAIT_FOR_JOBS_REQUIRED
+    # the job finishes -> the very next pass moves on
+    cluster.delete("v1", "Pod", "setsel-job", "default")
+    pump(mgr, policy, times=1)
+    assert node_state(cluster, "node-1") != us.STATE_WAIT_FOR_JOBS_REQUIRED
+
+
+def test_wait_for_jobs_malformed_selector_does_not_wedge(cluster):
+    """A malformed podSelector is logged and treated as matching nothing
+    (never an unhandled 400 aborting the whole upgrade pass)."""
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable="100%",
+        wait_for_completion={
+            "podSelector": "job-class in (batch",  # unbalanced paren
+            "timeoutSeconds": 600,
+        },
+    )
+    pump(mgr, policy, times=5)
+    assert node_state(cluster, "node-1") not in (
+        us.STATE_UNKNOWN,
+        us.STATE_WAIT_FOR_JOBS_REQUIRED,
+    )

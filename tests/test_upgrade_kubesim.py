@@ -26,16 +26,7 @@ from tpu_operator.upgrade import upgrade_state as us
 NS = "tpu-operator"
 CPV = "tpu.k8s.io/v1"
 
-def edit_cp(client, fn):
-    """Spec edit racing a live operator (which annotates/status-writes the
-    same CR): conflict-retried like any real controller-side writer."""
-    from tpu_operator.kube.client import mutate_with_retry
-
-    def mutate(cp):
-        fn(cp)
-        return True
-
-    mutate_with_retry(client, CPV, "ClusterPolicy", "cluster-policy", mutate=mutate)
+from tpu_operator.kube.testing import edit_clusterpolicy as edit_cp
 
 
 NODES = ("up-node-1", "up-node-2", "up-node-3")
@@ -432,3 +423,119 @@ def test_operator_restart_mid_upgrade_resumes_fsm(cluster):
                 "unschedulable", False
             ), f"{name} left cordoned after the resumed upgrade"
         assert wait_until(lambda: cr_state(client) == "ready", 60)
+
+
+def test_pdb_blocked_drain_fails_with_veto_event(cluster):
+    """PDB-respecting drain over the wire (round-2 missing #2): the
+    upgrade FSM evicts through the Eviction subresource, so a
+    PodDisruptionBudget covering the workload vetoes the drain with 429;
+    the drain exhausts its budget into terminal ``upgrade-failed`` and
+    the Warning Event carries the veto message naming the PDB. Removing
+    the budget and re-entering the FSM completes the upgrade — proof the
+    eviction path (not a bare DELETE that would bypass the PDB) is what
+    the operator runs."""
+    server, client = cluster
+    with running_operator(client):
+        assert wait_until(lambda: cr_state(client) == "ready", 90)
+
+        # an OWNED training pod (drain would normally evict it) guarded
+        # by a minAvailable=1 budget: every eviction is vetoed
+        client.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": "guarded-train",
+                    "namespace": NS,
+                    "labels": {"app": "guarded"},
+                    "ownerReferences": [
+                        {
+                            "apiVersion": "batch/v1",
+                            "kind": "Job",
+                            "name": "j",
+                            "uid": "u-guarded",
+                        }
+                    ],
+                },
+                "spec": {
+                    "nodeName": NODES[0],
+                    "containers": [
+                        {
+                            "name": "train",
+                            "resources": {"limits": {consts.TPU_RESOURCE: "4"}},
+                        }
+                    ],
+                },
+                "status": {"phase": "Running"},
+            }
+        )
+        client.create(
+            {
+                "apiVersion": "policy/v1",
+                "kind": "PodDisruptionBudget",
+                "metadata": {"name": "guarded-pdb", "namespace": NS},
+                "spec": {
+                    "minAvailable": 1,
+                    "selector": {"matchLabels": {"app": "guarded"}},
+                },
+            }
+        )
+
+        edit_cp(
+            client,
+            lambda cp: cp["spec"]["libtpu"].update(
+                upgradePolicy={
+                    "autoUpgrade": True,
+                    "maxParallelUpgrades": 3,
+                    "maxUnavailable": "100%",
+                    "drain": {"enable": True, "timeoutSeconds": 1},
+                },
+                version="2026.1.0",
+            ),
+        )
+
+        def blocked_failed_others_done():
+            labels = {
+                n: upgrade_label(client.get("v1", "Node", n)) for n in NODES
+            }
+            return labels[NODES[0]] == us.STATE_FAILED and all(
+                labels[n] == us.STATE_DONE for n in NODES[1:]
+            )
+
+        assert wait_until(blocked_failed_others_done, 120), {
+            n: upgrade_label(client.get("v1", "Node", n)) for n in NODES
+        }
+        # the pod survived: the budget actually protected it (a bare
+        # DELETE path would have killed it and the drain would have
+        # succeeded)
+        assert client.get_or_none("v1", "Pod", "guarded-train", NS) is not None
+        events = client.list("v1", "Event", NS)
+        veto_events = [
+            e
+            for e in events
+            if e.get("reason") == "UpgradeDrainTimeout"
+            and "disruption budget" in e.get("message", "")
+            and "guarded-pdb" in e.get("message", "")
+        ]
+        assert veto_events, [
+            (e.get("reason"), e.get("message")) for e in events
+        ]
+
+        # documented recovery: drop the budget, uncordon, clear the state
+        # label -> FSM re-enters and completes
+        client.delete("policy/v1", "PodDisruptionBudget", "guarded-pdb", NS)
+        from tpu_operator.kube.client import mutate_with_retry
+
+        def recover(node):
+            node["spec"]["unschedulable"] = False
+            node["metadata"]["labels"].pop(consts.UPGRADE_STATE_LABEL, None)
+            return True
+
+        mutate_with_retry(client, "v1", "Node", NODES[0], mutate=recover)
+        assert wait_until(
+            lambda: upgrade_label(client.get("v1", "Node", NODES[0]))
+            == us.STATE_DONE,
+            90,
+        ), upgrade_label(client.get("v1", "Node", NODES[0]))
+        # this time the drain DID evict it through the subresource
+        assert client.get_or_none("v1", "Pod", "guarded-train", NS) is None

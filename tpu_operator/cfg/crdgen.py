@@ -124,6 +124,19 @@ def _dataclass_schema(cls) -> Dict[str, Any]:
         doc = f.metadata.get("doc")
         if doc:
             schema["description"] = doc
+        # structural-schema defaulting: the dataclass scalar defaults ARE
+        # the defaults the decoder would apply, so stamping them into the
+        # schema makes the apiserver materialize them at admission —
+        # kubectl get then shows the effective config, exactly like the
+        # reference's hand-maintained CRD defaults. k8s semantics:
+        # defaults apply only within objects present in the payload, which
+        # matches the decoder (absent sub-spec => absent defaults).
+        if (
+            f.default is not dataclasses.MISSING
+            and isinstance(f.default, (str, int, float, bool))
+            and f.default != ""
+        ):
+            schema["default"] = f.default
         props[key] = schema
     return {"type": "object", "properties": props}
 

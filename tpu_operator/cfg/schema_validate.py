@@ -92,6 +92,46 @@ def validate(schema: Dict[str, Any], obj: Any, path: str = "") -> List[str]:
     return problems
 
 
+def apply_defaults(schema: Dict[str, Any], obj: Any) -> None:
+    """Structural-schema defaulting, in place (apiserver semantics:
+    defaulting happens at decode time, BEFORE validation, and applies
+    only inside objects that are present in the payload — an absent
+    sub-object does not get materialized just because its children have
+    defaults)."""
+    if schema.get("type") == "object" and isinstance(obj, dict):
+        props = schema.get("properties", {})
+        for key, prop_schema in props.items():
+            if key not in obj and "default" in prop_schema:
+                import copy
+
+                obj[key] = copy.deepcopy(prop_schema["default"])
+            if key in obj:
+                apply_defaults(prop_schema, obj[key])
+        addl = schema.get("additionalProperties")
+        if isinstance(addl, dict):
+            for value in obj.values():
+                apply_defaults(addl, value)
+    elif schema.get("type") == "array" and isinstance(obj, list):
+        item_schema = schema.get("items", {})
+        for item in obj:
+            apply_defaults(item_schema, item)
+
+
+def default_cr(crd: Dict[str, Any], cr_obj: Dict[str, Any]) -> None:
+    """Apply the CRD's schema defaults to a CR in place (metadata is the
+    apiserver's own domain and is skipped, matching ``validate_cr``)."""
+    schema = crd_schema(crd)
+    for key, prop_schema in schema.get("properties", {}).items():
+        if key == "metadata":
+            continue
+        if key not in cr_obj and "default" in prop_schema:
+            import copy
+
+            cr_obj[key] = copy.deepcopy(prop_schema["default"])
+        if key in cr_obj:
+            apply_defaults(prop_schema, cr_obj[key])
+
+
 def crd_schema(crd: Dict[str, Any], version: str = "v1") -> Dict[str, Any]:
     """Extract the openAPIV3Schema for ``version`` from a CRD manifest."""
     for v in crd.get("spec", {}).get("versions", []):

@@ -361,6 +361,19 @@ class CachedClient(Client):
                 },
             )
 
+    def evict(self, name, namespace=""):
+        self.live.evict(name, namespace)
+        inf = self._informers.get(("v1", "Pod"))
+        if inf is not None and inf.synced.is_set():
+            inf.on_event(
+                "DELETED",
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {"namespace": namespace, "name": name},
+                },
+            )
+
     def delete_if_exists(self, api_version, kind, name, namespace=""):
         """Probe the cache before issuing the DELETE: disabled-state
         controls call this every pass for operands that were never

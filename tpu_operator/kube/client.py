@@ -33,6 +33,13 @@ class ConflictError(RuntimeError):
     """resourceVersion conflict on update (HTTP 409 analogue)."""
 
 
+class EvictionBlockedError(RuntimeError):
+    """Eviction vetoed by a PodDisruptionBudget (HTTP 429 on the
+    pods/{name}/eviction subresource). The caller retries later —
+    kubectl-drain keeps retrying until its timeout; the upgrade FSM's
+    level-triggered drain step does the same per reconcile pass."""
+
+
 def mutate_with_retry(
     client: "Client",
     api_version: str,
@@ -99,22 +106,35 @@ def match_fields(obj: Obj, selector: Dict[str, str]) -> bool:
     return True
 
 
-def match_labels(obj: Obj, selector: Optional[Dict[str, str]]) -> bool:
-    """Label-selector match supporting exact values and ``*`` globs.
-
-    Glob support mirrors how the reference filters e.g. ``nvidia.com/gpu*``
-    resource names (``main.go:161-183``) — used by tests and the upgrade
-    engine's pod filters.
+def match_labels(obj: Obj, selector) -> bool:
+    """Label-selector match. Accepts either the dict convenience form —
+    exact values, ``*`` globs (client-side only, mirroring how the
+    reference filters ``nvidia.com/gpu*`` resource names,
+    ``main.go:161-183``), list values (``in``), ``!key`` (absent) — or a
+    raw apiserver selector STRING with the full set-based grammar
+    (``k in (a,b)``, ``k notin (...)``, ``!k``, ``k!=v``), so FakeClient
+    and the informer cache filter exactly like kubesim/the apiserver.
     """
     if not selector:
         return True
     labels = obj.get("metadata", {}).get("labels", {}) or {}
+    if isinstance(selector, str):
+        from tpu_operator.kube.selector import matches
+
+        return matches(labels, selector)
     for k, v in selector.items():
+        if k.startswith("!"):
+            if k[1:] in labels:
+                return False
+            continue
         if k not in labels:
             return False
         if v is None or v == "":
             continue
-        if "*" in v:
+        if isinstance(v, (list, tuple)):
+            if str(labels[k]) not in {str(x) for x in v}:
+                return False
+        elif "*" in v:
             if not fnmatch.fnmatchcase(str(labels[k]), v):
                 return False
         elif str(labels[k]) != str(v):
@@ -156,6 +176,21 @@ class Client:
         self, api_version: str, kind: str, name: str, namespace: str = ""
     ) -> None:
         raise NotImplementedError
+
+    def evict(self, name: str, namespace: str = "") -> None:
+        """Evict a pod through the Eviction subresource so
+        PodDisruptionBudgets can veto (429 → ``EvictionBlockedError``) —
+        the PDB-respecting path every workload-pod disruption must take
+        (reference: kubectl drain via
+        ``vendor/.../upgrade/drain_manager.go:76-89``).
+        Raises ``NotFoundError`` when the pod is already gone."""
+        self.create(
+            {
+                "apiVersion": "policy/v1",
+                "kind": "Eviction",
+                "metadata": {"name": name, "namespace": namespace},
+            }
+        )
 
     # -- conveniences shared by all implementations ---------------------
     def get_live(
@@ -333,6 +368,30 @@ class FakeClient(Client):
             key = (api_version, kind, namespace or "", name)
             if key not in self._store:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            self._delete_stored(key)
+
+    def evict(self, name, namespace=""):
+        """Eviction subresource with PDB enforcement — same arithmetic as
+        kubesim (``tpu_operator/kube/disruption.py``) so FakeClient tests
+        see apiserver-faithful 429 vetoes."""
+        from tpu_operator.kube.disruption import eviction_blocked_by
+
+        with self._lock:
+            key = ("v1", "Pod", namespace or "", name)
+            pod = self._store.get(key)
+            if pod is None:
+                raise NotFoundError(f"Pod {namespace}/{name} not found")
+            pods = [
+                o for (av, k, ns, _), o in self._store.items()
+                if k == "Pod" and ns == (namespace or "")
+            ]
+            pdbs = [
+                o for (av, k, ns, _), o in self._store.items()
+                if k == "PodDisruptionBudget" and ns == (namespace or "")
+            ]
+            blocked = eviction_blocked_by(pod, pods, pdbs)
+            if blocked is not None:
+                raise EvictionBlockedError(blocked[1])
             self._delete_stored(key)
 
     def _delete_stored(self, key) -> None:

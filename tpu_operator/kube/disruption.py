@@ -1,0 +1,110 @@
+"""PodDisruptionBudget semantics shared by kubesim and the FakeClient.
+
+The reference's drain goes through the Eviction subresource via kubectl's
+drain helper (``vendor/.../upgrade/drain_manager.go:76-89``,
+``vendor/k8s.io/kubectl/pkg/drain/drain.go:43-45``), which means a user's
+PDB can veto a disruption with 429 TooManyRequests. Both API doubles
+enforce the same arithmetic through this module so operator code sees
+apiserver-faithful behavior: an eviction is allowed only while every
+matching budget keeps ``disruptionsAllowed > 0``.
+
+Healthy counting follows the disruption controller: pods with a
+``Ready=True`` condition, falling back to ``phase=Running`` for doubles
+that don't model conditions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+Obj = Dict[str, Any]
+
+
+def _selector_matches(selector: Optional[dict], pod: Obj) -> bool:
+    """LabelSelector (matchLabels + matchExpressions) against pod labels.
+    An empty/absent selector matches every pod in the namespace (PDB API
+    semantics, unlike a plain list selector)."""
+    labels = pod.get("metadata", {}).get("labels", {}) or {}
+    if not selector:
+        return True
+    for k, v in (selector.get("matchLabels") or {}).items():
+        if labels.get(k) != v:
+            return False
+    for expr in selector.get("matchExpressions") or []:
+        key = expr.get("key", "")
+        op = expr.get("operator", "")
+        values = expr.get("values") or []
+        if op == "In":
+            if labels.get(key) not in values:
+                return False
+        elif op == "NotIn":
+            if key in labels and labels[key] in values:
+                return False
+        elif op == "Exists":
+            if key not in labels:
+                return False
+        elif op == "DoesNotExist":
+            if key in labels:
+                return False
+        else:
+            return False  # unknown operator: fail closed
+    return True
+
+
+def _healthy(pod: Obj) -> bool:
+    for cond in pod.get("status", {}).get("conditions") or []:
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return pod.get("status", {}).get("phase") == "Running"
+
+
+def _scaled(value, total: int) -> int:
+    """int-or-percent (k8s GetScaledValueFromIntOrPercent, rounding up
+    for minAvailable-style fields as the disruption controller does)."""
+    if isinstance(value, str) and value.endswith("%"):
+        import math
+
+        return math.ceil(total * int(value[:-1]) / 100.0)
+    return int(value)
+
+
+def eviction_blocked_by(
+    pod: Obj, pods: List[Obj], pdbs: List[Obj]
+) -> Optional[Tuple[str, str]]:
+    """Would evicting ``pod`` violate any budget? Returns ``(pdb_name,
+    message)`` for the first violated PDB, else None. ``pods`` is the
+    namespace's pod population the budgets are measured against."""
+    pod_ns = pod.get("metadata", {}).get("namespace", "")
+    for pdb in pdbs:
+        if pdb.get("metadata", {}).get("namespace", "") != pod_ns:
+            continue
+        spec = pdb.get("spec", {}) or {}
+        selector = spec.get("selector")
+        if not _selector_matches(selector, pod):
+            continue
+        matching = [
+            p
+            for p in pods
+            if p.get("metadata", {}).get("namespace", "") == pod_ns
+            and _selector_matches(selector, p)
+        ]
+        healthy = sum(1 for p in matching if _healthy(p))
+        total = len(matching)
+        if "minAvailable" in spec:
+            required = _scaled(spec["minAvailable"], total)
+            allowed = healthy - required
+        elif "maxUnavailable" in spec:
+            unhealthy = total - healthy
+            allowed = _scaled(spec["maxUnavailable"], total) - unhealthy
+        else:
+            continue
+        if allowed <= 0:
+            name = pdb.get("metadata", {}).get("name", "")
+            return name, (
+                f"Cannot evict pod as it would violate the pod's disruption "
+                f"budget: the disruption budget {name} needs "
+                f"{spec.get('minAvailable', spec.get('maxUnavailable'))} "
+                f"available and disruptionsAllowed is 0 "
+                f"({healthy} healthy of {total} matching)"
+            )
+    return None

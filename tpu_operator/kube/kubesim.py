@@ -126,14 +126,16 @@ class KubeSim:
             self._cr_schemas[kind] = crd
 
     def _admit(self, kind: str, obj: dict) -> List[str]:
-        """Validate + prune a CR against its registered CRD schema.
-        Returns problems (empty = admitted); prunes unknown fields in
-        place, as a structural schema does."""
+        """Default + validate + prune a CR against its registered CRD
+        schema. Returns problems (empty = admitted); applies schema
+        defaults and prunes unknown fields in place, in the apiserver's
+        order (defaulting at decode, before validation)."""
         crd = self._cr_schemas.get(kind)
         if crd is None:
             return []
-        from tpu_operator.cfg.schema_validate import validate_cr
+        from tpu_operator.cfg.schema_validate import default_cr, validate_cr
 
+        default_cr(crd, obj)
         problems = validate_cr(crd, obj)
         rejects = []
         for p in problems:
@@ -292,6 +294,32 @@ class KubeSim:
         for key, obj in orphans:
             self._delete_stored(key, obj)
 
+    def evict(self, group, version, namespace, name):
+        """pods/{name}/eviction with PodDisruptionBudget enforcement: a
+        disruption that would violate a matching budget answers 429 (the
+        apiserver's disruption-controller contract kubectl drain retries
+        against — ``vendor/k8s.io/kubectl/pkg/drain/drain.go:43-45``)."""
+        from tpu_operator.kube.disruption import eviction_blocked_by
+
+        with self._lock:
+            key = self._key("", "v1", "pods", namespace, name)
+            pod = self._objs.get(key)
+            if pod is None:
+                return 404, _status(404, "NotFound", f"pods {name} not found")
+            pods = [
+                o for k, o in self._objs.items()
+                if k[2] == "pods" and k[3] == namespace
+            ]
+            pdbs = [
+                o for k, o in self._objs.items()
+                if k[2] == "poddisruptionbudgets" and k[3] == namespace
+            ]
+            blocked = eviction_blocked_by(pod, pods, pdbs)
+            if blocked is not None:
+                return 429, _status(429, "TooManyRequests", blocked[1])
+            self._delete_stored(key, pod)
+            return 201, _status(201, "Success", f"pod {name} evicted")
+
     def get(self, group, version, plural, namespace, name):
         with self._lock:
             stored = self._objs.get(self._key(group, version, plural, namespace, name))
@@ -301,6 +329,15 @@ class KubeSim:
 
     def list(self, group, version, plural, namespace, label_sel="", field_sel=""):
         kind, namespaced = PLURAL_TABLE[plural]
+        if label_sel:
+            # parse once up front: a malformed selector is 400 Bad
+            # Request, not an empty result
+            from tpu_operator.kube.selector import parse_selector
+
+            try:
+                parse_selector(label_sel)
+            except ValueError as e:
+                return 400, _status(400, "BadRequest", str(e))
         with self._lock:
             items = []
             for (g, v, p, ns, _), obj in self._objs.items():
@@ -383,18 +420,12 @@ def _status(code: int, reason: str, message: str) -> dict:
 
 
 def _match_label_selector(obj: dict, selector: str) -> bool:
-    labels = obj.get("metadata", {}).get("labels", {}) or {}
-    for term in selector.split(","):
-        term = term.strip()
-        if not term:
-            continue
-        if "=" in term:
-            k, v = term.split("=", 1)
-            if labels.get(k) != v:
-                return False
-        elif labels.get(term) is None:  # bare key: existence
-            return False
-    return True
+    """Full apiserver selector grammar including set-based terms
+    (``in``/``notin``/``!key``); raises ValueError on malformed input,
+    which the handler answers with 400 like a real apiserver."""
+    from tpu_operator.kube.selector import matches
+
+    return matches(obj.get("metadata", {}).get("labels", {}) or {}, selector)
 
 
 def _match_field_selector(obj: dict, selector: str) -> bool:
@@ -528,10 +559,8 @@ class _Handler(BaseHTTPRequestHandler):
         group, version, plural, namespace, name, sub = route
         body = self._body()
         if plural == "pods" and sub == "eviction":
-            code, obj = self.sim.delete(group, version, "pods", namespace, name)
-            if code == 404:
-                return self._json(404, obj)
-            return self._json(201, _status(201, "Success", f"pod {name} evicted"))
+            code, obj = self.sim.evict(group, version, namespace, name)
+            return self._json(code, obj)
         code, obj = self.sim.create(group, version, plural, namespace, body)
         return self._json(code, obj)
 

@@ -30,6 +30,11 @@ SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 class TransientAPIError(RuntimeError):
     """429 / 5xx from the API server — retryable for idempotent reads."""
 
+
+class TooManyRequestsError(TransientAPIError):
+    """HTTP 429 specifically: on the eviction subresource this is the
+    PDB-veto signal, not a load-shedding hiccup."""
+
 # kind -> (plural, namespaced)
 KIND_TABLE: Dict[str, tuple] = {
     "Pod": ("pods", True),
@@ -56,6 +61,7 @@ KIND_TABLE: Dict[str, tuple] = {
     "Lease": ("leases", True),
     "CustomResourceDefinition": ("customresourcedefinitions", False),
     "Eviction": ("evictions", True),
+    "PodDisruptionBudget": ("poddisruptionbudgets", True),
 }
 
 
@@ -161,7 +167,11 @@ class RestClient(Client):
                 raise NotFoundError(path)
             if resp.status == 409:
                 raise ConflictError(path)
-            if resp.status == 429 or resp.status >= 500:
+            if resp.status == 429:
+                raise TooManyRequestsError(
+                    f"{method} {path} -> {resp.status}: {data[:512]!r}"
+                )
+            if resp.status >= 500:
                 raise TransientAPIError(
                     f"{method} {path} -> {resp.status}: {data[:512]!r}"
                 )
@@ -190,11 +200,16 @@ class RestClient(Client):
         path = _resource_path(api_version, kind, namespace)
         params = {}
         if label_selector:
-            params["labelSelector"] = ",".join(
-                k if v in (None, "") else f"{k}={v}"
-                for k, v in label_selector.items()
-                if "*" not in str(v)
-            )
+            if isinstance(label_selector, str):
+                # raw apiserver grammar (set-based terms included) goes
+                # through verbatim — server-side filtering
+                params["labelSelector"] = label_selector
+            else:
+                from tpu_operator.kube.selector import encode_dict_selector
+
+                encoded = encode_dict_selector(label_selector)
+                if encoded:
+                    params["labelSelector"] = encoded
         if field_selector:
             params["fieldSelector"] = ",".join(
                 f"{k}={v}" for k, v in field_selector.items()
@@ -210,7 +225,14 @@ class RestClient(Client):
         for item in items:
             item.setdefault("apiVersion", api_version_out.replace("List", ""))
             item.setdefault("kind", kind)
-        if label_selector and any("*" in str(v) for v in label_selector.values()):
+        if (
+            label_selector
+            and not isinstance(label_selector, str)
+            and any(
+                not isinstance(v, (list, tuple)) and "*" in str(v)
+                for v in label_selector.values()
+            )
+        ):
             items = [o for o in items if match_labels(o, label_selector)]
         return items
 
@@ -219,9 +241,15 @@ class RestClient(Client):
         meta = obj.get("metadata", {})
         ns = meta.get("namespace", "")
         if kind == "Eviction":
-            # Eviction only exists as the pods/{name}/eviction subresource
+            # Eviction only exists as the pods/{name}/eviction subresource;
+            # a 429 here is a PodDisruptionBudget veto, not load shedding
             pod_path = _resource_path("v1", "Pod", ns, meta["name"])
-            return self._request("POST", pod_path + "/eviction", obj)
+            try:
+                return self._request("POST", pod_path + "/eviction", obj)
+            except TooManyRequestsError as e:
+                from tpu_operator.kube.client import EvictionBlockedError
+
+                raise EvictionBlockedError(str(e)) from e
         return self._request("POST", _resource_path(av, kind, ns), obj)
 
     def update(self, obj):

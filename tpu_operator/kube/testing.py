@@ -267,3 +267,19 @@ def seed_cluster(client, namespace: str, node_names=("fake-tpu-node-1",)) -> Non
         client.create(make_tpu_node(name))
     with open(sample_clusterpolicy_path()) as f:
         client.create(yaml.safe_load(f))
+
+
+def edit_clusterpolicy(client, fn, name="cluster-policy"):
+    """Conflict-retried ClusterPolicy spec edit for tests racing a live
+    operator: the annotation and status writers share the CR, so a raw
+    get→update pair 409s under an active manager."""
+    from tpu_operator import consts
+    from tpu_operator.kube.client import mutate_with_retry
+
+    def mutate(cp):
+        fn(cp)
+        return True
+
+    mutate_with_retry(
+        client, consts.API_VERSION, consts.CLUSTER_POLICY_KIND, name, mutate=mutate
+    )

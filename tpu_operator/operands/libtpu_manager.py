@@ -87,12 +87,12 @@ def uninstall_libtpu(
         pods = pods_to_evict()
         if pods:
             log.info("evicting %d TPU pods from %s", len(pods), node_name)
-            pm.delete_pods(pods, force=force)
+            pm.evict_pods(pods, force=force)
             # Graceful deletes leave pods listed (with deletionTimestamp) for
             # their grace period: wait for them to actually disappear — the
             # chip is single-client and the old libtpu stays mmapped until
             # the pod is gone. A pod with NO deletionTimestamp is either
-            # unmanaged (delete_pods skipped it; without force that's
+            # unmanaged (evict_pods skipped it; without force that's
             # terminal — waiting can't help) or a managed pod a controller
             # (re)created since the last pass — those get evicted again.
             deadline = time.monotonic() + eviction_timeout_s
@@ -119,7 +119,7 @@ def uninstall_libtpu(
                             len(stuck),
                         )
                         return 1
-                    pm.delete_pods(undeleted, force=force)
+                    pm.evict_pods(undeleted, force=force)
                 if time.monotonic() >= deadline:
                     log.error(
                         "%d TPU pods still terminating after %.0fs",

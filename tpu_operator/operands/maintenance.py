@@ -107,6 +107,9 @@ class MaintenanceHandler:
         self.evict = evict
         self.reader = reader or read_maintenance_event
         self._active = False
+        # evictions vetoed by a PDB are retried every poll while the
+        # window stays open (the budget may free up before the host dies)
+        self._evict_pending = False
 
     # -- conflict-safe node writes (shared-Node discipline) -------------
     def _mutate_node(self, mutate) -> None:
@@ -153,36 +156,72 @@ class MaintenanceHandler:
             return changed
 
         self._mutate_node(mutate)
-        evicted = 0
-        if self.evict:
-            from tpu_operator.upgrade.upgrade_state import PodManager
-
-            pods = PodManager(
-                self.client,
-                os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "default"),
-            )
-            victims = pods.tpu_pods_on_node(self.node_name)
-            if victims:
-                log.warning(
-                    "evicting %d TPU pod(s) ahead of maintenance", len(victims)
-                )
-                pods.delete_pods(victims, force=self.force)
-                evicted = len(victims)
+        action = self._evict_sweep()
         from tpu_operator.kube.events import TYPE_WARNING
 
-        # the Event must report what actually happened: cordon-only mode
-        # and an empty node must not claim workloads were evicted
-        if not self.evict:
-            action = "node cordoned (eviction disabled)"
-        elif evicted:
-            action = f"node cordoned and {evicted} TPU workload pod(s) evicted"
-        else:
-            action = "node cordoned; no TPU workload pods to evict"
         self._event(
             TYPE_WARNING,
             "HostMaintenanceImminent",
             f"{event}: {action} ahead of host maintenance",
         )
+
+    def _evict_sweep(self) -> str:
+        """One eviction pass over the node's TPU pods; returns the
+        truthful description for Events. Sets ``_evict_pending`` when
+        pods remain (PDB-vetoed or skipped-unmanaged) so the poll loop
+        keeps retrying for the whole window — the budget may free up
+        (a replica turns Ready elsewhere) before the host dies. With
+        ``force``, a PDB-vetoed pod is deleted outright (kubectl's
+        ``--disable-eviction`` escape hatch): the host termination will
+        kill it anyway, so under an imminent window force means force."""
+        self._evict_pending = False
+        if not self.evict:
+            return "node cordoned (eviction disabled)"
+        from tpu_operator.upgrade.upgrade_state import PodManager
+
+        pods = PodManager(
+            self.client,
+            os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "default"),
+        )
+        victims = pods.tpu_pods_on_node(self.node_name)
+        if not victims:
+            return "node cordoned; no TPU workload pods to evict"
+        log.warning(
+            "evicting %d TPU pod(s) ahead of maintenance", len(victims)
+        )
+        res = pods.evict_pods(victims, force=self.force)
+        if res.blocked and self.force:
+            # the node is doomed: eviction was vetoed but FORCE_EVICT
+            # promises removal — fall back to delete (disable-eviction
+            # semantics), loudly
+            for pod in pods.tpu_pods_on_node(self.node_name):
+                meta = pod["metadata"]
+                log.warning(
+                    "force-deleting %s/%s past its disruption budget "
+                    "(host maintenance imminent)",
+                    meta.get("namespace"),
+                    meta["name"],
+                )
+                self.client.delete_if_exists(
+                    "v1", "Pod", meta["name"], meta.get("namespace", "")
+                )
+                res.evicted += 1
+            res.blocked = []
+        parts = ["node cordoned"]
+        if res.evicted:
+            parts.append(f"{res.evicted} TPU workload pod(s) evicted")
+        if res.blocked:
+            parts.append(
+                f"{len(res.blocked)} eviction(s) vetoed by a disruption "
+                f"budget (will retry: {res.blocked[0]})"
+            )
+            self._evict_pending = True
+        if res.skipped:
+            parts.append(
+                f"{res.skipped} unmanaged pod(s) left alone (set "
+                "FORCE_EVICT=true to remove)"
+            )
+        return "; ".join(parts)
 
     def _leave_maintenance(self) -> None:
         log.info("maintenance window cleared on %s", self.node_name)
@@ -273,6 +312,12 @@ class MaintenanceHandler:
                     log.warning(
                         "maintenance cordon hit persistent 409s; retrying"
                     )
+            elif self._evict_pending:
+                # a PDB vetoed part of the sweep: keep retrying while the
+                # window is open — one-shot entry must not strand doomed
+                # workloads behind a budget that later frees up
+                log.info("retrying vetoed evictions (window still open)")
+                self._evict_sweep()
         elif self._active:
             try:
                 self._leave_maintenance()

@@ -27,7 +27,13 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from tpu_operator import consts
-from tpu_operator.kube.client import Client, Obj, mutate_with_retry
+from tpu_operator.kube.client import (
+    Client,
+    EvictionBlockedError,
+    NotFoundError,
+    Obj,
+    mutate_with_retry,
+)
 
 log = logging.getLogger("tpu-operator.upgrade")
 
@@ -223,6 +229,15 @@ class CordonManager:
         mutate_with_retry(self.client, "v1", "Node", node_name, mutate=mutate)
 
 
+@dataclass
+class EvictResult:
+    """What an eviction sweep actually did."""
+
+    evicted: int = 0
+    skipped: int = 0  # unmanaged pods left alone (non-force)
+    blocked: List[str] = field(default_factory=list)  # PDB-veto messages
+
+
 class PodManager:
     """Deletes/evicts TPU workload pods ahead of a libtpu swap (reference
     ``pod_manager.go``)."""
@@ -240,10 +255,18 @@ class PodManager:
                 pods.append(pod)
         return pods
 
-    def delete_pods(self, pods: List[Obj], force: bool = False) -> None:
-        """Without ``force``, unmanaged (ownerless) pods are left alone —
-        deleting them loses work permanently since no controller recreates
-        them (kubectl-drain ``--force`` semantics)."""
+    def evict_pods(self, pods: List[Obj], force: bool = False) -> "EvictResult":
+        """Evict through the Eviction subresource so PodDisruptionBudgets
+        can veto — never a bare Pod DELETE on workload pods (reference
+        drain path: ``vendor/.../upgrade/drain_manager.go:76-89`` via
+        kubectl's drain helper). The result reports exactly what happened
+        (evicted / PDB-vetoed / skipped-unmanaged) so callers can retry
+        level-triggered and Events can tell the truth.
+
+        Without ``force``, unmanaged (ownerless) pods are left alone —
+        disrupting them loses work permanently since no controller
+        recreates them (kubectl-drain ``--force`` semantics)."""
+        res = EvictResult()
         for pod in pods:
             meta = pod["metadata"]
             if not force and not meta.get("ownerReferences"):
@@ -252,13 +275,25 @@ class PodManager:
                     meta.get("namespace"),
                     meta["name"],
                 )
+                res.skipped += 1
                 continue
             log.info(
-                "deleting TPU pod %s/%s for upgrade", meta.get("namespace"), meta["name"]
+                "evicting TPU pod %s/%s for upgrade", meta.get("namespace"), meta["name"]
             )
-            self.client.delete_if_exists(
-                "v1", "Pod", meta["name"], meta.get("namespace", "")
-            )
+            try:
+                self.client.evict(meta["name"], meta.get("namespace", ""))
+                res.evicted += 1
+            except NotFoundError:
+                res.evicted += 1  # already gone: the goal state
+            except EvictionBlockedError as e:
+                log.warning(
+                    "eviction of %s/%s vetoed by disruption budget: %s",
+                    meta.get("namespace"),
+                    meta["name"],
+                    e,
+                )
+                res.blocked.append(str(e))
+        return res
 
     def operand_pods_on_node(self, node_name: str, app: str) -> List[Obj]:
         return [
@@ -277,14 +312,22 @@ class DrainManager:
     def __init__(self, client: Client, pod_manager: PodManager):
         self.client = client
         self.pods = pod_manager
+        # last PDB-veto message per node, surfaced in the drain-timeout
+        # failure Event so the operator can see WHY the drain stalled
+        self.last_block_reason: Dict[str, str] = {}
 
     def drain(self, node_name: str, spec) -> bool:
         if spec is not None and spec.enable is False:
             return True
         pods = self.pods.tpu_pods_on_node(node_name)
         if not pods:
+            self.last_block_reason.pop(node_name, None)
             return True
-        self.pods.delete_pods(pods, force=bool(spec and spec.force))
+        res = self.pods.evict_pods(pods, force=bool(spec and spec.force))
+        if res.blocked:
+            self.last_block_reason[node_name] = res.blocked[0]
+        else:
+            self.last_block_reason.pop(node_name, None)
         return not self.pods.tpu_pods_on_node(node_name)
 
 
@@ -513,7 +556,7 @@ class ClusterUpgradeStateManager:
             if policy.pod_deletion is not None:
                 node_name = ns.node["metadata"]["name"]
                 pods = self.pod_manager.tpu_pods_on_node(node_name)
-                self.pod_manager.delete_pods(
+                self.pod_manager.evict_pods(
                     pods, force=bool(policy.pod_deletion.force)
                 )
             self.provider.set_state(ns.node, STATE_DRAIN_REQUIRED)
@@ -534,12 +577,14 @@ class ClusterUpgradeStateManager:
                     self._drain_timeout(policy),
                 )
                 self.provider.set_state(ns.node, STATE_FAILED)
+                veto = self.drain.last_block_reason.get(node_name)
                 self._record_failure(
                     ns.node,
                     "UpgradeDrainTimeout",
                     f"libtpu upgrade drain exceeded "
                     f"{self._drain_timeout(policy):.0f}s; node stays cordoned "
-                    f"(clear {consts.UPGRADE_STATE_LABEL} to retry)",
+                    f"(clear {consts.UPGRADE_STATE_LABEL} to retry)"
+                    + (f". Last eviction veto: {veto}" if veto else ""),
                 )
 
         for ns in state.node_states.get(STATE_POD_RESTART_REQUIRED, []):
@@ -652,12 +697,22 @@ class ClusterUpgradeStateManager:
         return float(drain.timeout_seconds or 0)
 
     def _jobs_running(self, node_name: str, selector: str) -> bool:
-        sel = {}
-        for part in selector.split(","):
-            if "=" in part:
-                k, v = part.split("=", 1)
-                sel[k.strip()] = v.strip()
-        for pod in self.client.list("v1", "Pod", label_selector=sel or None):
+        """``waitForCompletion.podSelector`` is user-authored apiserver
+        selector grammar (the reference upgrade lib's pod-selector
+        option): forwarded verbatim, so set-based terms like
+        ``app in (train, batch)`` work exactly as against kubectl."""
+        from tpu_operator.kube.selector import parse_selector
+
+        try:
+            parse_selector(selector)
+        except ValueError:
+            log.error(
+                "waitForCompletion.podSelector %r is malformed; "
+                "treating as matching nothing",
+                selector,
+            )
+            return False
+        for pod in self.client.list("v1", "Pod", label_selector=selector or None):
             if pod.get("spec", {}).get("nodeName") == node_name and pod.get(
                 "status", {}
             ).get("phase") in ("Running", "Pending"):

@@ -131,10 +131,12 @@ struct Snapshot {
 
 class Collector {
  public:
-  Collector(std::string dev_root, std::string sample_file, std::string drop_file)
+  Collector(std::string dev_root, std::string sample_file, std::string drop_file,
+            double sample_max_age_s = 60.0)
       : dev_root_(std::move(dev_root)),
         sample_file_(std::move(sample_file)),
-        drop_file_(std::move(drop_file)) {}
+        drop_file_(std::move(drop_file)),
+        sample_max_age_s_(sample_max_age_s) {}
 
   void collect_once() {
     std::vector<char> buf(1 << 20);
@@ -144,6 +146,19 @@ class Collector {
     int count = tpuinfo_chip_count(dev_root_.c_str());
     std::string sample = read_file(sample_file_);
     bool have_sample = !sample.empty() && sample.front() == '{';
+    // age gate: a dead sampler must read as MISSING, not as its last
+    // value forever — the side-file's own "ts" stamp decides. A sample
+    // without a ts is treated as un-ageable and rejected the same way.
+    double sample_age = -1;
+    if (have_sample) {
+      double ts = find_number(sample, "ts");
+      if (std::isnan(ts)) {
+        have_sample = false;
+      } else {
+        sample_age = (double)::time(nullptr) - ts;
+        if (sample_age > sample_max_age_s_) have_sample = false;
+      }
+    }
     collections_++;
 
     std::string json = "{\"source\":\"tpu-metricsd-native\",\"ts\":" +
@@ -174,20 +189,30 @@ class Collector {
       double idx = find_number(chip, "index");
       int chip_id = std::isnan(idx) ? (int)pos : (int)idx;
       ++pos;
-      std::string label = "chip=\"" + std::to_string(chip_id) + "\"";
+      // source label = provenance (sampler / sysfs / devfs): a dashboard
+      // must be able to tell a measured number from a presence fact
+      std::string label =
+          "chip=\"" + std::to_string(chip_id) + "\",source=\"devfs\"";
       gauge("tpu_chip_present", "Chip device node visible", label, 1);
       double numa = find_number(chip, "numa_node");
       if (!std::isnan(numa))
-        gauge("tpu_chip_numa_node", "Chip NUMA affinity", label, numa);
+        gauge("tpu_chip_numa_node", "Chip NUMA affinity",
+              "chip=\"" + std::to_string(chip_id) + "\",source=\"sysfs\"",
+              numa);
     }
+    if (sample_age >= 0)
+      gauge("tpu_metricsd_sample_age_seconds",
+            "Age of the sampler side-file", "", sample_age);
     if (have_sample) {
-      gauge("tpu_metricsd_sample_fresh", "Sampler side-file present", "", 1);
+      gauge("tpu_metricsd_sample_fresh", "Sampler side-file present and fresh",
+            "", 1);
       size_t si = 0;
       for (const std::string& entry : split_objects(extract_array(sample, "chips"))) {
         double idx = find_number(entry, "index");
         int chip_id = std::isnan(idx) ? (int)si : (int)idx;
         ++si;
-        std::string label = "chip=\"" + std::to_string(chip_id) + "\"";
+        std::string label =
+            "chip=\"" + std::to_string(chip_id) + "\",source=\"sampler\"";
         double util = find_number(entry, "tensorcore_util");
         if (!std::isnan(util))
           gauge("tpu_tensorcore_utilization_percent",
@@ -208,7 +233,8 @@ class Collector {
                 label, hbm_total);
       }
     } else {
-      gauge("tpu_metricsd_sample_fresh", "Sampler side-file present", "", 0);
+      gauge("tpu_metricsd_sample_fresh", "Sampler side-file present and fresh",
+            "", 0);
     }
 
     {
@@ -248,6 +274,7 @@ class Collector {
   std::string dev_root_;
   std::string sample_file_;
   std::string drop_file_;
+  double sample_max_age_s_;
   std::mutex mu_;
   Snapshot snap_;
   long collections_ = 0;
@@ -294,6 +321,7 @@ int main(int argc, char** argv) {
   std::string sample_file = "/run/tpu/metricsd-sample.json";
   int port = 5555;
   double interval_s = 10.0;
+  double sample_max_age_s = 60.0;
   bool once = false;
 
   for (int i = 1; i < argc; ++i) {
@@ -304,16 +332,17 @@ int main(int argc, char** argv) {
     else if (a == "--sample-file") sample_file = next();
     else if (a == "--port") port = std::atoi(next());
     else if (a == "--interval") interval_s = std::atof(next());
+    else if (a == "--sample-max-age") sample_max_age_s = std::atof(next());
     else if (a == "--once") once = true;
     else if (a == "--help" || a == "-h") {
       std::printf(
           "tpu-metricsd [--port N] [--dev-root D] [--drop-file F]\n"
-          "             [--sample-file F] [--interval S] [--once]\n");
+          "             [--sample-file F] [--interval S] [--sample-max-age S] [--once]\n");
       return 0;
     }
   }
 
-  Collector collector(dev_root, sample_file, drop_file);
+  Collector collector(dev_root, sample_file, drop_file, sample_max_age_s);
   collector.collect_once();
   if (once) {
     std::printf("%s\n", collector.snapshot().json.c_str());

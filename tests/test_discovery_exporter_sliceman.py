@@ -120,7 +120,7 @@ def test_exporter_collect(dev_root):
     assert data["0"]["hbm_total"] == 16 * 2**30
     assert data["0"]["ici_links"] == 10.0  # 2x4 mesh links
     text = generate_latest(reg).decode()
-    assert 'tpu_chip_present{chip="0",node="n1"} 1.0' in text
+    assert 'tpu_chip_present{chip="0",node="n1",source="devfs"} 1.0' in text
     assert "tpu_hbm_total_bytes" in text
 
 
@@ -307,3 +307,44 @@ def test_slice_idempotent(slice_env):
     assert mgr.reconcile_once() == sm.STATE_SUCCESS
     rv_after = client.get("v1", "Node", "n1")["metadata"]["resourceVersion"]
     assert rv_before == rv_after  # no churn once applied
+
+
+def test_exporter_source_flip_removes_stale_series(dev_root, tmp_path):
+    """When a metric's provenance flips (sampler dies -> fallback), the
+    superseded source-labeled child must be REMOVED, not left frozen at
+    its last value — sum by (node, chip) would double-count."""
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    reg = CollectorRegistry()
+    exp = Exporter(
+        node_name="n1",
+        dev_root=dev_root,
+        enabled_metrics=["duty_cycle"],
+        registry=reg,
+    )
+    # scrape 1: sampler-provided duty cycle
+    exp._fetch_metricsd = lambda: {
+        "chips": [
+            {"index": 0, "duty_cycle": 83.0, "_sources": {"duty_cycle": "sampler"}}
+        ]
+    }
+    exp.collect_once()
+    text = generate_latest(reg).decode()
+    assert 'tpu_duty_cycle_percent{chip="0",node="n1",source="sampler"} 83.0' in text
+
+    # scrape 2: sampler gone, devfs fallback answers
+    exp._fetch_metricsd = lambda: None
+    import tpu_operator.exporter.exporter as ex
+
+    orig = ex.tpuinfo.metrics
+    ex.tpuinfo.metrics = lambda d: {
+        "source": "fallback",
+        "chips": [{"index": 0, "duty_cycle": 5.0}],
+    }
+    try:
+        exp.collect_once()
+    finally:
+        ex.tpuinfo.metrics = orig
+    text = generate_latest(reg).decode()
+    assert 'source="sampler"' not in text, "stale sampler series survived"
+    assert 'tpu_duty_cycle_percent{chip="0",node="n1",source="devfs"} 5.0' in text

@@ -1,0 +1,216 @@
+"""The device-plugin ↔ kubelet contract closed in ONE system (round-2
+missing #3): the shipped ``DevicePluginServer`` serves real gRPC on a
+unix socket, the kubelet device-manager sim performs Registration →
+ListAndWatch → Allocate, node ``status.capacity``/``allocatable`` are
+DERIVED from the advertisement (not hand-seeded), plugin-validation
+reads that derived capacity, and the slice-manager's subslice resources
+ride the same path. Reference posture:
+``/root/reference/validator/main.go:1083-1161`` reads capacity the real
+kubelet produced from the real plugin."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+from tpu_operator import consts
+from tpu_operator.kube.kubelet_sim import KubeletDeviceManager
+from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+from tpu_operator.kube.testing import seed_cluster
+from tpu_operator.plugin.server import DevicePluginServer, TPUDevicePluginServicer
+from tpu_operator.validator.components import (
+    StatusFiles,
+    ValidationError,
+    validate_plugin,
+)
+
+NS = "tpu-operator"
+NODE = "plug-node-1"
+
+
+def wait_until(pred, timeout_s=30.0, poll_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll_s)
+    return False
+
+
+@pytest.fixture()
+def rig(tmp_path):
+    """kubesim + node + kubelet device manager + real plugin over gRPC."""
+    server = KubeSimServer(KubeSim(bookmark_interval_s=1.0)).start()
+    client = make_client(server.port)
+    client.GET_RETRY_BACKOFF_S = 0.05
+    seed_cluster(client, NS, node_names=(NODE,))
+
+    dev_root = tmp_path / "dev"
+    dev_root.mkdir()
+    for i in range(4):
+        (dev_root / f"accel{i}").touch()
+    socket_dir = str(tmp_path / "kubelet")
+
+    kubelet = KubeletDeviceManager(client, NODE, socket_dir)
+    kubelet.start()
+
+    servicer = TPUDevicePluginServicer(
+        dev_root=str(dev_root),
+        generation="v5e",
+        host_topology="2x2",
+        cdi_enabled=True,
+        poll_interval_s=0.2,
+        health_probe_interval_s=3600,  # probes drive nothing here
+    )
+    plugin = DevicePluginServer(servicer, socket_dir=socket_dir)
+    plugin.start()
+    plugin.register_with_kubelet(kubelet.kubelet_socket)
+
+    yield client, kubelet, servicer, plugin, dev_root, socket_dir
+    plugin.stop()
+    kubelet.stop()
+    server.stop()
+
+
+def caps(client):
+    st = client.get("v1", "Node", NODE).get("status", {})
+    return st.get("capacity", {}), st.get("allocatable", {})
+
+
+def test_capacity_derived_from_advertisement(rig):
+    client, kubelet, servicer, plugin, dev_root, _ = rig
+    assert wait_until(
+        lambda: caps(client)[0].get(consts.TPU_RESOURCE) == "4"
+        and caps(client)[1].get(consts.TPU_RESOURCE) == "4"
+    ), caps(client)
+
+
+def test_plugin_validation_reads_kubelet_derived_capacity(rig, tmp_path):
+    client, kubelet, servicer, plugin, dev_root, _ = rig
+    assert wait_until(
+        lambda: caps(client)[1].get(consts.TPU_RESOURCE) == "4"
+    )
+    status = StatusFiles(str(tmp_path / "validations"))
+    info = validate_plugin(status, client, NODE, retries=3, sleep_s=0.1)
+    assert info["capacity"] == 4
+    assert info["allocatable"] == 4
+
+
+def test_unhealthy_chip_shrinks_allocatable_and_flips_validation(rig, tmp_path):
+    """The VERDICT's done-criterion: marking a chip Unhealthy in the
+    plugin shrinks node allocatable over the gRPC stream, and with every
+    chip Unhealthy the validator's plugin check fails even though
+    capacity still advertises 4."""
+    client, kubelet, servicer, plugin, dev_root, _ = rig
+    assert wait_until(
+        lambda: caps(client)[1].get(consts.TPU_RESOURCE) == "4"
+    )
+    servicer.mark_unhealthy("3")
+    assert wait_until(
+        lambda: caps(client)[1].get(consts.TPU_RESOURCE) == "3"
+    ), caps(client)
+    # capacity keeps the full set (kubelet semantics: capacity counts
+    # registered devices; allocatable subtracts the unhealthy)
+    assert caps(client)[0][consts.TPU_RESOURCE] == "4"
+
+    for i in range(4):
+        servicer.mark_unhealthy(str(i))
+    assert wait_until(
+        lambda: caps(client)[1].get(consts.TPU_RESOURCE) == "0"
+    ), caps(client)
+    status = StatusFiles(str(tmp_path / "validations"))
+    with pytest.raises(ValidationError, match="none are allocatable"):
+        validate_plugin(status, client, NODE, retries=2, sleep_s=0.05)
+
+    # recovery: chips pass probes again -> allocatable restored
+    for i in range(4):
+        servicer.mark_healthy(str(i))
+    assert wait_until(
+        lambda: caps(client)[1].get(consts.TPU_RESOURCE) == "4"
+    )
+    validate_plugin(status, client, NODE, retries=3, sleep_s=0.1)
+
+
+def test_device_removal_shrinks_capacity(rig):
+    """A chip vanishing from devfs (hardware gone, not just unhealthy)
+    leaves the advertisement entirely: capacity AND allocatable shrink."""
+    client, kubelet, servicer, plugin, dev_root, _ = rig
+    assert wait_until(
+        lambda: caps(client)[0].get(consts.TPU_RESOURCE) == "4"
+    )
+    os.unlink(str(dev_root / "accel3"))
+    servicer.refresh_devices()
+    assert wait_until(
+        lambda: caps(client)[0].get(consts.TPU_RESOURCE) == "3"
+        and caps(client)[1].get(consts.TPU_RESOURCE) == "3"
+    ), caps(client)
+
+
+def test_allocation_through_kubelet_path(rig):
+    """Admission-time allocation exactly as the kubelet drives it:
+    GetPreferredAllocation picks an ICI-contiguous pair, Allocate answers
+    CDI devices + the slice env."""
+    client, kubelet, servicer, plugin, dev_root, _ = rig
+    assert wait_until(
+        lambda: caps(client)[1].get(consts.TPU_RESOURCE) == "4"
+    )
+    resp = kubelet.allocate(consts.TPU_RESOURCE, 2)
+    cresp = resp.container_responses[0]
+    names = [d.name for d in cresp.cdi_devices]
+    assert len(names) == 2 and all(n.startswith("google.com/tpu=") for n in names)
+    assert cresp.envs["TPU_CHIPS_VISIBLE"]
+    assert cresp.envs["TPU_HOST_TOPOLOGY"] == "2x2"
+
+
+def test_subslice_resources_ride_the_same_path(rig, tmp_path):
+    """Slice-manager handoff over the kubelet contract: a partition state
+    file makes the PluginManager register ``google.com/tpu-<shape>``
+    plugins with the SAME kubelet, whose ListAndWatch feeds subslice
+    capacity into the node status; allocating one subslice expands to its
+    member chips."""
+    client, kubelet, servicer, plugin, dev_root, socket_dir = rig
+    from tpu_operator.plugin.manager import PluginManager
+    from tpu_operator.sliceman.slice_manager import write_partition_state
+
+    state_file = str(tmp_path / "partition.json")
+    write_partition_state(
+        {
+            "partitioned": True,
+            "topology": "2x2",
+            "generation": "v5e",
+            "shape": "1x2",
+            "subslices": [
+                {"id": 0, "shape": "1x2", "chips": [0, 1]},
+                {"id": 1, "shape": "1x2", "chips": [2, 3]},
+            ],
+        },
+        state_file,
+    )
+    mgr = PluginManager(
+        strategy="mixed",
+        socket_dir=socket_dir,
+        partition_file=state_file,
+        servicer_kw=dict(
+            dev_root=str(dev_root),
+            generation="v5e",
+            cdi_enabled=True,
+            poll_interval_s=0.2,
+        ),
+    )
+    try:
+        mgr.sync(register=True)
+        resource = consts.TPU_SUBSLICE_RESOURCE_PREFIX + "1x2"
+        assert wait_until(
+            lambda: caps(client)[1].get(resource) == "2"
+        ), caps(client)
+        resp = kubelet.allocate(resource, 1)
+        cresp = resp.container_responses[0]
+        # one subslice device expands to both member chips
+        assert cresp.envs["TPU_CHIPS_VISIBLE"] in ("0,1", "2,3")
+    finally:
+        mgr.stop()

@@ -89,15 +89,15 @@ def test_http_endpoints(daemon):
     assert snap["chip_count"] == 2
     prom = get(port, "/metrics")
     assert "tpu_metricsd_chips 2" in prom
-    assert 'tpu_chip_present{chip="0"} 1' in prom
-    assert 'tpu_chip_present{chip="1"} 1' in prom
+    assert 'tpu_chip_present{chip="0",source="devfs"} 1' in prom
+    assert 'tpu_chip_present{chip="1",source="devfs"} 1' in prom
     assert "tpu_metricsd_sample_fresh 0" in prom
 
 
 def test_sampler_sidefile_merge(daemon):
     port, paths = daemon
     payload = {
-        "ts": 1.0,
+        "ts": time.time(),
         "chips": [{"index": 0, "tensorcore_util": 87.5, "hbm_used": 2048}],
     }
     with open(paths["sample"], "w") as f:
@@ -110,8 +110,11 @@ def test_sampler_sidefile_merge(daemon):
         time.sleep(0.2)
     assert snap["sample"]["chips"][0]["tensorcore_util"] == 87.5
     prom = get(port, "/metrics")
-    assert 'tpu_tensorcore_utilization_percent{chip="0"} 87.5' in prom
-    assert 'tpu_hbm_used_bytes{chip="0"} 2048' in prom
+    assert (
+        'tpu_tensorcore_utilization_percent{chip="0",source="sampler"} 87.5'
+        in prom
+    )
+    assert 'tpu_hbm_used_bytes{chip="0",source="sampler"} 2048' in prom
     assert "tpu_metricsd_sample_fresh 1" in prom
 
 
@@ -127,7 +130,10 @@ def test_exporter_scrapes_native_hostengine(daemon):
     object_controls.go:95-98): sampler counters flow through to gauges."""
     port, paths = daemon
     with open(paths["sample"], "w") as f:
-        json.dump({"chips": [{"index": 0, "tensorcore_util": 55.0}]}, f)
+        json.dump(
+            {"ts": time.time(), "chips": [{"index": 0, "tensorcore_util": 55.0}]},
+            f,
+        )
     time.sleep(0.8)
 
     from prometheus_client import CollectorRegistry
@@ -320,7 +326,7 @@ def test_native_per_chip_attribution_with_sparse_keys(daemon):
     with open(paths["sample"], "w") as f:
         json.dump(
             {
-                "ts": 1.0,
+                "ts": time.time(),
                 "chips": [
                     {"index": 0, "tensorcore_util": 50.0},
                     {"index": 1, "tensorcore_util": 60.0, "hbm_used": 200},
@@ -335,10 +341,16 @@ def test_native_per_chip_attribution_with_sparse_keys(daemon):
         if "tpu_hbm_used_bytes" in prom:
             break
         time.sleep(0.2)
-    assert 'tpu_hbm_used_bytes{chip="1"} 200' in prom
-    assert 'tpu_hbm_used_bytes{chip="0"}' not in prom
-    assert 'tpu_tensorcore_utilization_percent{chip="0"} 50' in prom
-    assert 'tpu_tensorcore_utilization_percent{chip="1"} 60' in prom
+    assert 'tpu_hbm_used_bytes{chip="1",source="sampler"} 200' in prom
+    assert 'tpu_hbm_used_bytes{chip="0"' not in prom
+    assert (
+        'tpu_tensorcore_utilization_percent{chip="0",source="sampler"} 50'
+        in prom
+    )
+    assert (
+        'tpu_tensorcore_utilization_percent{chip="1",source="sampler"} 60'
+        in prom
+    )
 
 
 def test_native_dropfile_without_directory(dev_root, tmp_path):
@@ -379,3 +391,54 @@ def test_bench_telemetry_chain_end_to_end():
     assert out["duty_cycle_percent"] == 99.0
     assert out["native_duty_cycle_percent"] == 99.0
     assert out["hbm_used_bytes"] == 123456.0
+
+
+def test_stale_sample_is_age_gated(daemon):
+    """A dead sampler must read as MISSING, not as its last value forever
+    (round-2 weak #3): a side-file older than --sample-max-age is
+    rejected — sample_fresh 0, no sampler series, and /json omits the
+    sample block so the exporter can't resurrect it either."""
+    port, paths = daemon
+    with open(paths["sample"], "w") as f:
+        json.dump(
+            {
+                "ts": time.time() - 3600,  # an hour-dead sampler
+                "chips": [{"index": 0, "tensorcore_util": 99.0}],
+            },
+            f,
+        )
+    time.sleep(0.8)
+    prom = get(port, "/metrics")
+    assert "tpu_metricsd_sample_fresh 0" in prom
+    assert "tpu_tensorcore_utilization_percent" not in prom
+    assert "tpu_metricsd_sample_age_seconds" in prom
+    snap = json.loads(get(port, "/json"))
+    assert "sample" not in snap
+
+    # a fresh write revives the chain
+    with open(paths["sample"], "w") as f:
+        json.dump(
+            {"ts": time.time(), "chips": [{"index": 0, "tensorcore_util": 42.0}]},
+            f,
+        )
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        prom = get(port, "/metrics")
+        if "tpu_metricsd_sample_fresh 1" in prom:
+            break
+        time.sleep(0.2)
+    assert (
+        'tpu_tensorcore_utilization_percent{chip="0",source="sampler"} 42'
+        in prom
+    )
+
+
+def test_unstamped_sample_is_rejected(daemon):
+    """A sample without a ts cannot be age-checked: fail closed."""
+    port, paths = daemon
+    with open(paths["sample"], "w") as f:
+        json.dump({"chips": [{"index": 0, "tensorcore_util": 77.0}]}, f)
+    time.sleep(0.8)
+    prom = get(port, "/metrics")
+    assert "tpu_metricsd_sample_fresh 0" in prom
+    assert "tpu_tensorcore_utilization_percent" not in prom

@@ -603,9 +603,12 @@ def test_wait_for_jobs_set_based_selector(cluster):
     assert node_state(cluster, "node-1") != us.STATE_WAIT_FOR_JOBS_REQUIRED
 
 
-def test_wait_for_jobs_malformed_selector_does_not_wedge(cluster):
-    """A malformed podSelector is logged and treated as matching nothing
-    (never an unhandled 400 aborting the whole upgrade pass)."""
+def test_wait_for_jobs_malformed_selector_fails_closed(cluster):
+    """A malformed podSelector must FAIL CLOSED: the gate exists to
+    protect running jobs from the drain, so reading it as matching
+    nothing would disrupt exactly the workloads it shields. The node
+    holds in wait-for-jobs (never an unhandled 400 aborting the pass)
+    until the wait budget expires, which proceeds loudly as designed."""
     mgr = us.ClusterUpgradeStateManager(cluster, NS)
     policy = UpgradePolicySpec(
         auto_upgrade=True,
@@ -617,7 +620,8 @@ def test_wait_for_jobs_malformed_selector_does_not_wedge(cluster):
         },
     )
     pump(mgr, policy, times=5)
-    assert node_state(cluster, "node-1") not in (
-        us.STATE_UNKNOWN,
-        us.STATE_WAIT_FOR_JOBS_REQUIRED,
-    )
+    assert node_state(cluster, "node-1") == us.STATE_WAIT_FOR_JOBS_REQUIRED
+    # the timed budget still bounds the hold: expiry proceeds
+    _age_node_state(cluster, "node-1", 601)
+    pump(mgr, policy, times=1)
+    assert node_state(cluster, "node-1") != us.STATE_WAIT_FOR_JOBS_REQUIRED

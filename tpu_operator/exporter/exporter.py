@@ -73,9 +73,20 @@ class Exporter:
         self._stop = threading.Event()
         self.gauges: Dict[str, object] = {}
         kw = {"registry": registry} if registry is not None else {}
+        # (key, chip) -> source last exported; a provenance flip (sampler
+        # dies -> devfs fallback) must REMOVE the superseded child, or the
+        # old-source series stays frozen at its last value forever and a
+        # `sum by (node, chip)` double-counts
+        self._last_source: Dict[tuple, str] = {}
         for key in self.enabled:
             name, doc = ALL_METRICS[key]
-            self.gauges[key] = Gauge(name, doc, ["node", "chip"], **kw)
+            # every series carries its provenance (round-2 weak #3):
+            # sampler = chip-owning JAX process's side-file counters,
+            # sysfs = native hostengine probes, devfs = presence-only
+            # device-node facts, spec = rated values from the generation
+            # table — so a dashboard can tell a measured number from a
+            # nameplate one
+            self.gauges[key] = Gauge(name, doc, ["node", "chip", "source"], **kw)
 
     def _fetch_metricsd(self) -> Optional[dict]:
         """Scrape the standalone hostengine's /json (reference
@@ -103,8 +114,12 @@ class Exporter:
                 chip.setdefault("present", 1)
                 extra = sample_by_idx.get(chip.get("index"))
                 if extra:
-                    chip.update(
-                        {k: v for k, v in extra.items() if k != "index"}
+                    merged = {k: v for k, v in extra.items() if k != "index"}
+                    chip.update(merged)
+                    # provenance: these keys came from the chip-owning
+                    # sampler, not the hostengine's own probes
+                    chip.setdefault("_sources", {}).update(
+                        {k: "sampler" for k in merged}
                     )
             return data
         except Exception:
@@ -115,29 +130,46 @@ class Exporter:
         """One scrape of metricsd (preferred) or libtpuinfo -> gauge
         updates. Returns {chip: {key: v}} for tests."""
         data = self._fetch_metricsd() or tpuinfo.metrics(self.dev_root)
+        # the backend's own provenance: the native hostengine/libtpuinfo
+        # probe sysfs; the pure-python fallback only proves devfs presence
+        backend_source = (
+            "devfs" if data.get("source") == "fallback" else "sysfs"
+        )
         out: Dict[str, Dict[str, float]] = {}
         chips = data.get("chips", [])
         for chip in chips:
             cid = str(chip.get("index", 0))
+            key_sources = chip.get("_sources", {}) or {}
             values = {}
             for key in self.enabled:
+                source = key_sources.get(key, backend_source)
                 if key == "present":
                     values[key] = float(chip.get("present", 1))
+                    source = key_sources.get(key, "devfs")
                 elif key == "hbm_total" and self.generation:
                     values[key] = topo.HBM_GB.get(self.generation, 0) * 2**30
+                    source = "spec"  # nameplate, not a measurement
                 elif key == "ici_links" and self.host_topology:
                     values[key] = float(
                         topo.ici_link_count(
                             self.host_topology, self.generation or "v5e"
                         )
                     )
+                    source = "spec"
                 elif key in chip:
                     values[key] = float(chip[key])
                 else:
                     continue
-                self.gauges[key].labels(node=self.node_name, chip=cid).set(
-                    values[key]
-                )
+                prev = self._last_source.get((key, cid))
+                if prev is not None and prev != source:
+                    try:
+                        self.gauges[key].remove(self.node_name, cid, prev)
+                    except KeyError:
+                        pass
+                self._last_source[(key, cid)] = source
+                self.gauges[key].labels(
+                    node=self.node_name, chip=cid, source=source
+                ).set(values[key])
             out[cid] = values
         return out
 

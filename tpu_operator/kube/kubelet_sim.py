@@ -1,0 +1,222 @@
+"""Kubelet device-manager simulator — the kubelet's half of the
+DevicePlugin gRPC contract.
+
+The reference's plugin check reads node capacity the *real kubelet*
+produced from the *real plugin*'s advertisement
+(``/root/reference/validator/main.go:1083-1161``). Round 2 hand-seeded
+that capacity, so the loop plugin → kubelet → capacity → plugin-validation
+never closed in one system. This module closes it: it serves the
+``v1beta1.Registration`` service on ``kubelet.sock``, and when the
+shipped ``DevicePluginServer`` registers, it dials the plugin's endpoint
+back, consumes ``ListAndWatch``, and derives the node's
+``status.capacity`` / ``status.allocatable`` from the advertisement
+exactly like the kubelet's device manager:
+
+* ``capacity[resource]``   = all advertised devices,
+* ``allocatable[resource]`` = healthy devices only,
+
+so marking a chip Unhealthy in the plugin shrinks allocatable over the
+wire. ``allocate()`` drives admission the way the kubelet does —
+``GetPreferredAllocation`` (when offered) then ``Allocate``.
+
+Used by the kubesim e2e and the ``--kubesim`` dev loop; everything it
+talks to is production code (the real gRPC servicer over a real unix
+socket, the real RestClient against kubesim).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from concurrent import futures
+from typing import Dict, Optional
+
+import grpc
+
+from tpu_operator.kube.client import Client, mutate_with_retry
+from tpu_operator.plugin import grpc_glue
+from tpu_operator.plugin.proto import pb2
+
+log = logging.getLogger("tpu-kubelet-sim")
+
+HEALTHY = "Healthy"
+
+
+class KubeletDeviceManager:
+    """Registration server + per-resource ListAndWatch consumers +
+    capacity writer for ONE node."""
+
+    def __init__(self, client: Client, node_name: str, socket_dir: str):
+        self.client = client
+        self.node_name = node_name
+        self.socket_dir = socket_dir
+        self.kubelet_socket = os.path.join(socket_dir, "kubelet.sock")
+        # resource -> {device_id: health}
+        self.resources: Dict[str, Dict[str, str]] = {}
+        self._endpoints: Dict[str, str] = {}
+        self._channels: Dict[str, grpc.Channel] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._server: Optional[grpc.Server] = None
+        self._threads: list = []
+
+    # -- Registration service (what the plugin dials) -------------------
+    def Register(self, request, context):
+        resource = request.resource_name
+        endpoint = os.path.join(self.socket_dir, request.endpoint)
+        log.info(
+            "plugin registered: %s at %s (version %s)",
+            resource,
+            endpoint,
+            request.version,
+        )
+        with self._lock:
+            # re-registration replaces the previous stream (kubelet
+            # behavior on plugin restart)
+            self._endpoints[resource] = endpoint
+        t = threading.Thread(
+            target=self._consume,
+            args=(resource, endpoint),
+            daemon=True,
+            name=f"kubelet-law-{resource}",
+        )
+        t.start()
+        self._threads.append(t)
+        return pb2.Empty()
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> str:
+        os.makedirs(self.socket_dir, exist_ok=True)
+        if os.path.exists(self.kubelet_socket):
+            os.unlink(self.kubelet_socket)
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers(
+            (grpc_glue.registration_handler(self),)
+        )
+        self._server.add_insecure_port(f"unix://{self.kubelet_socket}")
+        self._server.start()
+        return self.kubelet_socket
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            channels = list(self._channels.values())
+            self._channels.clear()
+        for ch in channels:
+            try:
+                ch.close()
+            except Exception:
+                pass
+        if self._server is not None:
+            self._server.stop(grace=1)
+
+    # -- ListAndWatch consumption ---------------------------------------
+    def _consume(self, resource: str, endpoint: str) -> None:
+        channel = grpc.insecure_channel(f"unix://{endpoint}")
+        with self._lock:
+            if self._endpoints.get(resource) != endpoint:
+                channel.close()
+                return
+            old = self._channels.pop(resource, None)
+            self._channels[resource] = channel
+        if old is not None:
+            old.close()  # cancels the zombie stream's consumer
+        stub = grpc_glue.DevicePluginStub(channel)
+        try:
+            stub.GetDevicePluginOptions(pb2.Empty())
+            for resp in stub.ListAndWatch(pb2.Empty()):
+                if self._stop.is_set():
+                    return
+                with self._lock:
+                    if self._endpoints.get(resource) != endpoint:
+                        return  # superseded by a re-registration
+                    self.resources[resource] = {
+                        d.ID: d.health for d in resp.devices
+                    }
+                self._write_node_status()
+        except grpc.RpcError:
+            if self._stop.is_set():
+                return
+            log.warning("ListAndWatch stream for %s ended", resource)
+            # plugin died: the kubelet zeroes allocatable but keeps the
+            # capacity entry until a re-registration or restart
+            with self._lock:
+                if self._endpoints.get(resource) != endpoint:
+                    return
+                devs = self.resources.get(resource, {})
+                self.resources[resource] = {
+                    i: "Unhealthy" for i in devs
+                }
+            self._write_node_status()
+
+    def _write_node_status(self) -> None:
+        with self._lock:
+            snapshot = {r: dict(d) for r, d in self.resources.items()}
+
+        def mutate(node):
+            status = node.setdefault("status", {})
+            cap = status.setdefault("capacity", {})
+            alloc = status.setdefault("allocatable", {})
+            changed = False
+            for resource, devices in snapshot.items():
+                total = str(len(devices))
+                healthy = str(
+                    sum(1 for h in devices.values() if h == HEALTHY)
+                )
+                if cap.get(resource) != total:
+                    cap[resource] = total
+                    changed = True
+                if alloc.get(resource) != healthy:
+                    alloc[resource] = healthy
+                    changed = True
+            # resources are never removed once advertised: the
+            # DevicePlugin API has no unregister — a dead plugin reads as
+            # allocatable 0 with capacity retained (see the stream-loss
+            # path in _consume), and only a kubelet restart forgets a
+            # resource entirely, which this steady-state sim doesn't model
+            return changed
+
+        try:
+            mutate_with_retry(
+                self.client, "v1", "Node", self.node_name, mutate=mutate
+            )
+        except Exception:
+            log.exception("failed to write node device status")
+
+    # -- admission-time allocation (what placing a pod does) -------------
+    def allocate(
+        self, resource: str, count: int, must_include=()
+    ) -> pb2.AllocateResponse:
+        """GetPreferredAllocation (when the plugin offers it) → Allocate,
+        the kubelet's pod-admission sequence."""
+        with self._lock:
+            channel = self._channels.get(resource)
+            devices = dict(self.resources.get(resource, {}))
+        if channel is None:
+            raise RuntimeError(f"no registered plugin for {resource}")
+        stub = grpc_glue.DevicePluginStub(channel)
+        healthy = sorted(
+            (i for i, h in devices.items() if h == HEALTHY), key=str
+        )
+        if len(healthy) < count:
+            raise RuntimeError(
+                f"{resource}: want {count}, only {len(healthy)} allocatable"
+            )
+        opts = stub.GetDevicePluginOptions(pb2.Empty())
+        chosen = healthy[:count]
+        if opts.get_preferred_allocation_available:
+            req = pb2.GetPreferredAllocationRequest()
+            creq = req.container_requests.add()
+            creq.available_deviceIDs.extend(healthy)
+            creq.must_include_deviceIDs.extend(str(m) for m in must_include)
+            creq.allocation_size = count
+            pref = stub.GetPreferredAllocation(req)
+            if pref.container_responses:
+                ids = list(pref.container_responses[0].deviceIDs)
+                if ids:
+                    chosen = ids[:count]
+        areq = pb2.AllocateRequest()
+        acreq = areq.container_requests.add()
+        acreq.devicesIDs.extend(chosen)
+        return stub.Allocate(areq)

@@ -190,11 +190,14 @@ class MaintenanceHandler:
             "evicting %d TPU pod(s) ahead of maintenance", len(victims)
         )
         res = pods.evict_pods(victims, force=self.force)
-        if res.blocked and self.force:
+        forced = 0
+        if res.blocked_pods and self.force:
             # the node is doomed: eviction was vetoed but FORCE_EVICT
             # promises removal — fall back to delete (disable-eviction
-            # semantics), loudly
-            for pod in pods.tpu_pods_on_node(self.node_name):
+            # semantics), loudly, targeting EXACTLY the vetoed pods (a
+            # re-list would double-count pods already evicted and merely
+            # terminating through their grace period)
+            for pod in res.blocked_pods:
                 meta = pod["metadata"]
                 log.warning(
                     "force-deleting %s/%s past its disruption budget "
@@ -205,11 +208,16 @@ class MaintenanceHandler:
                 self.client.delete_if_exists(
                     "v1", "Pod", meta["name"], meta.get("namespace", "")
                 )
-                res.evicted += 1
+                forced += 1
             res.blocked = []
+            res.blocked_pods = []
         parts = ["node cordoned"]
         if res.evicted:
             parts.append(f"{res.evicted} TPU workload pod(s) evicted")
+        if forced:
+            parts.append(
+                f"{forced} pod(s) force-deleted past their disruption budget"
+            )
         if res.blocked:
             parts.append(
                 f"{len(res.blocked)} eviction(s) vetoed by a disruption "

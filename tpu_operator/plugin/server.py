@@ -176,7 +176,11 @@ class TPUDevicePluginServicer:
                 self._poller.start()
 
     def _poll_loop(self):
-        last_probe = 0.0
+        # start the probe clock NOW: monotonic() is huge, so a 0.0 seed
+        # would fire the first probe on the first tick no matter what
+        # health_probe_interval_s says — overriding health decisions an
+        # external prober just made
+        last_probe = time.monotonic()
         while not self._stop.wait(self.poll_interval_s):
             try:
                 self.refresh_devices()

@@ -236,6 +236,9 @@ class EvictResult:
     evicted: int = 0
     skipped: int = 0  # unmanaged pods left alone (non-force)
     blocked: List[str] = field(default_factory=list)  # PDB-veto messages
+    # the vetoed pods themselves: a force fallback must target exactly
+    # these, not a re-list that double-counts already-terminating pods
+    blocked_pods: List[Obj] = field(default_factory=list)
 
 
 class PodManager:
@@ -293,6 +296,7 @@ class PodManager:
                     e,
                 )
                 res.blocked.append(str(e))
+                res.blocked_pods.append(pod)
         return res
 
     def operand_pods_on_node(self, node_name: str, app: str) -> List[Obj]:
@@ -706,12 +710,17 @@ class ClusterUpgradeStateManager:
         try:
             parse_selector(selector)
         except ValueError:
+            # FAIL CLOSED: this gate protects running jobs from the
+            # drain. Reading a malformed selector as "matching nothing"
+            # would disrupt exactly the workloads it was written to
+            # shield; holding the node reads as "jobs running" until the
+            # wait budget expires (which proceeds loudly, as designed).
             log.error(
-                "waitForCompletion.podSelector %r is malformed; "
-                "treating as matching nothing",
+                "waitForCompletion.podSelector %r is malformed; holding "
+                "wait-for-jobs until its timeout (fix the selector)",
                 selector,
             )
-            return False
+            return True
         for pod in self.client.list("v1", "Pod", label_selector=selector or None):
             if pod.get("spec", {}).get("nodeName") == node_name and pod.get(
                 "status", {}

@@ -165,8 +165,8 @@ def validate_runtime(
 # ---------------------------------------------------------------------------
 
 
-def node_tpu_capacity(node: dict) -> int:
-    cap = node.get("status", {}).get("capacity", {}) or {}
+def node_tpu_capacity(node: dict, field: str = "capacity") -> int:
+    cap = node.get("status", {}).get(field, {}) or {}
     total = 0
     for key, val in cap.items():
         if key == consts.TPU_RESOURCE or key.startswith(
@@ -179,6 +179,18 @@ def node_tpu_capacity(node: dict) -> int:
     return total
 
 
+def node_tpu_allocatable(node: dict) -> int:
+    """Healthy (schedulable) chips: the kubelet's device manager writes
+    ``allocatable = capacity - unhealthy``, so a node whose chips all
+    failed their open-probe advertises capacity N / allocatable 0 — a
+    distinction the capacity-only reference check can't see."""
+    status = node.get("status", {})
+    if not status.get("allocatable"):
+        # no kubelet allocatable accounting (older sims): fall back
+        return node_tpu_capacity(node)
+    return node_tpu_capacity(node, field="allocatable")
+
+
 def validate_plugin(
     status: StatusFiles,
     client,
@@ -189,18 +201,22 @@ def validate_plugin(
     retries: int = PLUGIN_RETRIES,
     sleep_s: float = WAIT_SLEEP_S,
 ) -> dict:
-    """Node capacity advertises TPU chips (reference ``:1083-1161``), then
-    optionally proves schedulability with a 1-chip pod (``:931-1015``)."""
+    """Node capacity advertises TPU chips (reference ``:1083-1161``) AND
+    at least one is allocatable (healthy per the device manager — an
+    all-chips-Unhealthy node passes the reference's capacity-only check
+    but can never schedule), then optionally proves schedulability with a
+    1-chip pod (``:931-1015``)."""
     if with_wait:
         status.wait_for(consts.STATUS_FILE_RUNTIME)
-    count = 0
+    count = allocatable = 0
     for attempt in range(retries):
         node = client.get("v1", "Node", node_name)
         count = node_tpu_capacity(node)
-        if count > 0:
+        allocatable = node_tpu_allocatable(node)
+        if count > 0 and allocatable > 0:
             break
         log.info(
-            "node %s reports no %s capacity yet (attempt %d)",
+            "node %s reports no allocatable %s yet (attempt %d)",
             node_name,
             consts.TPU_RESOURCE,
             attempt,
@@ -210,7 +226,12 @@ def validate_plugin(
         raise ValidationError(
             f"node {node_name} never advertised {consts.TPU_RESOURCE}"
         )
-    info = {"node": node_name, "capacity": count}
+    if allocatable <= 0:
+        raise ValidationError(
+            f"node {node_name} advertises {count} {consts.TPU_RESOURCE} "
+            "but none are allocatable (all chips Unhealthy)"
+        )
+    info = {"node": node_name, "capacity": count, "allocatable": allocatable}
     if with_workload:
         from tpu_operator.validator import workload_pods
 

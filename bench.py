@@ -471,9 +471,15 @@ def main() -> int:
     from tpu_operator.workloads.flashattn import run_flashattn_probe
 
     if on_tpu:
-        fa = run_flashattn_probe(seq=8192, heads=8, expect_tpu=True)
-        if not fa.ok:
-            fa = run_flashattn_probe(seq=8192, heads=8, expect_tpu=True)
+        # best-of-3 like membw: single flash runs vary ±30% with
+        # chip/tunnel state (compile-server round-trips pollute the
+        # shorter timing window far more than the long matmul chain),
+        # and the max is the sustained-capable rate
+        fa_runs = [
+            run_flashattn_probe(seq=8192, heads=8, expect_tpu=True)
+            for _ in range(3)
+        ]
+        fa = max(fa_runs, key=lambda r: r.tflops if r.ok else -1.0)
     else:
         fa = run_flashattn_probe(seq=256, heads=2, block_q=128, block_k=128)
 

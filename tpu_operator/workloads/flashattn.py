@@ -94,43 +94,82 @@ def make_flash_fn(
         if causal:
             # blocks fully above the diagonal contribute nothing
             hi = diag_stop(i, block_q, block_k)
+            # blocks fully BELOW the diagonal need no mask at all: every
+            # kpos <= every qpos when (j+1)*block_k - 1 <= i*block_q.
+            # Masking them anyway costs two iotas + compare + select on
+            # (block_q, block_k) per block — pure VPU overhead on the
+            # vast majority of blocks at long seq (the MXU sits idle
+            # while the VPU grinds); splitting the loop removes it
+            n_full = (i * block_q) // block_k
         else:
             hi = n_k_blocks
+            n_full = n_k_blocks
 
-        def body(j, carry):
-            m, l, acc = carry
-            k = k_ref[0, pl.ds(j * block_k, block_k), :]
-            s = (
-                lax.dot_general(
-                    q, k, (((1,), (1,)), ((), ())),
+        def make_body(masked: bool):
+            def body(j, carry):
+                m, l, acc = carry
+                k = k_ref[0, pl.ds(j * block_k, block_k), :]
+                s = (
+                    lax.dot_general(
+                        q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    * scale
+                )
+                if masked:
+                    qpos = i * block_q + lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 0
+                    )
+                    kpos = j * block_k + lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 1
+                    )
+                    s = jnp.where(qpos >= kpos, s, -jnp.inf)
+                m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+                alpha = jnp.exp(m - m_new)
+                p = jnp.exp(s - m_new)
+                l_new = alpha * l + p.sum(axis=-1, keepdims=True)
+                v = v_ref[0, pl.ds(j * block_k, block_k), :]
+                acc_new = acc * alpha + lax.dot_general(
+                    p.astype(jnp.bfloat16), v, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
-                * scale
-            )
-            if causal:
-                qpos = i * block_q + lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0
-                )
-                kpos = j * block_k + lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1
-                )
-                s = jnp.where(qpos >= kpos, s, -jnp.inf)
-            m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-            alpha = jnp.exp(m - m_new)
-            p = jnp.exp(s - m_new)
-            l_new = alpha * l + p.sum(axis=-1, keepdims=True)
-            v = v_ref[0, pl.ds(j * block_k, block_k), :]
-            acc_new = acc * alpha + lax.dot_general(
-                p.astype(jnp.bfloat16), v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            return m_new, l_new, acc_new
+                return m_new, l_new, acc_new
+
+            return body
 
         m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
         l0 = jnp.zeros((block_q, 1), jnp.float32)
         acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
-        m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, acc0))
+        carry = lax.fori_loop(0, n_full, make_body(False), (m0, l0, acc0))
+        if causal:
+            # only the diagonal-straddling tail pays for masking
+            carry = lax.fori_loop(n_full, hi, make_body(True), carry)
+        m, l, acc = carry
         o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+    kwargs = {}
+    if not interpret:
+        # every grid step is independent (the flash carry lives INSIDE
+        # one kernel instance): telling Mosaic both dims are parallel
+        # frees its scheduler to reorder/partition grid steps. The API
+        # moved across jax versions (TPUCompilerParams + strings before
+        # CompilerParams + GridDimensionSemantics); a jax without either
+        # still runs the kernel, just without the scheduling hint —
+        # never fail the probe over an optional optimization.
+        try:
+            from jax.experimental.pallas import tpu as pltpu
+
+            params_cls = getattr(pltpu, "CompilerParams", None) or getattr(
+                pltpu, "TPUCompilerParams", None
+            )
+            sem = getattr(pltpu, "GridDimensionSemantics", None)
+            parallel = sem.PARALLEL if sem is not None else "parallel"
+            if params_cls is not None:
+                kwargs["compiler_params"] = params_cls(
+                    dimension_semantics=(parallel, parallel)
+                )
+        except Exception:  # pragma: no cover - version-dependent
+            pass
 
     def flash(q, k, v):
         return pl.pallas_call(
@@ -146,6 +185,7 @@ def make_flash_fn(
                 (1, block_q, head_dim), lambda h, i: (h, i, 0)
             ),
             interpret=interpret,
+            **kwargs,
         )(q, k, v)
 
     return jax.jit(flash)
@@ -181,7 +221,7 @@ def run_flashattn_probe(
     seq: int = 2048,
     heads: int = 8,
     head_dim: int = LANES,
-    block_q: int = 256,
+    block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     causal: bool = True,
     iters: int = 64,
@@ -205,7 +245,11 @@ def run_flashattn_probe(
         if expect_tpu and not on_tpu:
             raise RuntimeError(f"expected TPU, found platform={dev.platform}")
         interpret = not on_tpu
-        bk = block_k if block_k is not None else min(1024, seq)
+        # measured optimum on v5e at seq 8192 (block sweep, round 3):
+        # 512/2048 beats the round-2 256/1024 by ~40% — fewer
+        # softmax/carry rounds per FLOP; 512/4096 exceeds VMEM
+        bq = block_q if block_q is not None else min(512, seq)
+        bk = block_k if block_k is not None else min(2048, seq)
 
         key = jax.random.PRNGKey(11)
         kq, kk, kv = jax.random.split(key, 3)
@@ -215,7 +259,7 @@ def run_flashattn_probe(
         v = jax.random.normal(kv, shape, jnp.bfloat16)
 
         flash = make_flash_fn(
-            seq, heads, head_dim, block_q, bk, causal, interpret
+            seq, heads, head_dim, bq, bk, causal, interpret
         )
         out = flash(q, k, v)
         ref = reference_attention(q, k, v, causal)
@@ -228,7 +272,7 @@ def run_flashattn_probe(
             )
 
         flops = (
-            causal_flops(seq, heads, head_dim, block_q, bk)
+            causal_flops(seq, heads, head_dim, bq, bk)
             if causal
             else 4.0 * heads * seq * seq * head_dim
         )

@@ -572,3 +572,45 @@ def test_crd_schema_defaulting_at_admission(cluster):
     del cp["spec"]["libtpu"]["upgradePolicy"]["maxUnavailable"]
     updated = client.update(cp)
     assert updated["spec"]["libtpu"]["upgradePolicy"]["maxUnavailable"] == "25%"
+
+
+def test_statusless_put_preserves_status(cluster):
+    """Apiserver semantics for every kind: re-applying a manifest without
+    a status block (the operator's hash-gated update path) must not wipe
+    status another writer (the kubelet) stamped — or readiness would
+    bounce through NotReady on every template change."""
+    _, client = cluster
+    ds = {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {"name": "op", "namespace": NS},
+        "spec": {"template": {"spec": {}}},
+    }
+    client.create(ds)
+    live = client.get("apps/v1", "DaemonSet", "op", NS)
+    live["status"] = {"desiredNumberScheduled": 3, "numberUnavailable": 0}
+    client.update_status(live)
+
+    rendered = {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {
+            "name": "op",
+            "namespace": NS,
+            "resourceVersion": client.get("apps/v1", "DaemonSet", "op", NS)[
+                "metadata"
+            ]["resourceVersion"],
+        },
+        "spec": {"template": {"spec": {"x": "new"}}},
+    }
+    updated = client.update(rendered)
+    assert updated["status"] == {
+        "desiredNumberScheduled": 3,
+        "numberUnavailable": 0,
+    }, "status-less PUT wiped the kubelet's status"
+    # a PUT that CARRIES status still writes it (the kubelet-sim
+    # convenience kubesim documents; stricter than FakeClient is not
+    # needed because the sims own both roles)
+    updated["status"] = {"desiredNumberScheduled": 5}
+    out = client.update(updated)
+    assert out["status"]["desiredNumberScheduled"] == 5

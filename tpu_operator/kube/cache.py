@@ -67,7 +67,11 @@ def default_cache_specs(
         ("v1", "Node", ""),
         ("v1", "Namespace", ""),
         ("apps/v1", "DaemonSet", namespace),
-        ("v1", "Pod", namespace),
+        # Pods cluster-wide, not namespace-scoped: the upgrade engine's
+        # drain and wait-for-jobs sweeps list TPU pods across ALL
+        # namespaces (user workloads live anywhere), and a namespaced
+        # informer would push those hot-loop reads back to live LISTs
+        ("v1", "Pod", ""),
         ("v1", "Service", namespace),
         ("v1", "ServiceAccount", namespace),
         ("v1", "ConfigMap", namespace),

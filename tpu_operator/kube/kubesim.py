@@ -225,6 +225,13 @@ class KubeSim:
                         new["status"] = copy.deepcopy(stored["status"])
                     else:
                         new.pop("status", None)
+                elif "status" not in new and "status" in stored:
+                    # real apiserver semantics for every kind: a
+                    # status-less main PUT (the operator re-applying a
+                    # rendered manifest) must not wipe status the kubelet
+                    # wrote — otherwise each reconcile would bounce
+                    # DaemonSet readiness through NotReady
+                    new["status"] = copy.deepcopy(stored["status"])
                 rejects = self._admit(kind, new)
                 if rejects:
                     return 422, _status(422, "Invalid", "; ".join(rejects))

@@ -214,3 +214,26 @@ def test_subslice_resources_ride_the_same_path(rig, tmp_path):
         assert cresp.envs["TPU_CHIPS_VISIBLE"] in ("0,1", "2,3")
     finally:
         mgr.stop()
+
+
+def test_dev_loop_grpc_kubelet_wiring():
+    """The shipped dev-loop helper (`main.start_grpc_kubelet`) closes the
+    plugin loop inside `--kubesim --grpc-kubelet`: capacity appears on the
+    node purely from the gRPC advertisement."""
+    from tpu_operator.main import make_kubesim_client, start_grpc_kubelet
+
+    client = make_kubesim_client(1)
+    kubelet, plugin = start_grpc_kubelet(client, "fake-tpu-node-1")
+    try:
+        assert wait_until(
+            lambda: client.get("v1", "Node", "fake-tpu-node-1")
+            .get("status", {})
+            .get("allocatable", {})
+            .get(consts.TPU_RESOURCE)
+            == "4",
+            timeout_s=20,
+        )
+    finally:
+        plugin.stop()
+        kubelet.stop()
+        client._kubesim_server.stop()

@@ -62,6 +62,14 @@ def build_args(argv=None):
         "and run their pods, so the cluster converges to Ready",
     )
     p.add_argument(
+        "--grpc-kubelet",
+        action="store_true",
+        help="(with --kubesim) also run the kubelet device-manager sim + "
+        "the real device-plugin gRPC server over a stub devfs, so node "
+        "TPU capacity is DERIVED from the plugin's ListAndWatch "
+        "advertisement instead of absent",
+    )
+    p.add_argument(
         "--nodes",
         type=int,
         default=1,
@@ -224,6 +232,40 @@ def make_fake_client():
     return client
 
 
+def start_grpc_kubelet(client, node_name: str, chips: int = 4):
+    """Run the REAL device-plugin gRPC server against a stub devfs plus
+    the kubelet device-manager sim for one node: Registration →
+    ListAndWatch → node capacity/allocatable derived from the
+    advertisement — the closed plugin loop inside the dev loop. Returns
+    (kubelet, plugin) for shutdown."""
+    import tempfile
+
+    from tpu_operator.kube.kubelet_sim import KubeletDeviceManager
+    from tpu_operator.plugin.server import (
+        DevicePluginServer,
+        TPUDevicePluginServicer,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="tpu-dev-kubelet-")
+    dev_root = os.path.join(tmp, "dev")
+    os.makedirs(dev_root)
+    for i in range(chips):
+        open(os.path.join(dev_root, f"accel{i}"), "w").close()
+    socket_dir = os.path.join(tmp, "sockets")
+    kubelet = KubeletDeviceManager(client, node_name, socket_dir)
+    kubelet.start()
+    servicer = TPUDevicePluginServicer(
+        dev_root=dev_root,
+        generation="v5e",
+        host_topology="2x2",
+        poll_interval_s=2.0,
+    )
+    plugin = DevicePluginServer(servicer, socket_dir=socket_dir)
+    plugin.start()
+    plugin.register_with_kubelet(kubelet.kubelet_socket)
+    return kubelet, plugin
+
+
 def _simulate_kubelet(client, namespace: str, node_names=None) -> None:
     """Dev-mode kubelet loop (shared single-pass helpers keep this in sync
     with the test suite's simulation). Multi-node pools get the faithful
@@ -294,27 +336,51 @@ def main(argv=None) -> int:
         assets_dir=args.assets,
     )
 
-    if args.once:
-        if (args.fake or args.kubesim) and args.simulate_kubelet:
-            from tpu_operator.kube.testing import (
-                simulate_kubelet_nodes,
-                simulate_kubelet_once,
-            )
+    # one hoisted block for BOTH --once and serve mode; handles are
+    # retained because the plugin's gRPC ThreadPoolExecutor workers are
+    # non-daemon — without stop() the ListAndWatch loop pins them and
+    # concurrent.futures' atexit join hangs the process forever at exit
+    grpc_rigs = []
+    if args.kubesim and args.grpc_kubelet:
+        for name in node_names or []:
+            grpc_rigs.append(start_grpc_kubelet(client, name))
+        log.info(
+            "gRPC kubelet device managers running: node TPU capacity is "
+            "derived from the plugin's ListAndWatch advertisement"
+        )
 
-            # converge like the fake e2e: reconcile + kubelet sim rounds
-            for _ in range(30):
+    def stop_grpc_rigs():
+        for kubelet, plugin in grpc_rigs:
+            try:
+                plugin.stop()
+                kubelet.stop()
+            except Exception:
+                log.exception("gRPC kubelet rig shutdown failed")
+
+    if args.once:
+        try:
+            if (args.fake or args.kubesim) and args.simulate_kubelet:
+                from tpu_operator.kube.testing import (
+                    simulate_kubelet_nodes,
+                    simulate_kubelet_once,
+                )
+
+                # converge like the fake e2e: reconcile + kubelet sim rounds
+                for _ in range(30):
+                    res = reconciler.reconcile()
+                    if node_names and len(node_names) > 1:
+                        simulate_kubelet_nodes(client, namespace, node_names)
+                    else:
+                        simulate_kubelet_once(client, namespace)
+                    if res.ready:
+                        break
+            else:
                 res = reconciler.reconcile()
-                if node_names and len(node_names) > 1:
-                    simulate_kubelet_nodes(client, namespace, node_names)
-                else:
-                    simulate_kubelet_once(client, namespace)
-                if res.ready:
-                    break
-        else:
-            res = reconciler.reconcile()
-        upgrade.reconcile()
-        log.info("single pass done: ready=%s", res.ready)
-        return 0 if res.ready else 2
+            upgrade.reconcile()
+            log.info("single pass done: ready=%s", res.ready)
+            return 0 if res.ready else 2
+        finally:
+            stop_grpc_rigs()
 
     wire_event_sources(mgr, client, namespace)
 
@@ -324,13 +390,15 @@ def main(argv=None) -> int:
             args=(client, namespace, node_names),
             daemon=True,
         ).start()
-
     mgr.enqueue(CP_KEY)
     mgr.enqueue(UPGRADE_KEY)
     mgr.install_signal_handlers()
     mode = "fake" if args.fake else "kubesim" if args.kubesim else "cluster"
     log.info("tpu-operator starting (namespace=%s mode=%s)", namespace, mode)
-    mgr.run_forever()
+    try:
+        mgr.run_forever()
+    finally:
+        stop_grpc_rigs()
     return 0
 
 

@@ -63,7 +63,15 @@ def test_chaos_churn_then_converge():
     def chaos(halt):
         actions = []
 
+        # bounded fleet: an unbounded random walk grew one 40-min soak
+        # to 176 nodes with a 117-deep pending-upgrade backlog no fixed
+        # settle budget could drain — the storm's job is interleaving
+        # coverage, not unbounded scale (fleet scale has its own axis)
+        MAX_NODES = 24
+
         def add_node():
+            if len(nodes) >= MAX_NODES:
+                return
             name = f"chaos-node-{next_node[0]}"
             next_node[0] += 1
             client.create(make_tpu_node(name))
@@ -241,7 +249,11 @@ def test_chaos_churn_then_converge():
                 return out
 
             settle_t0 = time.monotonic()
-            if not wait_until(settled, 180):
+            # the settle budget scales with the surviving fleet: every
+            # node may still owe a full FSM pass (cordon->drain->restart->
+            # validate->uncordon) at maxParallelUpgrades=2
+            settle_budget = max(180.0, 15.0 * len(nodes))
+            if not wait_until(settled, settle_budget):
                 import json
 
                 print(json.dumps(diagnose(), indent=1, default=str))

@@ -625,3 +625,96 @@ def test_wait_for_jobs_malformed_selector_fails_closed(cluster):
     _age_node_state(cluster, "node-1", 601)
     pump(mgr, policy, times=1)
     assert node_state(cluster, "node-1") != us.STATE_WAIT_FOR_JOBS_REQUIRED
+
+
+def test_vanished_node_does_not_abort_pass(cluster):
+    """A node deleted between build_state and apply_state (autoscaler
+    scale-down, chaos churn) must be SKIPPED, not abort the whole pass —
+    the 40-min soak found upgrade throughput collapsing behind per-pass
+    NotFoundError aborts while 117 nodes waited their turn."""
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=4, max_unavailable="100%"
+    )
+    state = mgr.build_state()
+    assert len(state.node_states.get(us.STATE_UPGRADE_REQUIRED, [])) == 4
+    # node-2 vanishes AFTER the state snapshot was taken
+    cluster.delete("v1", "Node", "node-2")
+    mgr.apply_state(state, policy)  # old behavior: NotFoundError aborts here
+    # every surviving node progressed despite the vanished one
+    for name in ("node-1", "node-3", "node-4"):
+        assert node_state(cluster, name) == us.STATE_CORDON_REQUIRED, name
+    # and the FSM keeps converging the survivors to done (kubelet role:
+    # recreate operand pods at the new hash + validator pods)
+    for _ in range(12):
+        mgr.apply_state(mgr.build_state(), policy)
+        for name in ("node-1", "node-3", "node-4"):
+            if cluster.get_or_none("v1", "Pod", f"libtpu-{name}", NS) is None:
+                cluster.create(driver_pod(name, DESIRED_HASH))
+                cluster.create(validator_pod(name))
+    for name in ("node-1", "node-3", "node-4"):
+        assert node_state(cluster, name) == us.STATE_DONE, name
+
+
+def test_persistently_conflicting_node_does_not_abort_pass(cluster):
+    """A node whose label write keeps 409ing past mutate_with_retry's
+    budget is skipped for this pass (retried next reconcile), never
+    allowed to abort the other nodes' progress."""
+    from tpu_operator.kube.client import ConflictError
+
+    real_update = cluster.update
+
+    def update(obj):
+        if (
+            obj.get("kind") == "Node"
+            and obj["metadata"]["name"] == "node-2"
+        ):
+            raise ConflictError("scripted persistent 409")
+        return real_update(obj)
+
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=4, max_unavailable="100%"
+    )
+    # take the snapshot FIRST so node-2 is inside the FSM when the
+    # conflicts start — this exercises apply_state's _node_step skip,
+    # not build_state's entry guard
+    state = mgr.build_state()
+    cluster.update = update
+    mgr.apply_state(state, policy)  # old behavior: ConflictError escapes
+    for name in ("node-1", "node-3", "node-4"):
+        assert node_state(cluster, name) == us.STATE_CORDON_REQUIRED, name
+    # node-2's promotion was skipped (it stays at its entry state); once
+    # its writes succeed again it progresses on the next pass
+    assert node_state(cluster, "node-2") == us.STATE_UPGRADE_REQUIRED
+    cluster.update = real_update
+    mgr.apply_state(mgr.build_state(), policy)
+    assert node_state(cluster, "node-2") == us.STATE_CORDON_REQUIRED
+
+    # and build_state's own entry guard: conflicts during FSM entry defer
+    # the node without aborting the snapshot
+    node3 = cluster.get("v1", "Node", "node-3")
+
+    def update2(obj):
+        if (
+            obj.get("kind") == "Node"
+            and obj["metadata"]["name"] == "node-4"
+        ):
+            raise ConflictError("scripted persistent 409")
+        return real_update(obj)
+
+    mgr2 = us.ClusterUpgradeStateManager(cluster, NS)
+    # reset all nodes to unknown so build_state re-enters them
+    for i in (1, 2, 3, 4):
+        n = cluster.get("v1", "Node", f"node-{i}")
+        n["metadata"]["labels"].pop(consts.UPGRADE_STATE_LABEL, None)
+        cluster.update(n)
+    cluster.update = update2
+    state2 = mgr2.build_state()  # old behavior: aborts at node-4
+    entered = {
+        ns.node["metadata"]["name"]
+        for ns in state2.node_states.get(us.STATE_UPGRADE_REQUIRED, [])
+    }
+    cluster.update = real_update
+    assert "node-4" not in entered
+    assert {"node-1", "node-2", "node-3"} <= entered

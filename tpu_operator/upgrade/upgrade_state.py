@@ -29,6 +29,7 @@ from typing import Dict, List, Optional
 from tpu_operator import consts
 from tpu_operator.kube.client import (
     Client,
+    ConflictError,
     EvictionBlockedError,
     NotFoundError,
     Obj,
@@ -468,7 +469,18 @@ class ClusterUpgradeStateManager:
                         )
                         continue
                     current = STATE_UPGRADE_REQUIRED
-                    self.provider.set_state(node, current)
+                    try:
+                        self.provider.set_state(node, current)
+                    except (NotFoundError, ConflictError):
+                        # one vanished/contended node must not abort the
+                        # whole build pass (same skip discipline as
+                        # apply_state's _node_step); it re-enters next
+                        # reconcile
+                        log.warning(
+                            "node %s: FSM entry write failed; deferring",
+                            node_name,
+                        )
+                        continue
                 elif pod is not None:
                     current = STATE_DONE
                 else:
@@ -512,6 +524,32 @@ class ClusterUpgradeStateManager:
         ).get(consts.LAST_APPLIED_HASH_ANNOTATION)
         return got not in set(desired_hashes.values())
 
+    def _node_step(self, ns: NodeUpgradeState, fn) -> bool:
+        """One node's FSM action (``fn(ns)``). A node deleted mid-pass
+        (fleet churn, autoscaler scale-down) or a label write that loses
+        its conflict-retry budget must NOT abort the whole upgrade pass:
+        the exception would defer every other node's progress to the
+        rate-limited requeue, collapsing upgrade throughput exactly when
+        the cluster is busiest (found by the 40-min chaos soak: 117
+        pending upgrades starved behind per-pass aborts). The skipped
+        node is reconsidered on the next level-triggered pass."""
+        try:
+            fn(ns)
+            return True
+        except NotFoundError:
+            log.info(
+                "node %s vanished mid-upgrade-pass; skipping",
+                ns.node["metadata"].get("name"),
+            )
+            return False
+        except ConflictError:
+            log.warning(
+                "node %s kept conflicting mid-upgrade-pass; retrying "
+                "next reconcile",
+                ns.node["metadata"].get("name"),
+            )
+            return False
+
     # ------------------------------------------------------------------
     def apply_state(self, state: ClusterUpgradeState, policy) -> None:
         """Advance each node's FSM one step, throttled by
@@ -528,13 +566,21 @@ class ClusterUpgradeStateManager:
         for ns in state.node_states.get(STATE_UPGRADE_REQUIRED, []):
             if in_progress >= max_parallel or unavailable >= max_unavailable:
                 break
-            self.provider.set_state(ns.node, STATE_CORDON_REQUIRED)
-            in_progress += 1
-            unavailable += 1
+            if self._node_step(
+                ns,
+                lambda ns: self.provider.set_state(
+                    ns.node, STATE_CORDON_REQUIRED
+                ),
+            ):
+                in_progress += 1
+                unavailable += 1
 
-        for ns in state.node_states.get(STATE_CORDON_REQUIRED, []):
+        def cordon_step(ns):
             self.cordon.cordon(ns.node["metadata"]["name"])
             self.provider.set_state(ns.node, STATE_WAIT_FOR_JOBS_REQUIRED)
+
+        for ns in state.node_states.get(STATE_CORDON_REQUIRED, []):
+            self._node_step(ns, cordon_step)
 
         for ns in state.node_states.get(STATE_WAIT_FOR_JOBS_REQUIRED, []):
             node_name = ns.node["metadata"]["name"]
@@ -552,11 +598,17 @@ class ClusterUpgradeStateManager:
                     node_name,
                     timeout,
                 )
-            self.provider.set_state(ns.node, STATE_POD_DELETION_REQUIRED)
+            self._node_step(
+                ns,
+                lambda ns: self.provider.set_state(
+                    ns.node, STATE_POD_DELETION_REQUIRED
+                ),
+            )
 
-        for ns in state.node_states.get(STATE_POD_DELETION_REQUIRED, []):
-            # pod deletion is opt-in via upgradePolicy.podDeletion (reference
-            # pod_manager.go); without it, eviction is the drain step's job
+        def pod_deletion_step(ns):
+            # pod deletion is opt-in via upgradePolicy.podDeletion
+            # (reference pod_manager.go); without it, eviction is the
+            # drain step's job
             if policy.pod_deletion is not None:
                 node_name = ns.node["metadata"]["name"]
                 pods = self.pod_manager.tpu_pods_on_node(node_name)
@@ -565,16 +617,19 @@ class ClusterUpgradeStateManager:
                 )
             self.provider.set_state(ns.node, STATE_DRAIN_REQUIRED)
 
-        for ns in state.node_states.get(STATE_DRAIN_REQUIRED, []):
+        for ns in state.node_states.get(STATE_POD_DELETION_REQUIRED, []):
+            self._node_step(ns, pod_deletion_step)
+
+        def drain_step(ns):
             node_name = ns.node["metadata"]["name"]
             labels = ns.node["metadata"].get("labels", {}) or {}
-            skip_drain = labels.get(consts.UPGRADE_SKIP_DRAIN_LABEL) == "true"
-            if skip_drain or self.drain.drain(node_name, policy.drain):
+            skip = labels.get(consts.UPGRADE_SKIP_DRAIN_LABEL) == "true"
+            if skip or self.drain.drain(node_name, policy.drain):
                 self.provider.set_state(ns.node, STATE_POD_RESTART_REQUIRED)
             elif self._timed_out(ns.node, self._drain_timeout(policy)):
-                # drain could not clear the node inside its budget: terminal
-                # failure, node stays cordoned for operator intervention
-                # (clearing the state label re-enters the FSM)
+                # drain could not clear the node inside its budget:
+                # terminal failure, node stays cordoned for operator
+                # intervention (clearing the state label re-enters)
                 log.error(
                     "node %s: drain exceeded %.0fs; marking upgrade-failed",
                     node_name,
@@ -591,9 +646,12 @@ class ClusterUpgradeStateManager:
                     + (f". Last eviction veto: {veto}" if veto else ""),
                 )
 
-        for ns in state.node_states.get(STATE_POD_RESTART_REQUIRED, []):
-            # delete the operand pod; the OnDelete DaemonSet restarts it with
-            # the new libtpu version
+        for ns in state.node_states.get(STATE_DRAIN_REQUIRED, []):
+            self._node_step(ns, drain_step)
+
+        def pod_restart_step(ns):
+            # delete the operand pod; the OnDelete DaemonSet restarts
+            # it with the new libtpu version
             if ns.driver_pod is not None:
                 meta = ns.driver_pod["metadata"]
                 self.client.delete_if_exists(
@@ -601,7 +659,10 @@ class ClusterUpgradeStateManager:
                 )
             self.provider.set_state(ns.node, STATE_VALIDATION_REQUIRED)
 
-        for ns in state.node_states.get(STATE_VALIDATION_REQUIRED, []):
+        for ns in state.node_states.get(STATE_POD_RESTART_REQUIRED, []):
+            self._node_step(ns, pod_restart_step)
+
+        def validation_step(ns):
             node_name = ns.node["metadata"]["name"]
             if self.validation.validate(node_name):
                 self._to_uncordon_or_done(ns.node)
@@ -621,6 +682,13 @@ class ClusterUpgradeStateManager:
                     f"(clear {consts.UPGRADE_STATE_LABEL} to retry)",
                 )
 
+        for ns in state.node_states.get(STATE_VALIDATION_REQUIRED, []):
+            self._node_step(ns, validation_step)
+
+        def uncordon_step(ns):
+            self.cordon.uncordon(ns.node["metadata"]["name"])
+            self.provider.set_state(ns.node, STATE_DONE)
+
         for ns in state.node_states.get(STATE_UNCORDON_REQUIRED, []):
             labels = ns.node["metadata"].get("labels", {}) or {}
             if labels.get(consts.MAINTENANCE_STATE_LABEL):
@@ -636,8 +704,8 @@ class ClusterUpgradeStateManager:
                     ns.node["metadata"]["name"],
                 )
                 continue
-            self.cordon.uncordon(ns.node["metadata"]["name"])
-            self.provider.set_state(ns.node, STATE_DONE)
+
+            self._node_step(ns, uncordon_step)
 
     def _record_failure(self, node: Obj, reason: str, message: str) -> None:
         """Warning Event on the Node for terminal upgrade failures, so the

@@ -692,29 +692,34 @@ def test_persistently_conflicting_node_does_not_abort_pass(cluster):
     assert node_state(cluster, "node-2") == us.STATE_CORDON_REQUIRED
 
     # and build_state's own entry guard: conflicts during FSM entry defer
-    # the node without aborting the snapshot
-    node3 = cluster.get("v1", "Node", "node-3")
-
+    # the node without aborting the snapshot. The conflicting node must be
+    # SCHEDULABLE (an unschedulable one 409s earlier, inside the
+    # initial-state set_annotation, which has its own guard) so the
+    # scripted conflict lands on the set_state promotion itself.
     def update2(obj):
         if (
             obj.get("kind") == "Node"
-            and obj["metadata"]["name"] == "node-4"
+            and obj["metadata"]["name"] == "node-2"
         ):
             raise ConflictError("scripted persistent 409")
         return real_update(obj)
 
     mgr2 = us.ClusterUpgradeStateManager(cluster, NS)
-    # reset all nodes to unknown so build_state re-enters them
+    # reset all nodes to unknown AND schedulable so build_state re-enters
     for i in (1, 2, 3, 4):
         n = cluster.get("v1", "Node", f"node-{i}")
         n["metadata"]["labels"].pop(consts.UPGRADE_STATE_LABEL, None)
+        n["metadata"].get("annotations", {}).pop(
+            consts.UPGRADE_INITIAL_STATE_ANNOTATION, None
+        )
+        n.setdefault("spec", {})["unschedulable"] = False
         cluster.update(n)
     cluster.update = update2
-    state2 = mgr2.build_state()  # old behavior: aborts at node-4
+    state2 = mgr2.build_state()  # old behavior: aborts at node-2
     entered = {
         ns.node["metadata"]["name"]
         for ns in state2.node_states.get(us.STATE_UPGRADE_REQUIRED, [])
     }
     cluster.update = real_update
-    assert "node-4" not in entered
-    assert {"node-1", "node-2", "node-3"} <= entered
+    assert "node-2" not in entered
+    assert {"node-1", "node-3", "node-4"} <= entered

@@ -236,31 +236,41 @@ def run_validator_cli_chain() -> dict:
     )
     try:
         for comp, args in chain:
-            t0 = time.monotonic()
-            proc = subprocess.run(
-                [sys.executable, "-m", "tpu_operator.validator",
-                 "--component", comp, "--output-dir", status_dir, *args],
-                cwd=REPO,
-                env=env,
-                capture_output=True,
-                text=True,
-                timeout=600,
-            )
-            entry = {
-                "rc": proc.returncode,
-                "elapsed_s": round(time.monotonic() - t0, 2),
-            }
-            status_file = os.path.join(status_dir, expected_status[comp])
-            entry["status_file"] = os.path.exists(status_file)
-            if entry["status_file"]:
-                try:
-                    with open(status_file) as f:
-                        payload = json.load(f)
-                    for key in ("tflops", "gbps", "platform"):
-                        if key in payload:
-                            entry[key] = payload[key]
-                except (OSError, json.JSONDecodeError):
-                    pass
+            # up to 3 attempts per component: the tunneled chip's
+            # bandwidth dips transiently below the validator's production
+            # gates (a single membw run measured 334 GB/s minutes after
+            # 790); production hosts keep the strict single-shot gate —
+            # the bench retries the BINARY, it does not loosen the gate
+            entry = {}
+            t0 = time.monotonic()  # total wall across attempts
+            for attempt in range(3):
+                proc = subprocess.run(
+                    [sys.executable, "-m", "tpu_operator.validator",
+                     "--component", comp, "--output-dir", status_dir, *args],
+                    cwd=REPO,
+                    env=env,
+                    capture_output=True,
+                    text=True,
+                    timeout=600,
+                )
+                entry = {
+                    "rc": proc.returncode,
+                    "elapsed_s": round(time.monotonic() - t0, 2),
+                    "attempts": attempt + 1,
+                }
+                status_file = os.path.join(status_dir, expected_status[comp])
+                entry["status_file"] = os.path.exists(status_file)
+                if entry["status_file"]:
+                    try:
+                        with open(status_file) as f:
+                            payload = json.load(f)
+                        for key in ("tflops", "gbps", "platform"):
+                            if key in payload:
+                                entry[key] = payload[key]
+                    except (OSError, json.JSONDecodeError):
+                        pass
+                if proc.returncode == 0 and entry["status_file"]:
+                    break
             if proc.returncode != 0 or not entry["status_file"]:
                 entry["error"] = (proc.stderr or proc.stdout)[-512:]
                 out["components"][comp] = entry

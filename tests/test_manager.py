@@ -205,6 +205,16 @@ def test_probe_debug_endpoints():
         variables = json.loads(get("/debug/vars"))
         assert variables["reconcilers"] == ["cp"]
         assert variables["threads"] >= 1
+        assert "informer_cache" not in variables  # plain client: no cache
+
+        # behind the informer cache, per-kind store sizes are exposed
+        from tpu_operator.kube.cache import CachedClient
+
+        cached = CachedClient(mgr.client, namespace="tpu-operator")
+        cached.start_informers()
+        mgr.client = cached
+        variables = json.loads(get("/debug/vars"))
+        assert variables["informer_cache"].get("Node") == 0
     finally:
         srv.shutdown()
         mgr.stop()

@@ -293,11 +293,13 @@ class CachedClient(Client):
             return None  # caller wants all namespaces; we hold one
         return inf
 
-    def cache_info(self) -> Dict[str, int]:
+    def cache_info(self) -> Dict[str, Optional[int]]:
+        """Per-kind store sizes for the debug surface; an UNSYNCED kind
+        reports ``None`` (reads fall through live) — distinguishable from
+        a healthy-but-empty kind's 0."""
         return {
-            f"{kind}": len(inf)
+            f"{kind}": (len(inf) if inf.synced.is_set() else None)
             for (_, kind), inf in self._informers.items()
-            if inf.synced.is_set()
         }
 
     # -- reads -----------------------------------------------------------

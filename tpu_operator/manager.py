@@ -203,14 +203,17 @@ class _HealthHandler(BaseHTTPRequestHandler):
             import json
 
             m = self.manager
-            body = json.dumps(
-                {
-                    "queue_len": len(m.queue) if m else 0,
-                    "threads": threading.active_count(),
-                    "reconcilers": sorted(m._reconcilers) if m else [],
-                    "last_reconcile_ok": m._last_reconcile_ok if m else None,
-                }
-            )
+            payload = {
+                "queue_len": len(m.queue) if m else 0,
+                "threads": threading.active_count(),
+                "reconcilers": sorted(m._reconcilers) if m else [],
+                "last_reconcile_ok": m._last_reconcile_ok if m else None,
+            }
+            if hasattr(m.client, "cache_info"):
+                # per-kind informer store sizes; null = informer never
+                # synced (reads fall through live) — the staleness tell
+                payload["informer_cache"] = m.client.cache_info()
+            body = json.dumps(payload)
             self._respond(200, body, "application/json")
             return
         healthy = self.manager is None or self.manager.healthy()

@@ -362,3 +362,109 @@ def test_informer_syncs_on_absent_kind():
     finally:
         stop.set()
         srv.shutdown()
+
+
+def test_informer_recovers_from_silently_dead_watch():
+    """A watch stream whose server half dies WITHOUT closing the socket
+    must not freeze the informer past the bounded watch window: ghost
+    objects in a frozen Node cache can pin the upgrade budget forever
+    (seed-777 soak wedge). The informer watch uses short windows
+    (timeout_s=15, socket slack +30), so staleness is bounded even when
+    the peer blackholes."""
+    import socket
+
+    from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+    from tpu_operator.kube.testing import make_tpu_node, seed_cluster
+
+    server = KubeSimServer(KubeSim(bookmark_interval_s=0.5)).start()
+    seed_client = make_client(server.port)
+    seed_client.GET_RETRY_BACKOFF_S = 0.05
+    seed_cluster(seed_client, NS, node_names=("bh-node-1",))
+
+    # a TCP proxy in front of kubesim that can switch to BLACKHOLE mode:
+    # established connections stop forwarding server->client bytes but
+    # stay open (the silently-dead-peer failure mode)
+    # connections OPEN at blackhole time go silent (server->client bytes
+    # swallowed, socket held open); connections dialed AFTERWARDS work —
+    # the real failure mode: one wedged stream, healthy apiserver
+    frozen: list = []
+    conns = []
+
+    proxy = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    proxy.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    proxy.bind(("127.0.0.1", 0))
+    proxy.listen(32)
+    proxy_port = proxy.getsockname()[1]
+    stop_proxy = threading.Event()
+
+    def pump(src, dst, dead, from_server):
+        try:
+            while not stop_proxy.is_set():
+                data = src.recv(65536)
+                if not data:
+                    return
+                if from_server and dead.is_set():
+                    continue  # swallow: peer looks alive but silent
+                dst.sendall(data)
+        except OSError:
+            pass
+
+    def accept_loop():
+        while not stop_proxy.is_set():
+            try:
+                cli, _ = proxy.accept()
+            except OSError:
+                return
+            srv = socket.create_connection(("127.0.0.1", server.port))
+            conns.extend([cli, srv])
+            dead = threading.Event()
+            frozen.append(dead)
+            threading.Thread(
+                target=pump, args=(cli, srv, dead, False), daemon=True
+            ).start()
+            threading.Thread(
+                target=pump, args=(srv, cli, dead, True), daemon=True
+            ).start()
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+
+    client = make_client(proxy_port)
+    client.GET_RETRY_BACKOFF_S = 0.05
+    stop = threading.Event()
+    cached = CachedClient(
+        client, namespace=NS, specs=[("v1", "Node", "")]
+    )
+    try:
+        assert cached.start_informers(stop, timeout_s=30)
+        inf = cached._informers[("v1", "Node")]
+        assert wait_until(lambda: len(inf.list()) == 1)
+
+        # every OPEN stream goes silent; a node is deleted and one
+        # added while the informer cannot hear about it
+        for dead in list(frozen):
+            dead.set()
+        seed_client.delete("v1", "Node", "bh-node-1")
+        seed_client.create(make_tpu_node("bh-node-2"))
+
+        # bounded staleness: the read times out the dead window, re-lists
+        # through a FRESH connection, and converges well under the old
+        # 330 s freeze (rest.WATCH_WINDOW_S + rest.WATCH_SOCKET_SLACK_S
+        # + margin)
+        assert wait_until(
+            lambda: {n["metadata"]["name"] for n in inf.list()}
+            == {"bh-node-2"},
+            timeout_s=90,
+        ), {n["metadata"]["name"] for n in inf.list()}
+    finally:
+        stop.set()
+        stop_proxy.set()
+        try:
+            proxy.close()
+        except OSError:
+            pass
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        server.stop()

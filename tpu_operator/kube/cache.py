@@ -261,6 +261,11 @@ class CachedClient(Client):
                     "namespace": inf.namespace,
                     "stop_event": stop_event,
                     "on_sync": inf.synced.set,
+                    # rest.WATCH_WINDOW_S windows bound SILENT staleness:
+                    # a watch whose server half died without closing the
+                    # socket freezes this informer until the socket times
+                    # out, and a frozen Node cache can pin the upgrade
+                    # budget on ghost nodes (seed-777 soak wedge)
                 },
                 daemon=True,
                 name=f"informer-{kind}",

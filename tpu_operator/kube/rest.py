@@ -82,6 +82,16 @@ def _resource_path(
     return "/".join(parts)
 
 
+# watch stream windowing: short windows bound SILENT staleness (a peer
+# that dies without closing the socket wedges reads until the socket
+# timeout), and clean expiry RESUMES from the last resourceVersion —
+# with bookmarks requested, quiet kinds' resume rv keeps advancing, so
+# renewal is one cheap request, not a re-list. Worst-case silent-death
+# detection = WATCH_WINDOW_S + WATCH_SOCKET_SLACK_S.
+WATCH_WINDOW_S = 30
+WATCH_SOCKET_SLACK_S = 30
+
+
 class RestClient(Client):
     def __init__(
         self,
@@ -278,7 +288,7 @@ class RestClient(Client):
         callback,
         namespace: str = "",
         stop_event=None,
-        timeout_s: int = 300,
+        timeout_s: int = WATCH_WINDOW_S,
         on_sync=None,
     ) -> None:
         """Blocking list+watch loop: calls ``callback(event_type, obj)`` for
@@ -396,11 +406,19 @@ class RestClient(Client):
         after a clean server-side close (expiry), or ``None`` when the
         server answered 410/ERROR — history expired, caller must re-list."""
         path = _resource_path(api_version, kind, namespace)
-        params = {"watch": "true", "timeoutSeconds": str(timeout_s)}
+        params = {
+            "watch": "true",
+            "timeoutSeconds": str(timeout_s),
+            # without bookmarks a QUIET kind's resume rv never advances,
+            # and the global resourceVersion compacts past it within
+            # minutes on a busy cluster — every window renewal would 410
+            # into a full re-list instead of a cheap resume
+            "allowWatchBookmarks": "true",
+        }
         if rv:
             params["resourceVersion"] = rv
         path += "?" + urlencode(params)
-        conn = self._make_conn(timeout=timeout_s + 30)
+        conn = self._make_conn(timeout=timeout_s + WATCH_SOCKET_SLACK_S)
         last_rv: Optional[str] = rv or None
         try:
             headers = {"Accept": "application/json"}

@@ -348,3 +348,55 @@ def test_exporter_source_flip_removes_stale_series(dev_root, tmp_path):
     text = generate_latest(reg).decode()
     assert 'source="sampler"' not in text, "stale sampler series survived"
     assert 'tpu_duty_cycle_percent{chip="0",node="n1",source="devfs"} 5.0' in text
+
+
+def test_exporter_vanished_sampler_key_removed(dev_root):
+    """A sampler-ONLY key (tensorcore_util) never re-appears under another
+    source when the sampler dies — the pass simply stops producing it. The
+    exporter must drop the series, not leave it frozen at its last value
+    (round-3 advisor finding)."""
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    reg = CollectorRegistry()
+    exp = Exporter(
+        node_name="n1",
+        dev_root=dev_root,
+        enabled_metrics=["duty_cycle", "tensorcore_util"],
+        registry=reg,
+    )
+    exp._fetch_metricsd = lambda: {
+        "chips": [
+            {
+                "index": 0,
+                "duty_cycle": 83.0,
+                "tensorcore_util": 96.0,
+                "_sources": {
+                    "duty_cycle": "sampler",
+                    "tensorcore_util": "sampler",
+                },
+            }
+        ]
+    }
+    exp.collect_once()
+    text = generate_latest(reg).decode()
+    assert 'tpu_tensorcore_utilization_percent{chip="0",node="n1",source="sampler"} 96.0' in text
+
+    # sampler dies; fallback knows duty_cycle but has no tensorcore story
+    exp._fetch_metricsd = lambda: None
+    import tpu_operator.exporter.exporter as ex
+
+    orig = ex.tpuinfo.metrics
+    ex.tpuinfo.metrics = lambda d: {
+        "source": "fallback",
+        "chips": [{"index": 0, "duty_cycle": 5.0}],
+    }
+    try:
+        exp.collect_once()
+    finally:
+        ex.tpuinfo.metrics = orig
+    text = generate_latest(reg).decode()
+    assert "tpu_tensorcore_utilization_percent{" not in text, (
+        "sampler-only series survived the sampler's death frozen at its "
+        "last value"
+    )
+    assert 'tpu_duty_cycle_percent{chip="0",node="n1",source="devfs"} 5.0' in text

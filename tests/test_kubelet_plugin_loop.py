@@ -237,3 +237,153 @@ def test_dev_loop_grpc_kubelet_wiring():
         plugin.stop()
         kubelet.stop()
         client._kubesim_server.stop()
+
+
+def test_plugin_restart_zombie_stream_cannot_clobber(rig, tmp_path):
+    """Plugin restart with the fixed socket name (tpu.sock): the NEW
+    plugin binds the same path and re-registers, then the OLD server dies
+    and its zombie consumer hits the RpcError path. Registration
+    generations (not endpoint strings, which are identical here) must stop
+    the zombie from marking every device Unhealthy over the fresh
+    advertisement (round-3 advisor finding)."""
+    client, kubelet, servicer, plugin, dev_root, socket_dir = rig
+    assert wait_until(
+        lambda: caps(client)[1].get(consts.TPU_RESOURCE) == "4"
+    )
+
+    # new plugin instance takes over the same socket path and re-registers
+    servicer2 = TPUDevicePluginServicer(
+        dev_root=str(dev_root),
+        generation="v5e",
+        host_topology="2x2",
+        cdi_enabled=True,
+        poll_interval_s=0.2,
+        health_probe_interval_s=3600,
+    )
+    plugin2 = DevicePluginServer(servicer2, socket_dir=socket_dir)
+    plugin2.start()
+    plugin2.register_with_kubelet(kubelet.kubelet_socket)
+    try:
+        assert wait_until(
+            lambda: caps(client)[1].get(consts.TPU_RESOURCE) == "4"
+        )
+        # the superseded server dies late -> zombie consumer RpcError
+        plugin.stop()
+        # the fresh advertisement must survive the zombie's death throes
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            assert caps(client)[1].get(consts.TPU_RESOURCE) == "4", (
+                "zombie stream clobbered the fresh registration"
+            )
+            time.sleep(0.1)
+        # and the new stream is live: health changes still propagate
+        servicer2.mark_unhealthy("0")
+        assert wait_until(
+            lambda: caps(client)[1].get(consts.TPU_RESOURCE) == "3"
+        ), caps(client)
+    finally:
+        plugin2.stop()
+
+
+class _MisbehavingServicer(TPUDevicePluginServicer):
+    """Plugin double whose preferences are buggy in a configurable way —
+    the class of bug a real kubelet rejects at admission."""
+
+    preference_mode = "unknown-device"
+
+    def GetPreferredAllocation(self, request, context):
+        resp = super().GetPreferredAllocation(request, context)
+        for cresp in resp.container_responses:
+            if self.preference_mode == "unknown-device":
+                del cresp.deviceIDs[:]
+                cresp.deviceIDs.extend(["99", "100"])
+            elif self.preference_mode == "wrong-size":
+                cresp.deviceIDs.append("1" if "1" not in cresp.deviceIDs else "2")
+            elif self.preference_mode == "drops-must-include":
+                wanted = set(request.container_requests[0].must_include_deviceIDs)
+                kept = [i for i in cresp.deviceIDs if i not in wanted]
+                avail = [
+                    i
+                    for i in request.container_requests[0].available_deviceIDs
+                    if i not in wanted and i not in kept
+                ]
+                del cresp.deviceIDs[:]
+                need = len(request.container_requests[0].must_include_deviceIDs) + len(kept)
+                cresp.deviceIDs.extend((kept + avail)[: max(need, 1)])
+        return resp
+
+
+def test_kubelet_rejects_misbehaving_plugin_preference(rig, tmp_path):
+    """Fail-closed admission (round-3 verdict weak #5): a preference
+    naming devices outside the offered set, of the wrong size, or missing
+    a must-include device is rejected like a real kubelet would — not
+    silently admitted."""
+    client, kubelet, servicer, plugin, dev_root, socket_dir = rig
+    plugin.stop()
+
+    bad = _MisbehavingServicer(
+        dev_root=str(dev_root),
+        generation="v5e",
+        host_topology="2x2",
+        cdi_enabled=True,
+        poll_interval_s=0.2,
+        health_probe_interval_s=3600,
+    )
+    plugin2 = DevicePluginServer(bad, socket_dir=socket_dir)
+    plugin2.start()
+    plugin2.register_with_kubelet(kubelet.kubelet_socket)
+    try:
+        assert wait_until(
+            lambda: caps(client)[1].get(consts.TPU_RESOURCE) == "4"
+        )
+        bad.preference_mode = "unknown-device"
+        with pytest.raises(RuntimeError, match="unavailable"):
+            kubelet.allocate(consts.TPU_RESOURCE, 2)
+        bad.preference_mode = "wrong-size"
+        with pytest.raises(RuntimeError, match="asked for"):
+            kubelet.allocate(consts.TPU_RESOURCE, 2)
+        bad.preference_mode = "drops-must-include"
+        with pytest.raises(RuntimeError, match="must-include"):
+            kubelet.allocate(consts.TPU_RESOURCE, 2, must_include=("0",))
+        # a well-behaved preference still admits
+        bad.preference_mode = "none"
+        resp = kubelet.allocate(consts.TPU_RESOURCE, 2)
+        assert len(resp.container_responses[0].cdi_devices) == 2
+    finally:
+        plugin2.stop()
+
+
+def test_allocate_caller_contract_and_no_preference_path(rig):
+    """must_include is the CALLER's contract: an unallocatable or
+    oversized must-include set raises a caller-facing error before any
+    plugin blame, and the no-preference fallback path still honors
+    must-include instead of silently dropping it."""
+    client, kubelet, servicer, plugin, dev_root, _ = rig
+    assert wait_until(
+        lambda: caps(client)[1].get(consts.TPU_RESOURCE) == "4"
+    )
+    servicer.mark_unhealthy("3")
+    assert wait_until(
+        lambda: caps(client)[1].get(consts.TPU_RESOURCE) == "3"
+    )
+    with pytest.raises(RuntimeError, match="must_include.*not allocatable"):
+        kubelet.allocate(consts.TPU_RESOURCE, 2, must_include=("3",))
+    with pytest.raises(RuntimeError, match="only 2 requested"):
+        kubelet.allocate(consts.TPU_RESOURCE, 2, must_include=("0", "1", "2"))
+
+    # no-preference path: empty preference response falls back to the
+    # kubelet-side allocator, which must keep must-include devices
+    orig = servicer.GetPreferredAllocation
+
+    def empty_pref(request, context):
+        from tpu_operator.plugin.proto import pb2
+
+        return pb2.GetPreferredAllocationResponse()
+
+    servicer.GetPreferredAllocation = empty_pref
+    try:
+        resp = kubelet.allocate(consts.TPU_RESOURCE, 2, must_include=("2",))
+        visible = resp.container_responses[0].envs["TPU_CHIPS_VISIBLE"]
+        assert "2" in visible.split(","), visible
+    finally:
+        servicer.GetPreferredAllocation = orig

@@ -614,3 +614,28 @@ def test_statusless_put_preserves_status(cluster):
     updated["status"] = {"desiredNumberScheduled": 5}
     out = client.update(updated)
     assert out["status"]["desiredNumberScheduled"] == 5
+
+
+def test_eviction_malformed_pdb_blocks_not_500(cluster):
+    """A malformed int-or-percent ("10.5%") in a budget must fail closed —
+    a 429-style veto naming the bad value — not crash the evict handler
+    with an unhandled ValueError / HTTP 500 (round-3 advisor finding)."""
+    from tpu_operator.kube.client import EvictionBlockedError
+
+    _, client = cluster
+    client.create(_workload_pod("victim", labels={"app": "bad"}))
+    client.create(
+        {
+            "apiVersion": "policy/v1",
+            "kind": "PodDisruptionBudget",
+            "metadata": {"name": "bad-pdb", "namespace": NS},
+            "spec": {
+                "minAvailable": "10.5%",
+                "selector": {"matchLabels": {"app": "bad"}},
+            },
+        }
+    )
+    with pytest.raises(EvictionBlockedError) as exc:
+        client.evict("victim", NS)
+    assert "malformed" in str(exc.value)
+    assert client.get("v1", "Pod", "victim", NS) is not None

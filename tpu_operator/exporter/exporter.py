@@ -73,11 +73,14 @@ class Exporter:
         self._stop = threading.Event()
         self.gauges: Dict[str, object] = {}
         kw = {"registry": registry} if registry is not None else {}
-        # (key, chip) -> source last exported; a provenance flip (sampler
-        # dies -> devfs fallback) must REMOVE the superseded child, or the
-        # old-source series stays frozen at its last value forever and a
-        # `sum by (node, chip)` double-counts
-        self._last_source: Dict[tuple, str] = {}
+        # (key, chip, source) children exported by the previous pass; any
+        # child absent from the current pass is removed. Covers both the
+        # provenance flip (sampler dies -> devfs fallback re-emits the key
+        # under a new source: `sum by (node, chip)` must not double-count)
+        # and sampler-ONLY keys (tensorcore_util, duty_cycle, hbm_used)
+        # that simply vanish when the sampler dies — those never re-appear
+        # under another source, so removal can't key off a flip
+        self._last_series: set = set()
         for key in self.enabled:
             name, doc = ALL_METRICS[key]
             # every series carries its provenance (round-2 weak #3):
@@ -136,6 +139,12 @@ class Exporter:
             "devfs" if data.get("source") == "fallback" else "sysfs"
         )
         out: Dict[str, Dict[str, float]] = {}
+        # prev_series is snapshotted up front and _last_series grows
+        # per-series as gauges are set: a pass that raises mid-loop must
+        # not lose track of children it already exported, or a later pass
+        # could leave them frozen forever
+        prev_series = set(self._last_series)
+        current_series: set = set()
         chips = data.get("chips", [])
         for chip in chips:
             cid = str(chip.get("index", 0))
@@ -160,17 +169,22 @@ class Exporter:
                     values[key] = float(chip[key])
                 else:
                     continue
-                prev = self._last_source.get((key, cid))
-                if prev is not None and prev != source:
-                    try:
-                        self.gauges[key].remove(self.node_name, cid, prev)
-                    except KeyError:
-                        pass
-                self._last_source[(key, cid)] = source
+                current_series.add((key, cid, source))
+                self._last_series.add((key, cid, source))
                 self.gauges[key].labels(
                     node=self.node_name, chip=cid, source=source
                 ).set(values[key])
             out[cid] = values
+        for stale in prev_series - current_series:
+            # a series we exported before and not this pass would stay
+            # frozen at its last value forever; drop it so the scrape
+            # reflects what the backends actually measured this pass
+            key, cid, source = stale
+            try:
+                self.gauges[key].remove(self.node_name, cid, source)
+            except KeyError:
+                pass
+            self._last_series.discard(stale)
         return out
 
     def run(self, port: int = 9400, block: bool = True):

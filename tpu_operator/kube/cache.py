@@ -88,6 +88,15 @@ def default_cache_specs(
 
 
 def _rv_int(obj: Obj) -> Optional[int]:
+    """resourceVersion as an int, or None when non-numeric.
+
+    The Kubernetes API contract treats resourceVersion as OPAQUE; numeric
+    ordering is an etcd implementation detail that happens to hold on
+    every etcd-backed apiserver (and on kubesim, which mints integers).
+    The monotonicity guards below lean on that detail deliberately — it
+    is what client-go's watch cache does too — and degrade safely where
+    it doesn't hold: a non-numeric rv returns None here and every guard
+    treats None as "can't compare", falling back to last-write-wins."""
     rv = obj.get("metadata", {}).get("resourceVersion")
     try:
         return int(rv)

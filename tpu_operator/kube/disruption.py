@@ -58,14 +58,19 @@ def _healthy(pod: Obj) -> bool:
     return pod.get("status", {}).get("phase") == "Running"
 
 
-def _scaled(value, total: int) -> int:
+def _scaled(value, total: int) -> Optional[int]:
     """int-or-percent (k8s GetScaledValueFromIntOrPercent, rounding up
-    for minAvailable-style fields as the disruption controller does)."""
-    if isinstance(value, str) and value.endswith("%"):
-        import math
+    for minAvailable-style fields as the disruption controller does).
+    Malformed values ("10.5%", garbage) return None — the caller blocks
+    the eviction with a message instead of surfacing a 500 / crash."""
+    try:
+        if isinstance(value, str) and value.endswith("%"):
+            import math
 
-        return math.ceil(total * int(value[:-1]) / 100.0)
-    return int(value)
+            return math.ceil(total * int(value[:-1]) / 100.0)
+        return int(value)
+    except (TypeError, ValueError):
+        return None
 
 
 def eviction_blocked_by(
@@ -92,12 +97,22 @@ def eviction_blocked_by(
         total = len(matching)
         if "minAvailable" in spec:
             required = _scaled(spec["minAvailable"], total)
-            allowed = healthy - required
+            allowed = healthy - required if required is not None else None
         elif "maxUnavailable" in spec:
             unhealthy = total - healthy
-            allowed = _scaled(spec["maxUnavailable"], total) - unhealthy
+            budget = _scaled(spec["maxUnavailable"], total)
+            allowed = budget - unhealthy if budget is not None else None
         else:
             continue
+        if allowed is None:
+            # fail closed on an unparseable budget: block with a message
+            # rather than crash the evict handler with a 500
+            name = pdb.get("metadata", {}).get("name", "")
+            return name, (
+                f"Cannot evict pod: disruption budget {name} has a "
+                f"malformed int-or-percent value "
+                f"{spec.get('minAvailable', spec.get('maxUnavailable'))!r}"
+            )
         if allowed <= 0:
             name = pdb.get("metadata", {}).get("name", "")
             return name, (

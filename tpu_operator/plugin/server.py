@@ -401,6 +401,7 @@ class DevicePluginServer:
         self.socket_path = os.path.join(socket_dir, socket_name)
         self.socket_name = socket_name
         self.server: Optional[grpc.Server] = None
+        self._bound_ino: Optional[int] = None
 
     def start(self) -> str:
         if os.path.exists(self.socket_path):
@@ -413,6 +414,10 @@ class DevicePluginServer:
         addr = f"unix://{self.socket_path}"
         self.server.add_insecure_port(addr)
         self.server.start()
+        try:
+            self._bound_ino = os.stat(self.socket_path).st_ino
+        except OSError:
+            self._bound_ino = None
         log.info(
             "device plugin serving %s on %s",
             self.servicer.resource_name,
@@ -442,8 +447,31 @@ class DevicePluginServer:
 
     def stop(self):
         self.servicer.stop()
-        if self.server is not None:
-            self.server.stop(grace=1)
+        if self.server is None:
+            return
+        # grpc unlinks the unix socket PATH at shutdown even when a newer
+        # server instance (plugin restart with the fixed socket name) has
+        # since re-bound it — deleting the successor's socket file and
+        # breaking every later kubelet re-dial. If the path's inode is no
+        # longer ours, shield the successor's file across the shutdown.
+        guard = None
+        try:
+            if (
+                self._bound_ino is not None
+                and os.stat(self.socket_path).st_ino != self._bound_ino
+            ):
+                guard = self.socket_path + ".shutdown-guard"
+                os.rename(self.socket_path, guard)
+        except OSError:
+            pass
+        try:
+            self.server.stop(grace=1).wait(timeout=5)
+        finally:
+            if guard is not None:
+                try:
+                    os.replace(guard, self.socket_path)
+                except OSError:
+                    pass
 
 
 def main(argv=None) -> int:

@@ -29,10 +29,70 @@ NS = "tpu-operator"
 CPV = "tpu.k8s.io/v1"
 
 
+def _peak_rss_mib() -> float:
+    import resource
+
+    return round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1
+    )
+
+
+def _seed_bulk_pods(client, count: int, namespaces: int) -> None:
+    """Populated-cluster variant: ``count`` unrelated (non-TPU) pods
+    spread over ``namespaces`` user namespaces — the memory trap for a
+    cluster-wide Pod informer (round-3 verdict missing #2)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    for i in range(namespaces):
+        client.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Namespace",
+                "metadata": {"name": f"bulk-ns-{i}"},
+            }
+        )
+
+    def mk(i):
+        client.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": f"bulk-{i}",
+                    "namespace": f"bulk-ns-{i % namespaces}",
+                    "labels": {"app": f"web-{i % 50}"},
+                },
+                "spec": {
+                    "nodeName": f"bulk-node-{i % 64}",
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "nginx",
+                            "resources": {
+                                "requests": {"cpu": "100m", "memory": "128Mi"}
+                            },
+                        }
+                    ],
+                },
+                "status": {"phase": "Running"},
+            }
+        )
+
+    with ThreadPoolExecutor(max_workers=16) as ex:
+        list(ex.map(mk, range(count)))
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("fleet-converge")
     p.add_argument("--nodes", type=int, default=16)
     p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument(
+        "--pods",
+        type=int,
+        default=0,
+        help="unrelated non-TPU pods to pre-seed (populated-cluster variant)",
+    )
+    p.add_argument("--pod-namespaces", type=int, default=8)
     args = p.parse_args(argv)
 
     nodes = tuple(f"fleet-{i}" for i in range(args.nodes))
@@ -40,6 +100,8 @@ def main(argv=None) -> int:
     client = make_client(server.port)
     client.GET_RETRY_BACKOFF_S = 0.05
     seed_cluster(client, NS, node_names=nodes)
+    if args.pods:
+        _seed_bulk_pods(client, args.pods, args.pod_namespaces)
 
     t0 = time.monotonic()
     mgr, reconciler, _ = build_manager(client, NS, metrics_port=0, probe_port=0)
@@ -51,12 +113,13 @@ def main(argv=None) -> int:
     def kubelet():
         while not halt.is_set():
             try:
-                simulate_kubelet_nodes(client, NS, nodes)
+                simulate_kubelet_nodes(client, NS, nodes, halt_event=halt)
             except (ConflictError, NotFoundError, TransientAPIError, OSError):
                 pass
             time.sleep(0.1)
 
-    threading.Thread(target=kubelet, daemon=True).start()
+    kubelet_thread = threading.Thread(target=kubelet, daemon=True)
+    kubelet_thread.start()
     mgr.enqueue("clusterpolicy")
 
     ok = False
@@ -75,20 +138,34 @@ def main(argv=None) -> int:
     # cache — with the informer read path this must be O(1) (≈0) requests
     # per pass regardless of fleet size (round-2 missing #1)
     halt.set()
+    # the in-flight kubelet sweep aborts mid-pass on halt; joining it
+    # keeps its writes out of the per-reconcile request measurement
+    kubelet_thread.join(timeout=60)
     mgr.stop()
     time.sleep(0.5)
     before = server.sim.requests_total()
     steady_ok = True
     rounds = 5
+    pass_t0 = time.monotonic()
     for _ in range(rounds):
         try:
             steady_ok = reconciler.reconcile().ready and steady_ok
         except Exception:
             steady_ok = False
+    reconcile_pass_ms = (time.monotonic() - pass_t0) * 1000.0 / rounds
     per_reconcile = (server.sim.requests_total() - before) / rounds
     # the whole point of the axis: a cacheless read path would make
     # O(states × nodes) requests here — gate, don't just report
     cache_ok = per_reconcile <= 2
+
+    # informer footprint: how many pods did the operator actually mirror?
+    # (the scoped Pod informer must hold operand + TPU pods only, not the
+    # bulk population; reference envelope: values.yaml:106-112 350Mi)
+    pod_informer_objects = None
+    if hasattr(mgr.client, "_informers"):
+        inf = mgr.client._informers.get(("v1", "Pod"))
+        if inf is not None and inf.synced.is_set():
+            pod_informer_objects = len(inf)
 
     stop.set()
     server.stop()
@@ -97,9 +174,13 @@ def main(argv=None) -> int:
             {
                 "ok": ok and steady_ok and cache_ok,
                 "nodes": args.nodes,
+                "bulk_pods": args.pods,
                 "time_to_ready_s": round(elapsed, 2),
                 "converge_requests": converge_requests,
                 "apiserver_requests_per_reconcile": per_reconcile,
+                "reconcile_pass_ms": round(reconcile_pass_ms, 1),
+                "peak_rss_mib": _peak_rss_mib(),
+                "pod_informer_objects": pod_informer_objects,
             }
         )
     )

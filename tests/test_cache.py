@@ -468,3 +468,144 @@ def test_informer_recovers_from_silently_dead_watch():
             except OSError:
                 pass
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# resync / drift repair (round-3 verdict #1)
+# ---------------------------------------------------------------------------
+
+
+def test_informer_resync_semantics():
+    """Unit semantics of the repair diff: missing objects are re-added,
+    stale ones updated, deleted ones dropped — but a store entry NEWER
+    than the list snapshot (write-through raced the list) is kept."""
+    inf = Informer("v1", "ConfigMap", "")
+    mk = lambda name, rv, v="v": {  # noqa: E731
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": NS, "resourceVersion": str(rv)},
+        "data": {"k": v},
+    }
+    inf.replace([mk("a", 1), mk("b", 2), mk("ghost", 3)])
+    # fresh list: a updated to rv5, b unchanged, ghost gone, c new (rv4),
+    # and the store also holds "raced" written through at rv9 > list rv 6
+    inf.on_event("ADDED", mk("raced", 9))
+    repairs = inf.resync(
+        [mk("a", 5, "v2"), mk("b", 2), mk("c", 4)], list_rv=6
+    )
+    types = sorted((t, o["metadata"]["name"]) for t, o in repairs)
+    assert types == [("ADDED", "c"), ("DELETED", "ghost"), ("MODIFIED", "a")]
+    assert inf.get("a", NS)["data"]["k"] == "v2"
+    assert inf.get("raced", NS)  # newer than snapshot: survived
+    with pytest.raises(NotFoundError):
+        inf.get("ghost", NS)
+    assert inf.drift_repairs == 3
+    # a second resync against the same state is a no-op
+    assert inf.resync([mk("a", 5, "v2"), mk("b", 2), mk("c", 4), mk("raced", 9)], list_rv=9) == []
+    assert inf.drift_repairs == 3
+
+
+def test_wire_dropped_watch_event_healed_by_resync(wire):
+    """The round-3 verdict done-criterion: a watch line swallowed for one
+    client (kubesim fault injection) becomes a bounded-staleness incident
+    — the periodic re-list repairs the store, increments the drift
+    metric, and re-dispatches the repair through the event hooks so the
+    workqueue reconciles what the lost event hid."""
+    server, client, cached = wire
+    repair_events = []
+    cached.add_event_hook(lambda t, o: repair_events.append((t, o)))
+
+    client.create(cm("drift-cm", data={"k": "v1"}))
+    assert wait_until(
+        lambda: cached.get("v1", "ConfigMap", "drift-cm", NS) is not None
+    )
+
+    # swallow the next ConfigMap watch line for the informer's stream,
+    # then delete live: the cache keeps serving the ghost...
+    server.sim.inject_watch_drop("configmaps", 1)
+    client.delete("v1", "ConfigMap", "drift-cm", NS)
+    # ...wait for a bookmark to advance the stream cursor past the
+    # dropped event so a window renewal can NOT replay it (the silent-
+    # drift scenario: without resync this ghost would live forever)
+    time.sleep(1.2)
+    assert server.sim.watch_drops_injected >= 1
+    assert cached.get("v1", "ConfigMap", "drift-cm", NS) is not None
+
+    # ...until one resync period heals it
+    cached.resync_interval_s = 1.0
+    cached._start_resync_thread(threading.Event())
+    assert wait_until(
+        lambda: not _has(cached, "drift-cm"), timeout_s=10
+    ), "resync did not repair the dropped DELETED event"
+    assert cached.drift_repairs_total() >= 1
+    assert any(
+        t == "DELETED" and o["metadata"]["name"] == "drift-cm"
+        for t, o in repair_events
+    ), "repair was not re-dispatched through the event hooks"
+
+
+def _has(cached, name):
+    try:
+        cached.get("v1", "ConfigMap", name, NS)
+        return True
+    except NotFoundError:
+        return False
+
+
+def test_wire_dropped_added_event_healed_by_resync(wire):
+    """Same fault, other direction: a swallowed ADDED line means the
+    cache never learns the object exists; resync must add it."""
+    server, client, cached = wire
+    server.sim.inject_watch_drop("configmaps", 1)
+    client.create(cm("drift-add-cm"))
+    time.sleep(1.0)
+    assert not _has(cached, "drift-add-cm"), "fault was not injected"
+    assert cached.resync_once() >= 1
+    assert _has(cached, "drift-add-cm")
+    assert cached.drift_repairs_total() >= 1
+
+
+def test_pod_informer_scoped_to_operator_and_tpu_pods(fake):
+    """The cluster-wide Pod informer keeps only operand pods (operator
+    namespace) and TPU-requesting pods anywhere — on a populated cluster
+    it must not mirror every unrelated pod into operator memory
+    (reference scopes pod reads by selector,
+    vendor/.../upgrade/upgrade_state.go:160-212). Out-of-scope gets fall
+    through live because a filtered cache cannot prove absence."""
+    client, cached = fake
+
+    def pod(name, ns, tpu=False):
+        res = {"limits": {"google.com/tpu": "4"}} if tpu else {}
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"containers": [{"name": "c", "resources": res}]},
+        }
+
+    client.create(pod("operand", NS))            # kept: operator ns
+    client.create(pod("train", "user-ns", tpu=True))   # kept: TPU pod
+    client.create(pod("web", "user-ns"))         # filtered out
+
+    inf = cached._informers[("v1", "Pod")]
+    names = {o["metadata"]["name"] for o in inf.list()}
+    assert names == {"operand", "train"}
+
+    # cached cluster-wide list serves the scope (callers filter to TPU
+    # pods anyway); the unrelated pod is not in operator memory
+    assert {
+        o["metadata"]["name"] for o in cached.list("v1", "Pod")
+    } == {"operand", "train"}
+
+    # a get of the filtered pod still answers from live (scoped informer
+    # cannot prove absence outside its authoritative namespace)
+    assert cached.get("v1", "Pod", "web", "user-ns")["metadata"]["name"] == "web"
+
+    # a TPU pod rescheduled as non-TPU leaves the store
+    p = client.get("v1", "Pod", "train", "user-ns")
+    p["spec"]["containers"][0]["resources"] = {}
+    client.update(p)
+    assert {o["metadata"]["name"] for o in inf.list()} == {"operand"}
+
+    # resync respects the scope: no repair-adds for filtered pods
+    assert cached.resync_once() == 0

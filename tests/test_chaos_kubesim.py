@@ -31,12 +31,22 @@ CHURN_S = float(os.environ.get("CHAOS_DURATION_S", "12"))
 # one seed constant for BOTH the rng and the stats record, so the
 # durable trail can never report a seed that was not the one used
 CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "20260730"))
+# watch lines silently swallowed mid-storm (round-3 verdict #1): the
+# informer resync must repair them, so the settle-time cache-vs-live
+# comparison still reads drift=0
+CHAOS_WATCH_DROPS = int(os.environ.get("CHAOS_WATCH_DROPS", "2"))
 
 API_ERRORS = (ConflictError, NotFoundError, TransientAPIError, OSError)
 
 
 def test_chaos_churn_then_converge():
     base = ["chaos-node-0", "chaos-node-1", "chaos-node-2"]
+    # resync fast enough that an injected watch-drop heals within the
+    # settle budget (production default is 300 s; same code path)
+    prev_resync = os.environ.get("INFORMER_RESYNC_INTERVAL_S")
+    os.environ["INFORMER_RESYNC_INTERVAL_S"] = os.environ.get(
+        "CHAOS_RESYNC_INTERVAL_S", "5"
+    )
     server = KubeSimServer(KubeSim(bookmark_interval_s=1.0)).start()
     client = make_client(server.port)
     client.GET_RETRY_BACKOFF_S = 0.05
@@ -129,6 +139,16 @@ def test_chaos_churn_then_converge():
             )
             client.update(node)
 
+        drops_left = [CHAOS_WATCH_DROPS]
+
+        def drop_watch_line():
+            if drops_left[0] <= 0:
+                return
+            drops_left[0] -= 1
+            server.sim.inject_watch_drop(
+                rng.choice(["pods", "nodes", "daemonsets", "configmaps"])
+            )
+
         actions = [
             add_node,
             del_node,
@@ -137,6 +157,7 @@ def test_chaos_churn_then_converge():
             toggle_exporter,
             bump_libtpu,
             scribble_labels,
+            drop_watch_line,
         ]
         deadline = time.monotonic() + CHURN_S
         while not halt.is_set() and time.monotonic() < deadline:
@@ -152,6 +173,7 @@ def test_chaos_churn_then_converge():
     )
     soak_ok = False
     settle_s = None
+    drift_repairs = None
     try:
         chaos_thread.start()
         with running_operator(client, NS, nodes):
@@ -267,6 +289,62 @@ def test_chaos_churn_then_converge():
                 lambda: mgr._last_reconcile_ok, 30
             ), "worker wedged after chaos"
 
+            # drift assertion (round-3 verdict #1): at settle every
+            # informer store must agree with a fresh live LIST — even
+            # though CHAOS_WATCH_DROPS lines were swallowed mid-storm,
+            # resync repaired them. Events are excluded (count-bump
+            # churn plus TTL expiry make rv equality meaningless there).
+            def cache_mismatches():
+                cached = mgr.client
+                if not hasattr(cached, "_informers"):
+                    return []
+                diffs = []
+                for (av, kind), inf in cached._informers.items():
+                    if kind == "Event" or not inf.synced.is_set():
+                        continue
+                    try:
+                        live = client.list(av, kind, inf.namespace)
+                    except API_ERRORS:
+                        continue
+                    if inf.keep is not None:
+                        # scoped informer: compare within its scope
+                        live = [o for o in live if inf.keep(o)]
+
+                    def as_map(objs):
+                        return {
+                            (
+                                o["metadata"].get("namespace", ""),
+                                o["metadata"]["name"],
+                            ): o["metadata"].get("resourceVersion")
+                            for o in objs
+                        }
+
+                    live_map, cache_map = as_map(live), as_map(inf.list())
+                    if live_map != cache_map:
+                        diffs.append(
+                            (
+                                kind,
+                                sorted(
+                                    set(live_map.items())
+                                    ^ set(cache_map.items())
+                                )[:6],
+                            )
+                        )
+                return diffs
+
+            # one resync period of grace for an unlucky just-dropped line
+            wait_until(lambda: not cache_mismatches(), 30)
+            drift_at_settle = cache_mismatches()
+            assert not drift_at_settle, (
+                f"informer cache drifted from live state at settle: "
+                f"{drift_at_settle}"
+            )
+            drift_repairs = (
+                mgr.client.drift_repairs_total()
+                if hasattr(mgr.client, "drift_repairs_total")
+                else None
+            )
+
         soak_ok = True
     finally:
         chaos_halt.set()
@@ -286,6 +364,9 @@ def test_chaos_churn_then_converge():
                     round(settle_s, 2) if settle_s is not None else None
                 ),
                 "apiserver_requests": server.sim.requests_total(),
+                "watch_drops_injected": server.sim.watch_drops_injected,
+                "drift_repairs": drift_repairs,
+                "drift_at_settle": 0 if soak_ok else None,
                 "ok": soak_ok,
             },
         }
@@ -298,4 +379,8 @@ def test_chaos_churn_then_converge():
                 f.write(json.dumps(stats) + "\n")
         except OSError:
             pass  # a read-only checkout must not fail the soak
+        if prev_resync is None:
+            os.environ.pop("INFORMER_RESYNC_INTERVAL_S", None)
+        else:
+            os.environ["INFORMER_RESYNC_INTERVAL_S"] = prev_resync
         server.stop()

@@ -639,3 +639,64 @@ def test_eviction_malformed_pdb_blocks_not_500(cluster):
         client.evict("victim", NS)
     assert "malformed" in str(exc.value)
     assert client.get("v1", "Pod", "victim", NS) is not None
+
+
+def test_event_ttl_expiry(cluster):
+    """Events expire like a real apiserver's --event-ttl: untouched
+    Events vanish from lists (with DELETED watch events so informers
+    unmirror them); a count-bump update resets the clock."""
+    from tests.conftest import wait_until
+
+    server, client = cluster
+    server.sim.event_ttl_s = 0.4
+
+    def ev(name):
+        return {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"name": name, "namespace": NS},
+            "reason": "Test",
+            "message": "m",
+            "type": "Normal",
+            "count": 1,
+        }
+
+    client.create(ev("stale-ev"))
+    client.create(ev("fresh-ev"))
+    deadline = time.monotonic() + 2.0
+    # keep touching fresh-ev (dedup count bumps) while stale-ev ages out
+    while time.monotonic() < deadline:
+        cur = client.get("v1", "Event", "fresh-ev", NS)
+        cur["count"] = int(cur.get("count", 1)) + 1
+        try:
+            client.update(cur)
+        except ConflictError:
+            pass
+        time.sleep(0.1)
+        names = {
+            e["metadata"]["name"] for e in client.list("v1", "Event", NS)
+        }
+        if "stale-ev" not in names:
+            break
+    names = {e["metadata"]["name"] for e in client.list("v1", "Event", NS)}
+    assert "stale-ev" not in names, "event outlived its TTL"
+    assert "fresh-ev" in names, "touched event must NOT expire"
+
+    # expiry emits DELETED on the watch stream (informer contract)
+    got = []
+    stop = threading.Event()
+    t = threading.Thread(
+        target=client.watch,
+        args=("v1", "Event", lambda e, o: got.append((e, o["metadata"]["name"]))),
+        kwargs={"namespace": NS, "stop_event": stop},
+        daemon=True,
+    )
+    t.start()
+    try:
+        assert wait_until(lambda: ("ADDED", "fresh-ev") in got, 10)
+        server.sim.event_ttl_s = 0.2
+        assert wait_until(
+            lambda: ("DELETED", "fresh-ev") in got, 10
+        ), "TTL expiry must reach watch streams as DELETED"
+    finally:
+        stop.set()

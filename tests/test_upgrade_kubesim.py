@@ -435,6 +435,14 @@ def test_pdb_blocked_drain_fails_with_veto_event(cluster):
     eviction path (not a bare DELETE that would bypass the PDB) is what
     the operator runs."""
     server, client = cluster
+    from tpu_operator.controllers.operator_metrics import OperatorMetrics
+
+    m = OperatorMetrics()
+    blocked_before = (
+        m.evictions_blocked._value.get()
+        if getattr(m, "evictions_blocked", None)
+        else None
+    )
     with running_operator(client):
         assert wait_until(lambda: cr_state(client) == "ready", 90)
 
@@ -520,6 +528,10 @@ def test_pdb_blocked_drain_fails_with_veto_event(cluster):
         assert veto_events, [
             (e.get("reason"), e.get("message")) for e in events
         ]
+        # veto pressure is operator-visible as a climbing counter (the
+        # TPUUpgradeEvictionsBlocked alert rides it), not just an Event
+        if blocked_before is not None:
+            assert m.evictions_blocked._value.get() > blocked_before
 
         # documented recovery: drop the budget, uncordon, clear the state
         # label -> FSM re-enters and completes

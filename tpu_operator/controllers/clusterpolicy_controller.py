@@ -162,6 +162,14 @@ class ClusterPolicyReconciler:
         return summary
 
     def _update_fleet_metrics(self) -> None:
+        if (
+            self.metrics
+            and getattr(self.metrics, "informer_drift_repairs", None)
+            and hasattr(self.client, "drift_repairs_total")
+        ):
+            self.metrics.informer_drift_repairs.set(
+                self.client.drift_repairs_total()
+            )
         if self.metrics and getattr(self.metrics, "tpu_nodes_total", None):
             self.metrics.tpu_nodes_total.set(self.ctrl.tpu_node_count)
             self.metrics.feature_labels_present.set(

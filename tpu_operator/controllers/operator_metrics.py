@@ -95,6 +95,21 @@ class OperatorMetrics:
         self.upgrades_unknown = g(
             "libtpu_upgrades_unknown", "Nodes with unknown upgrade state"
         )
+        # PDB-veto pressure (reference drain path
+        # vendor/.../upgrade/drain_manager.go:76-89): each count is one
+        # eviction a PodDisruptionBudget refused — sustained growth means
+        # a drain is stuck behind a budget and the upgrade cannot proceed
+        self.evictions_blocked = c(
+            "upgrade_evictions_blocked_total",
+            "Upgrade-drain evictions vetoed by a PodDisruptionBudget",
+        )
+        # informer health (client-go reflector resync analogue): nonzero
+        # means a watch stream silently swallowed an event and the
+        # periodic re-list repaired the cache
+        self.informer_drift_repairs = g(
+            "informer_drift_repairs_total",
+            "Cache objects repaired by informer resync (missed watch events)",
+        )
 
     # -- convenience ----------------------------------------------------
     def observe_reconcile(self, status_value: int) -> None:

@@ -87,6 +87,27 @@ def default_cache_specs(
     ]
 
 
+def pod_scope_filter(namespace: str) -> Callable[[Obj], bool]:
+    """Scope predicate for the cluster-wide Pod informer: keep operand
+    pods (the operator's namespace) and TPU-requesting workload pods
+    anywhere — everything the reconcile/upgrade/slice paths actually read
+    (``upgrade_state.tpu_pods_on_node`` filters to TPU pods,
+    ``object_controls``/``slice_status`` read namespace pods). On a
+    populated cluster (10k+ unrelated pods) an unscoped mirror is
+    unbounded operator memory; the reference scopes its pod reads with a
+    label selector (vendor/.../upgrade/upgrade_state.go:160-212), this is
+    the same idea expressed as a cache filter (controller-runtime
+    ByObject selector)."""
+    from tpu_operator.upgrade.upgrade_state import pod_requests_tpu
+
+    def keep(pod: Obj) -> bool:
+        if pod.get("metadata", {}).get("namespace", "") == namespace:
+            return True
+        return pod_requests_tpu(pod)
+
+    return keep
+
+
 def _rv_int(obj: Obj) -> Optional[int]:
     """resourceVersion as an int, or None when non-numeric.
 
@@ -108,11 +129,27 @@ class Informer:
     """One kind's watch-fed store. Thread-safe; ``synced`` is set after
     the first full list has been delivered."""
 
-    def __init__(self, api_version: str, kind: str, namespace: str):
+    def __init__(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str,
+        keep: Optional[Callable[[Obj], bool]] = None,
+    ):
         self.api_version = api_version
         self.kind = kind
         self.namespace = namespace
+        # scope filter (controller-runtime cache ByObject selector
+        # analogue): objects failing ``keep`` are never stored — on a
+        # populated cluster the cluster-wide Pod informer would otherwise
+        # mirror every unrelated pod into operator memory, where the
+        # reference scopes its pod reads by selector
+        # (vendor/.../upgrade/upgrade_state.go:160-212)
+        self.keep = keep
         self.synced = threading.Event()
+        # objects repaired by resync() because the store disagreed with a
+        # fresh LIST — each one is a watch event this informer never got
+        self.drift_repairs = 0
         self._lock = threading.Lock()
         self._store: Dict[Tuple[str, str], Obj] = {}  # (ns, name) -> obj
         # deletions observed before the initial seed lands: a concurrent
@@ -126,6 +163,14 @@ class Informer:
         key = (meta.get("namespace", ""), meta.get("name", ""))
         if not key[1]:
             return
+        if etype != "DELETED" and self.keep is not None and not self.keep(obj):
+            # out of scope — and an in-scope object mutated OUT of scope
+            # must leave the store, like a label-selector cache would drop
+            # it (fall through to the DELETED path if we hold it)
+            with self._lock:
+                if key not in self._store:
+                    return
+            etype = "DELETED"
         with self._lock:
             have = self._store.get(key)
             # monotonicity guard: a watch event older than what a
@@ -146,6 +191,8 @@ class Informer:
         flowed (subscription precedes the list so nothing is missed):
         newer store entries win over the snapshot, and keys deleted since
         the snapshot was taken stay deleted."""
+        if self.keep is not None:
+            objs = [o for o in objs if self.keep(o)]
         with self._lock:
             for o in objs:
                 meta = o.get("metadata", {})
@@ -162,6 +209,65 @@ class Informer:
                 self._store[key] = copy.deepcopy(o)
             self._tombstones.clear()
         self.synced.set()
+
+    def resync(
+        self, objs: List[Obj], list_rv: Optional[int] = None
+    ) -> List[Tuple[str, Obj]]:
+        """Repair the store against a fresh LIST (client-go reflector
+        resync semantics: the watch stream is trusted but verified). A
+        bounded watch window restart catches a DEAD stream; only a
+        re-list catches a stream that silently swallowed one event.
+        Returns the repair events applied, for hook re-dispatch:
+
+        * fresh object missing from the store        -> ADDED repair
+        * fresh object newer than the store's        -> MODIFIED repair
+        * store object absent from the list and not
+          newer than the list snapshot               -> DELETED repair
+
+        ``list_rv`` (the List response's collection resourceVersion)
+        guards deletes: a store entry written through AFTER the snapshot
+        was cut (rv > list_rv) is not drift, just a faster write path.
+        Repairs observed during active churn may include events still in
+        flight on the watch stream — harmless (idempotent), so the
+        drift_repairs metric is meaningful in quiescence, not mid-storm."""
+        if self.keep is not None:
+            objs = [o for o in objs if self.keep(o)]
+        repairs: List[Tuple[str, Obj]] = []
+        with self._lock:
+            fresh: Dict[Tuple[str, str], Obj] = {}
+            for o in objs:
+                meta = o.get("metadata", {})
+                key = (meta.get("namespace", ""), meta.get("name", ""))
+                if key[1]:
+                    fresh[key] = o
+            for key, o in fresh.items():
+                have = self._store.get(key)
+                if have is None:
+                    self._store[key] = copy.deepcopy(o)
+                    repairs.append(("ADDED", o))
+                    continue
+                old_rv, new_rv = _rv_int(have), _rv_int(o)
+                if old_rv is not None and new_rv is not None:
+                    if new_rv > old_rv:
+                        self._store[key] = copy.deepcopy(o)
+                        repairs.append(("MODIFIED", o))
+                elif have != o:
+                    # opaque rvs: can't order, repair on inequality
+                    self._store[key] = copy.deepcopy(o)
+                    repairs.append(("MODIFIED", o))
+            for key in [k for k in self._store if k not in fresh]:
+                have = self._store[key]
+                have_rv = _rv_int(have)
+                if (
+                    list_rv is not None
+                    and have_rv is not None
+                    and have_rv > list_rv
+                ):
+                    continue  # created after the snapshot; watch will tell
+                del self._store[key]
+                repairs.append(("DELETED", have))
+            self.drift_repairs += len(repairs)
+        return repairs
 
     # -- reads -----------------------------------------------------------
     def get(self, name: str, namespace: str = "") -> Obj:
@@ -208,15 +314,32 @@ class CachedClient(Client):
         client: Client,
         namespace: str = "",
         specs: Optional[List[Tuple[str, str, str]]] = None,
+        resync_interval_s: float = 300.0,
     ):
         from tpu_operator import consts
 
         self.live = client
         self.namespace = namespace
+        # client-go reflector resync analogue: every interval each synced
+        # informer re-LISTs and repairs divergence (a dropped/misdelivered
+        # watch event becomes a bounded-staleness incident with a metric,
+        # not permanent drift). 0 disables the background loop
+        # (resync_once stays available for tests).
+        self.resync_interval_s = resync_interval_s
         if specs is None:
             specs = default_cache_specs(consts.API_VERSION, namespace)
         self._informers: Dict[Tuple[str, str], Informer] = {
-            (av, kind): Informer(av, kind, ns) for av, kind, ns in specs
+            (av, kind): Informer(
+                av,
+                kind,
+                ns,
+                keep=(
+                    pod_scope_filter(namespace)
+                    if kind == "Pod" and not ns and namespace
+                    else None
+                ),
+            )
+            for av, kind, ns in specs
         }
         self._hooks: List[Callable[[str, Obj], None]] = []
         self._started = False
@@ -258,6 +381,7 @@ class CachedClient(Client):
             self.live.add_watcher(fan_out)
             for (av, kind), inf in self._informers.items():
                 inf.replace(self.live.list(av, kind, inf.namespace))
+            self._start_resync_thread(stop_event)
             return True
         if not hasattr(self.live, "watch"):
             log.warning("underlying client has no watch; cache stays passthrough")
@@ -281,6 +405,7 @@ class CachedClient(Client):
             )
             t.start()
             self._threads.append(t)
+        self._start_resync_thread(stop_event)
         deadline = timeout_s
         ok = True
         import time as _time
@@ -292,6 +417,79 @@ class CachedClient(Client):
                 log.warning("informer for %s not synced after %.0fs", kind, timeout_s)
                 ok = False
         return ok
+
+    def _start_resync_thread(self, stop_event: threading.Event) -> None:
+        if not self.resync_interval_s:
+            return
+
+        def loop():
+            while not stop_event.wait(self.resync_interval_s):
+                try:
+                    self.resync_once(stop_event)
+                except Exception:
+                    log.exception("informer resync pass failed")
+
+        t = threading.Thread(target=loop, daemon=True, name="informer-resync")
+        t.start()
+        self._threads.append(t)
+
+    def _list_live_with_rv(
+        self, api_version: str, kind: str, namespace: str
+    ) -> Tuple[List[Obj], Optional[int]]:
+        if hasattr(self.live, "list_with_rv"):
+            items, rv = self.live.list_with_rv(api_version, kind, namespace)
+            try:
+                return items, int(rv)
+            except (TypeError, ValueError):
+                return items, None
+        return self.live.list(api_version, kind, namespace), None
+
+    def resync_once(self, stop_event: Optional[threading.Event] = None) -> int:
+        """One repair pass over every synced informer: fresh LIST, diff,
+        repair, and re-dispatch repair events through the hooks so the
+        workqueue reconciles anything a swallowed watch event hid.
+        Returns the number of repairs applied."""
+        from tpu_operator.kube.client import NotFoundError as _NF
+
+        total = 0
+        for (av, kind), inf in self._informers.items():
+            if stop_event is not None and stop_event.is_set():
+                return total  # shutting down: don't log list noise
+            if not inf.synced.is_set():
+                continue
+            try:
+                objs, list_rv = self._list_live_with_rv(av, kind, inf.namespace)
+            except _NF:
+                objs, list_rv = [], None  # kind not served: empty is truth
+            except Exception:
+                log.warning("resync list for %s failed; skipping", kind)
+                continue
+            for o in objs:
+                o.setdefault("apiVersion", av)
+                o.setdefault("kind", kind)
+            repairs = inf.resync(objs, list_rv)
+            if repairs:
+                total += len(repairs)
+                log.warning(
+                    "informer %s drifted from live state: repaired %d "
+                    "object(s) (missed watch events)",
+                    kind,
+                    len(repairs),
+                )
+                for etype, obj in repairs:
+                    for fn in list(self._hooks):
+                        try:
+                            fn(etype, obj)
+                        except Exception:
+                            log.exception(
+                                "resync repair hook failed for %s %s",
+                                etype,
+                                kind,
+                            )
+        return total
+
+    def drift_repairs_total(self) -> int:
+        return sum(inf.drift_repairs for inf in self._informers.values())
 
     def _informer_for(
         self, api_version: str, kind: str, namespace: str
@@ -321,7 +519,15 @@ class CachedClient(Client):
         inf = self._informer_for(api_version, kind, namespace)
         if inf is None:
             return self.live.get(api_version, kind, name, namespace)
-        return inf.get(name, namespace)
+        try:
+            return inf.get(name, namespace)
+        except NotFoundError:
+            if inf.keep is not None and namespace != self.namespace:
+                # a scoped informer cannot prove absence outside its
+                # authoritative namespace: the object may exist live and
+                # simply be filtered (e.g. a non-TPU pod elsewhere)
+                return self.live.get(api_version, kind, name, namespace)
+            raise
 
     def get_live(self, api_version, kind, name, namespace=""):
         """Bypass the cache — read-modify-write retry paths after a 409."""
@@ -406,7 +612,10 @@ class CachedClient(Client):
             try:
                 inf.get(name, namespace)
             except NotFoundError:
-                return False
+                if inf.keep is None or namespace == self.namespace:
+                    return False
+                # scoped informer, foreign namespace: the miss is
+                # ambiguous — fall through to the live DELETE probe
         return super().delete_if_exists(api_version, kind, name, namespace)
 
     def apply(self, obj):

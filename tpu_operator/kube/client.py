@@ -292,6 +292,13 @@ class FakeClient(Client):
                 out.append(copy.deepcopy(obj))
             return out
 
+    def list_with_rv(self, api_version, kind, namespace=""):
+        """List plus the store's current resourceVersion (what a real
+        List response carries in its collection metadata)."""
+        with self._lock:
+            rv = str(self._rv)
+        return self.list(api_version, kind, namespace), rv
+
     @staticmethod
     def _match_fields(obj: Obj, selector: Dict[str, str]) -> bool:
         return match_fields(obj, selector)

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import copy
 import json
+import os
 import re
 import threading
 import time
@@ -80,6 +81,38 @@ class KubeSim:
         # the informer-cache bench axis counts apiserver requests per
         # reconcile against these
         self.request_counts: Dict[str, int] = {}
+        # fault injection: plural -> number of watch event lines to
+        # silently swallow (first consuming stream eats one) — models a
+        # proxy hiccup / lost line that real informers must self-heal
+        # from via resync
+        self._watch_drop_faults: Dict[str, int] = {}
+        self.watch_drops_injected = 0
+        # Events expire like a real apiserver's --event-ttl (default 1h):
+        # without it an hour-scale Event storm grows the store — and
+        # every informer mirroring it — without bound. Keyed by store
+        # key, stamped at create AND update (TTL measures from last
+        # touch, matching apiserver behavior).
+        self.event_ttl_s = float(os.environ.get("KUBESIM_EVENT_TTL_S", "3600"))
+        self._event_touch: Dict[Tuple, float] = {}
+
+    def inject_watch_drop(self, plural: str, count: int = 1) -> None:
+        """Arrange for the next ``count`` watch event lines for ``plural``
+        to be silently dropped on whichever client stream would have
+        delivered them (the event stays in history; other streams and
+        re-lists still see the state)."""
+        with self._lock:
+            self._watch_drop_faults[plural] = (
+                self._watch_drop_faults.get(plural, 0) + count
+            )
+
+    def _consume_watch_drop(self, plural: str) -> bool:
+        with self._lock:
+            n = self._watch_drop_faults.get(plural, 0)
+            if n <= 0:
+                return False
+            self._watch_drop_faults[plural] = n - 1
+            self.watch_drops_injected += 1
+            return True
 
     def count_request(self, verb: str, is_watch: bool = False) -> None:
         key = "WATCH" if is_watch else verb
@@ -110,6 +143,27 @@ class KubeSim:
             self._min_event_rv = self._events[drop - 1][0]
             del self._events[:drop]
         self._cond.notify_all()
+
+    def expire_events(self) -> int:
+        """Drop Events untouched for ``event_ttl_s`` (the apiserver's
+        ``--event-ttl``, default 1h), emitting DELETED watch events so
+        informers unmirror them. Called lazily from the read/watch paths;
+        idempotent and cheap when nothing expired."""
+        if self.event_ttl_s <= 0:
+            return 0
+        cutoff = time.monotonic() - self.event_ttl_s
+        with self._lock:
+            stale = [k for k in self._event_touch if k not in self._objs]
+            for k in stale:
+                self._event_touch.pop(k, None)
+            expired = [
+                (k, self._objs[k])
+                for k, t in list(self._event_touch.items())
+                if t < cutoff
+            ]
+            for key, obj in expired:
+                self._delete_stored(key, obj)
+        return len(expired)
 
     def compact_now(self) -> None:
         """Force-compact the whole event log (tests use this to drive the
@@ -186,6 +240,8 @@ class KubeSim:
             self._objs[key] = copy.deepcopy(body)
             if plural == "customresourcedefinitions":
                 self._register_crd(self._objs[key])
+            if plural == "events":
+                self._event_touch[key] = time.monotonic()
             self._emit("ADDED", key, self._objs[key])
             return 201, copy.deepcopy(self._objs[key])
 
@@ -245,6 +301,8 @@ class KubeSim:
                     # an updated CRD schema takes effect immediately, as
                     # on a real apiserver
                     self._register_crd(self._objs[key])
+            if plural == "events":
+                self._event_touch[key] = time.monotonic()
             self._emit("MODIFIED", key, self._objs[key])
             return 200, copy.deepcopy(self._objs[key])
 
@@ -270,6 +328,7 @@ class KubeSim:
         get two DELETED events."""
         if self._objs.pop(key, None) is None:
             return
+        self._event_touch.pop(key, None)
         obj["metadata"]["resourceVersion"] = self._bump()
         self._emit("DELETED", key, obj)
         self._gc(obj["metadata"].get("uid"))
@@ -336,6 +395,8 @@ class KubeSim:
 
     def list(self, group, version, plural, namespace, label_sel="", field_sel=""):
         kind, namespaced = PLURAL_TABLE[plural]
+        if plural == "events":
+            self.expire_events()
         if label_sel:
             # parse once up front: a malformed selector is 400 Bad
             # Request, not an empty result
@@ -389,6 +450,10 @@ class KubeSim:
             )
             return
         while not stop.is_set() and time.monotonic() < deadline:
+            if plural == "events":
+                # any active Event watch keeps expiry live even when
+                # nobody lists — informers must see the DELETEDs
+                self.expire_events()
             batch: List[Tuple[str, dict]] = []
             with self._cond:
                 if cursor < self._min_event_rv:
@@ -408,6 +473,8 @@ class KubeSim:
                 yield "ERROR", _status(410, "Expired", "history compacted")
                 return
             for etype, obj in batch:
+                if self._consume_watch_drop(plural):
+                    continue  # injected fault: this stream never sees it
                 yield etype, obj
             now = time.monotonic()
             if now - last_bookmark >= self.bookmark_interval_s:

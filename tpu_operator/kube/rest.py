@@ -246,6 +246,19 @@ class RestClient(Client):
             items = [o for o in items if match_labels(o, label_selector)]
         return items
 
+    def list_with_rv(self, api_version, kind, namespace=""):
+        """Unfiltered list plus the List response's collection
+        resourceVersion — the informer resync needs the snapshot rv to
+        tell a deleted object from one created after the snapshot."""
+        result = self._request(
+            "GET", _resource_path(api_version, kind, namespace)
+        )
+        items = result.get("items", [])
+        for item in items:
+            item.setdefault("apiVersion", api_version)
+            item.setdefault("kind", kind)
+        return items, result.get("metadata", {}).get("resourceVersion")
+
     def create(self, obj):
         av, kind = obj["apiVersion"], obj["kind"]
         meta = obj.get("metadata", {})

@@ -143,7 +143,9 @@ def simulate_kubelet_once(
             )
 
 
-def simulate_kubelet_nodes(client: Client, namespace: str, node_names) -> None:
+def simulate_kubelet_nodes(
+    client: Client, namespace: str, node_names, halt_event=None
+) -> None:
     """One kubelet pass over a multi-node pool with FAITHFUL OnDelete
     semantics: each node gets one Running pod per DaemonSet (named
     ``{app}-{node}``) stamped with the template revision hash at creation
@@ -193,6 +195,11 @@ def simulate_kubelet_nodes(client: Client, namespace: str, node_names) -> None:
         on_delete = ds["spec"].get("updateStrategy", {}).get("type") == "OnDelete"
         app, h = _ds_app_and_hash(ds)
         for node in matching:
+            if halt_event is not None and halt_event.is_set():
+                # a fleet-scale sweep takes minutes; callers that halt the
+                # kubelet (to measure a quiesced steady state) must be
+                # able to abort MID-sweep, not just between sweeps
+                return
             _ensure_operand_pod(
                 client,
                 namespace,

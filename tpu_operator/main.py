@@ -111,7 +111,16 @@ def build_manager(
     if informer_cache and not hasattr(client, "add_event_hook"):
         from tpu_operator.kube.cache import CachedClient
 
-        client = CachedClient(client, namespace=namespace)
+        client = CachedClient(
+            client,
+            namespace=namespace,
+            # drift self-healing cadence (client-go reflector resync);
+            # minutes-scale by default, env-tunable like the validator's
+            # probe knobs
+            resync_interval_s=float(
+                os.environ.get("INFORMER_RESYNC_INTERVAL_S", "300")
+            ),
+        )
 
     mgr = Manager(
         client,

@@ -213,6 +213,12 @@ class _HealthHandler(BaseHTTPRequestHandler):
                 # per-kind informer store sizes; null = informer never
                 # synced (reads fall through live) — the staleness tell
                 payload["informer_cache"] = m.client.cache_info()
+            if hasattr(m.client, "drift_repairs_total"):
+                # watch events the resync pass had to repair — nonzero
+                # means a stream silently swallowed an event
+                payload["informer_drift_repairs"] = (
+                    m.client.drift_repairs_total()
+                )
             body = json.dumps(payload)
             self._respond(200, body, "application/json")
             return

@@ -298,7 +298,24 @@ class PodManager:
                 )
                 res.blocked.append(str(e))
                 res.blocked_pods.append(pod)
+                self._count_blocked_eviction()
         return res
+
+    @staticmethod
+    def _count_blocked_eviction() -> None:
+        """PDB-veto pressure metric: a stuck-forever drain must be an
+        operator-visible condition (alert rides this counter), not just a
+        Warning Event."""
+        try:
+            from tpu_operator.controllers.operator_metrics import (
+                OperatorMetrics,
+            )
+
+            m = OperatorMetrics()
+            if getattr(m, "evictions_blocked", None):
+                m.evictions_blocked.inc()
+        except Exception:
+            pass  # metrics are never load-bearing for the drain itself
 
     def operand_pods_on_node(self, node_name: str, app: str) -> List[Obj]:
         return [

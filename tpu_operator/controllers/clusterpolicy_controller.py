@@ -265,15 +265,36 @@ class ClusterPolicyReconciler:
 # ---------------------------------------------------------------------------
 
 
+def _tpu_resource_view(node: dict) -> tuple:
+    """The node-status slice the operator's readiness logic consumes:
+    TPU-prefixed capacity/allocatable entries (kubelet-derived chip
+    health feeding slice-scoped readiness)."""
+    status = node.get("status", {}) or {}
+    out = []
+    for bucket in ("capacity", "allocatable"):
+        for k, v in sorted((status.get(bucket) or {}).items()):
+            if k == consts.TPU_RESOURCE or k.startswith(
+                consts.TPU_SUBSLICE_RESOURCE_PREFIX
+            ):
+                out.append((bucket, k, v))
+    return tuple(out)
+
+
 def node_event_needs_reconcile(event: str, old: Optional[dict], new: dict) -> bool:
-    """Label-diff predicate deciding whether a Node event triggers a
-    reconcile (reference ``:247-306``): new TPU node arrives, TPU labels
-    change, or operator labels were externally modified."""
+    """Predicate deciding whether a Node event triggers a reconcile
+    (reference ``:247-306``): new TPU node arrives, TPU labels change,
+    operator labels were externally modified — or the kubelet changed
+    the node's TPU capacity/allocatable (the reference's predicates are
+    label-only, but slice-scoped readiness consumes kubelet-derived chip
+    health, so a chip souring AFTER validation must wake the reconciler
+    too)."""
     if event == "ADDED":
         return has_tpu_labels(new)
     if event == "DELETED":
         return True
     if old is None:
+        return True
+    if _tpu_resource_view(old) != _tpu_resource_view(new):
         return True
     old_labels = old.get("metadata", {}).get("labels", {}) or {}
     new_labels = new.get("metadata", {}).get("labels", {}) or {}

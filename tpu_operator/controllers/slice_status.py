@@ -46,6 +46,9 @@ class SliceInfo:
     member_nodes: List[str] = field(default_factory=list)
     expected_hosts: int = 0  # 0 = unknown; fall back to member count
     ready_nodes: int = 0
+    # members advertising the TPU resource with ZERO allocatable chips —
+    # the per-host reason a slice is down, named in the degradation Event
+    unhealthy_hosts: List[str] = field(default_factory=list)
 
     @property
     def ready(self) -> bool:
@@ -136,6 +139,26 @@ def validator_ready_nodes(
     return ready
 
 
+def host_allocatable_ok(node: Obj) -> Optional[bool]:
+    """Kubelet-derived chip health for a member host — the reference's
+    capacity check (``validator/main.go:1083-1161``) at slice
+    granularity. ``None`` = the TPU resource is not advertised yet
+    (bring-up: the validator verdict stands alone); ``False`` = the
+    device plugin advertises the resource but every chip is Unhealthy
+    (allocatable 0) — a host that cannot serve its slice even though its
+    validator pod passed at startup."""
+    status = node.get("status", {}) or {}
+    if consts.TPU_RESOURCE not in (status.get("capacity", {}) or {}):
+        return None
+    try:
+        alloc = (status.get("allocatable", {}) or {}).get(
+            consts.TPU_RESOURCE, "0"
+        )
+        return int(alloc) > 0
+    except (TypeError, ValueError):
+        return False
+
+
 def group_slices(tpu_nodes: List[Obj]) -> Dict[str, SliceInfo]:
     slices: Dict[str, SliceInfo] = {}
     for node in tpu_nodes:
@@ -162,10 +185,29 @@ def aggregate(
     slices = group_slices(tpu_nodes)
     cached = {n["metadata"]["name"]: n for n in tpu_nodes}
     for info in slices.values():
+        info.unhealthy_hosts = sorted(
+            n
+            for n in info.member_nodes
+            if host_allocatable_ok(cached[n]) is False
+        )
+        # a member counts only when validated AND not advertising zero
+        # allocatable chips (kubelet-derived health can sour a host long
+        # after its validator initContainer chain passed)
         info.ready_nodes = sum(
-            1 for n in info.member_nodes if n in validated
+            1
+            for n in info.member_nodes
+            if n in validated and n not in info.unhealthy_hosts
         )
         verdict = "true" if info.ready else "false"
+        was_ready = any(
+            (cached[n].get("metadata", {}).get("labels", {}) or {}).get(
+                consts.SLICE_READY_LABEL
+            )
+            == "true"
+            for n in info.member_nodes
+        )
+        if verdict == "false" and was_ready:
+            _record_degradation(client, namespace, info)
         for node_name in info.member_nodes:
             # steady-state cheap path: when the cached node already carries
             # the right verdict, skip the API round-trip entirely; only
@@ -194,3 +236,35 @@ def aggregate(
                     "failed to label node %s slice.ready=%s", node_name, verdict
                 )
     return SliceSummary(slices=slices)
+
+
+def _record_degradation(client: Client, namespace: str, info: SliceInfo) -> None:
+    """Warning Event on the true→false flip naming WHICH hosts took the
+    slice down — a v5p-64 losing one host is invisible in per-node
+    readiness; this is where the operator says so out loud."""
+    from tpu_operator import consts as c
+    from tpu_operator.kube.events import TYPE_WARNING, record_event
+
+    if info.unhealthy_hosts:
+        detail = (
+            f"host(s) {', '.join(info.unhealthy_hosts)} advertise 0 "
+            f"allocatable {c.TPU_RESOURCE}"
+        )
+    else:
+        detail = (
+            f"{info.ready_nodes} of "
+            f"{info.expected_hosts or len(info.member_nodes)} member hosts "
+            f"validated"
+        )
+    record_event(
+        client,
+        namespace,
+        {
+            "apiVersion": c.API_VERSION,
+            "kind": "ClusterPolicy",
+            "metadata": {"name": "cluster-policy"},
+        },
+        TYPE_WARNING,
+        "SliceDegraded",
+        f"slice {info.slice_id} is no longer ready: {detail}",
+    )

@@ -108,6 +108,18 @@ def pod_scope_filter(namespace: str) -> Callable[[Obj], bool]:
     return keep
 
 
+def _slim(obj: Obj) -> Obj:
+    """Deep-copy for the store minus ``metadata.managedFields`` — on a
+    real apiserver that block often outweighs the object itself, nothing
+    in the operator reads it, and controller-runtime's cache strips it
+    for the same reason (DefaultTransform)."""
+    out = copy.deepcopy(obj)
+    meta = out.get("metadata")
+    if isinstance(meta, dict):
+        meta.pop("managedFields", None)
+    return out
+
+
 def _rv_int(obj: Obj) -> Optional[int]:
     """resourceVersion as an int, or None when non-numeric.
 
@@ -184,7 +196,7 @@ class Informer:
                 if not self.synced.is_set():
                     self._tombstones[key] = _rv_int(obj) or 0
             elif etype in ("ADDED", "MODIFIED"):
-                self._store[key] = copy.deepcopy(obj)
+                self._store[key] = _slim(obj)
 
     def replace(self, objs: List[Obj]) -> None:
         """Guarded seed from an initial list. Events may already have
@@ -206,7 +218,7 @@ class Informer:
                     old_rv = _rv_int(have)
                     if old_rv is not None and rv is not None and rv < old_rv:
                         continue  # a live event already delivered newer state
-                self._store[key] = copy.deepcopy(o)
+                self._store[key] = _slim(o)
             self._tombstones.clear()
         self.synced.set()
 
@@ -243,17 +255,17 @@ class Informer:
             for key, o in fresh.items():
                 have = self._store.get(key)
                 if have is None:
-                    self._store[key] = copy.deepcopy(o)
+                    self._store[key] = _slim(o)
                     repairs.append(("ADDED", o))
                     continue
                 old_rv, new_rv = _rv_int(have), _rv_int(o)
                 if old_rv is not None and new_rv is not None:
                     if new_rv > old_rv:
-                        self._store[key] = copy.deepcopy(o)
+                        self._store[key] = _slim(o)
                         repairs.append(("MODIFIED", o))
-                elif have != o:
+                elif have != _slim(o):
                     # opaque rvs: can't order, repair on inequality
-                    self._store[key] = copy.deepcopy(o)
+                    self._store[key] = _slim(o)
                     repairs.append(("MODIFIED", o))
             for key in [k for k in self._store if k not in fresh]:
                 have = self._store[key]

@@ -39,6 +39,13 @@ CHAOS_WATCH_DROPS = int(os.environ.get("CHAOS_WATCH_DROPS", "2"))
 API_ERRORS = (ConflictError, NotFoundError, TransientAPIError, OSError)
 
 
+def _safe_event_count(client):
+    try:
+        return len(client.list("v1", "Event", NS))
+    except Exception:
+        return None
+
+
 def test_chaos_churn_then_converge():
     base = ["chaos-node-0", "chaos-node-1", "chaos-node-2"]
     # resync fast enough that an injected watch-drop heals within the
@@ -367,6 +374,11 @@ def test_chaos_churn_then_converge():
                 "watch_drops_injected": server.sim.watch_drops_injected,
                 "drift_repairs": drift_repairs,
                 "drift_at_settle": 0 if soak_ok else None,
+                # Event-store boundedness (hour-scale storms must not
+                # grow the store without bound; kubesim TTLs like a real
+                # apiserver — KUBESIM_EVENT_TTL_S tightens it for soaks)
+                "events_at_settle": _safe_event_count(client),
+                "event_ttl_s": server.sim.event_ttl_s,
                 "ok": soak_ok,
             },
         }

@@ -723,3 +723,54 @@ def test_persistently_conflicting_node_does_not_abort_pass(cluster):
     cluster.update = real_update
     assert "node-2" not in entered
     assert {"node-1", "node-3", "node-4"} <= entered
+
+
+def test_wait_for_jobs_sees_pods_outside_scoped_cache(cluster):
+    """The wait-for-jobs gate evaluates a USER selector over arbitrary
+    pods; with the scoped Pod informer (operand + TPU pods only) the
+    gate must read LIVE, or a non-TPU coordinator pod in a user
+    namespace would be invisible and the node would drain under the job
+    it shields (round-4 review finding)."""
+    from tpu_operator.kube.cache import CachedClient
+
+    cached = CachedClient(cluster, namespace=NS)
+    assert cached.start_informers() is True
+    mgr = us.ClusterUpgradeStateManager(cached, NS)
+    # a plain (non-TPU) pod in a user namespace: the scoped informer
+    # does NOT hold it...
+    cluster.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": "coordinator",
+                "namespace": "default",
+                "labels": {"app": "train-coordinator"},
+                "ownerReferences": [{"kind": "Job", "name": "j", "uid": "u"}],
+            },
+            "spec": {
+                "nodeName": "node-1",
+                "containers": [{"name": "c", "resources": {}}],
+            },
+            "status": {"phase": "Running"},
+        }
+    )
+    inf = cached._informers[("v1", "Pod")]
+    assert all(
+        o["metadata"]["name"] != "coordinator" for o in inf.list()
+    ), "scoped informer unexpectedly holds the non-TPU pod"
+    # ...but the gate still sees it and holds the node
+    policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable="100%",
+        wait_for_completion={
+            "podSelector": "app=train-coordinator",
+            "timeoutSeconds": 600,
+        },
+    )
+    pump(mgr, policy, times=4)
+    assert node_state(cluster, "node-1") == us.STATE_WAIT_FOR_JOBS_REQUIRED
+    cluster.delete("v1", "Pod", "coordinator", "default")
+    pump(mgr, policy, times=1)
+    assert node_state(cluster, "node-1") != us.STATE_WAIT_FOR_JOBS_REQUIRED

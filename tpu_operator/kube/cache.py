@@ -363,13 +363,16 @@ class CachedClient(Client):
         event — the workqueue feed rides the same streams as the cache."""
         self._hooks.append(fn)
 
-    def _dispatch(self, inf: Informer, etype: str, obj: Obj) -> None:
-        inf.on_event(etype, obj)
+    def _dispatch_hooks(self, etype: str, obj: Obj, kind: str) -> None:
         for fn in list(self._hooks):
             try:
                 fn(etype, obj)
             except Exception:
-                log.exception("cache event hook failed for %s %s", etype, inf.kind)
+                log.exception("cache event hook failed for %s %s", etype, kind)
+
+    def _dispatch(self, inf: Informer, etype: str, obj: Obj) -> None:
+        inf.on_event(etype, obj)
+        self._dispatch_hooks(etype, obj, inf.kind)
 
     def start_informers(
         self, stop_event: Optional[threading.Event] = None, timeout_s: float = 30.0
@@ -489,15 +492,7 @@ class CachedClient(Client):
                     len(repairs),
                 )
                 for etype, obj in repairs:
-                    for fn in list(self._hooks):
-                        try:
-                            fn(etype, obj)
-                        except Exception:
-                            log.exception(
-                                "resync repair hook failed for %s %s",
-                                etype,
-                                kind,
-                            )
+                    self._dispatch_hooks(etype, obj, kind)
         return total
 
     def drift_repairs_total(self) -> int:
@@ -544,6 +539,19 @@ class CachedClient(Client):
     def get_live(self, api_version, kind, name, namespace=""):
         """Bypass the cache — read-modify-write retry paths after a 409."""
         return self.live.get(api_version, kind, name, namespace)
+
+    def list_live(
+        self,
+        api_version,
+        kind,
+        namespace="",
+        label_selector=None,
+        field_selector=None,
+    ):
+        """Bypass the cache — user-selector safety gates (see Client)."""
+        return self.live.list(
+            api_version, kind, namespace, label_selector, field_selector
+        )
 
     def list(
         self,

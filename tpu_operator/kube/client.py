@@ -201,6 +201,25 @@ class Client:
         paths call this after a 409 to observe the conflicting write."""
         return self.get(api_version, kind, name, namespace)
 
+    def list_live(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str = "",
+        label_selector=None,
+        field_selector=None,
+    ) -> List[Obj]:
+        """Cache-bypassing list. On plain clients this IS ``list``; the
+        informer-backed ``CachedClient`` overrides it. Safety gates that
+        evaluate USER-authored selectors over arbitrary pods (the
+        wait-for-jobs drain shield) must use this: the scoped Pod
+        informer holds only operand + TPU pods, and a gate silently
+        narrowed to that scope would drain a node while the job it was
+        written to shield is still running."""
+        return self.list(
+            api_version, kind, namespace, label_selector, field_selector
+        )
+
     def get_or_none(
         self, api_version: str, kind: str, name: str, namespace: str = ""
     ) -> Optional[Obj]:

@@ -153,6 +153,12 @@ class KubeletDeviceManager:
         the plugin socket); devices are marked Unhealthy only when the
         endpoint is genuinely dead — an in-process connection mixup or a
         transient blip must not bury a live plugin's advertisement."""
+        # retry budget: 5 dials with exponential backoff (~6 s total) —
+        # wide enough to ride out a superseded server's shutdown guard
+        # briefly renaming the socket, and a clean stream END (no
+        # RpcError) consumes from the same budget so a plugin that keeps
+        # completing streams instantly cannot spin this thread hot
+        MAX_ATTEMPTS = 5
         attempts = 0
         while not self._stop.is_set():
             channel = self._dial(resource, endpoint, gen)
@@ -173,32 +179,30 @@ class KubeletDeviceManager:
                         }
                     self._write_node_status()
             except grpc.RpcError:
-                if self._stop.is_set():
-                    return
-                with self._lock:
-                    if self._generations.get(resource) != gen:
-                        return  # a newer registration owns this resource
-                attempts += 1
-                if attempts <= 2:
-                    self._stop.wait(0.1)
-                    continue  # re-dial: maybe the plugin is still there
-                with self._lock:
-                    if self._generations.get(resource) != gen:
-                        return
-                    log.warning(
-                        "ListAndWatch stream for %s dead after %d dials",
-                        resource,
-                        attempts,
-                    )
-                    # plugin died: the kubelet zeroes allocatable but
-                    # keeps the capacity entry until a re-registration or
-                    # restart
-                    devs = self.resources.get(resource, {})
-                    self.resources[resource] = {
-                        i: "Unhealthy" for i in devs
-                    }
-                self._write_node_status()
+                pass  # fall through to the shared retry/death logic
+            if self._stop.is_set():
                 return
+            with self._lock:
+                if self._generations.get(resource) != gen:
+                    return  # a newer registration owns this resource
+            attempts += 1
+            if attempts < MAX_ATTEMPTS:
+                self._stop.wait(0.2 * (2 ** (attempts - 1)))
+                continue  # re-dial: maybe the plugin is still there
+            with self._lock:
+                if self._generations.get(resource) != gen:
+                    return
+                log.warning(
+                    "ListAndWatch stream for %s dead after %d dials",
+                    resource,
+                    attempts,
+                )
+                # plugin died: the kubelet zeroes allocatable but keeps
+                # the capacity entry until a re-registration or restart
+                devs = self.resources.get(resource, {})
+                self.resources[resource] = {i: "Unhealthy" for i in devs}
+            self._write_node_status()
+            return
 
     def _write_node_status(self) -> None:
         with self._write_lock:

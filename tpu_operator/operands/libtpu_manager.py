@@ -73,10 +73,15 @@ def uninstall_libtpu(
         def pods_to_evict():
             # one LIST, filtered locally both ways — this runs every 2 s for
             # up to the whole drain timeout, so a second cluster-wide LIST
-            # per pass would double the API load for nothing
+            # per pass would double the API load for nothing. The USER
+            # selector half must read LIVE: the scoped Pod informer only
+            # holds TPU/operand pods, and a user selector may name others.
+            lister = (
+                pm.client.list_live if pod_selector else pm.client.list
+            )
             return [
                 pod
-                for pod in pm.client.list("v1", "Pod")
+                for pod in lister("v1", "Pod")
                 if pod.get("spec", {}).get("nodeName") == node_name
                 and (
                     pod_requests_tpu(pod)

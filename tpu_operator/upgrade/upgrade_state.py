@@ -814,7 +814,14 @@ class ClusterUpgradeStateManager:
                 selector,
             )
             return True
-        for pod in self.client.list("v1", "Pod", label_selector=selector or None):
+        # LIVE read, deliberately: the user's selector may match pods the
+        # scoped Pod informer does not hold (non-TPU coordinator /
+        # dataloader pods in user namespaces), and this gate exists to
+        # shield exactly those — the reference's upgrade lib reads its
+        # pods live and selector-scoped too (upgrade_state.go:160-212)
+        for pod in self.client.list_live(
+            "v1", "Pod", label_selector=selector or None
+        ):
             if pod.get("spec", {}).get("nodeName") == node_name and pod.get(
                 "status", {}
             ).get("phase") in ("Running", "Pending"):

@@ -513,8 +513,15 @@ def main() -> int:
             for _ in range(3)
         ]
         fa = max(fa_runs, key=lambda r: r.tflops if r.ok else -1.0)
+        # the ratio denominator must share the flash probes' chip state:
+        # the headline matmul ran minutes earlier, and using it would
+        # put chip-hour drift INSIDE the "chip-state-invariant" ratio
+        fa_matmul = run_matmul_validation(
+            size=8192, depth=8, iters=4, expect_tpu=True
+        )
     else:
         fa = run_flashattn_probe(seq=256, heads=2, block_q=128, block_k=128)
+        fa_matmul = None
 
     # HBM axis: pallas DMA copy + XLA stream pass on the same chip.
     # best-of-3: single runs vary ~±15% with chip state; the max is the
@@ -622,13 +629,17 @@ def main() -> int:
         "flashattn": {
             "ok": bool(fa.ok),
             "tflops": round(fa.tflops, 1),
-            # same-run ratio to the matmul axis: the chip-state-invariant
-            # comparator (gate round-over-round regressions on THIS, not
-            # on raw TFLOPS, which swings with tunnel/chip hour)
+            # ADJACENT-matmul ratio: the chip-state-invariant comparator
+            # (gate round-over-round regressions on THIS, not on raw
+            # TFLOPS, which swings with tunnel/chip hour); denominator
+            # measured back-to-back with the flash probes
             "vs_matmul": (
-                round(fa.tflops / res.tflops, 4)
-                if fa.ok and res.tflops
+                round(fa.tflops / fa_matmul.tflops, 4)
+                if fa.ok and fa_matmul is not None and fa_matmul.tflops
                 else None
+            ),
+            "adjacent_matmul_tflops": (
+                round(fa_matmul.tflops, 1) if fa_matmul is not None else None
             ),
             "max_err": round(fa.max_err, 5),
             "seq": fa.seq,

@@ -53,32 +53,39 @@ def _seed_bulk_pods(client, count: int, namespaces: int) -> None:
         )
 
     def mk(i):
-        client.create(
-            {
-                "apiVersion": "v1",
-                "kind": "Pod",
-                "metadata": {
-                    "name": f"bulk-{i}",
-                    "namespace": f"bulk-ns-{i % namespaces}",
-                    "labels": {"app": f"web-{i % 50}"},
-                },
-                "spec": {
-                    "nodeName": f"bulk-node-{i % 64}",
-                    "containers": [
-                        {
-                            "name": "c",
-                            "image": "nginx",
-                            "resources": {
-                                "requests": {"cpu": "100m", "memory": "128Mi"}
-                            },
-                        }
-                    ],
-                },
-                "status": {"phase": "Running"},
-            }
-        )
+        body = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"bulk-{i}",
+                "namespace": f"bulk-ns-{i % namespaces}",
+                "labels": {"app": f"web-{i % 50}"},
+            },
+            "spec": {
+                "nodeName": f"bulk-node-{i % 64}",
+                "containers": [
+                    {
+                        "name": "c",
+                        "image": "nginx",
+                        "resources": {
+                            "requests": {"cpu": "100m", "memory": "128Mi"}
+                        },
+                    }
+                ],
+            },
+            "status": {"phase": "Running"},
+        }
+        # tens of thousands of concurrent creates can reset an accept
+        # queue connection; the seeding is scaffolding, so retry briefly
+        for attempt in range(5):
+            try:
+                client.create(body)
+                return
+            except (OSError, TransientAPIError):
+                time.sleep(0.05 * (attempt + 1))
+        client.create(body)
 
-    with ThreadPoolExecutor(max_workers=16) as ex:
+    with ThreadPoolExecutor(max_workers=8) as ex:
         list(ex.map(mk, range(count)))
 
 

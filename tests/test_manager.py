@@ -215,6 +215,13 @@ def test_probe_debug_endpoints():
         mgr.client = cached
         variables = json.loads(get("/debug/vars"))
         assert variables["informer_cache"].get("Node") == 0
+        # drift repairs surface beside the store sizes (round-4: a
+        # nonzero count is the "a watch line was swallowed" tell)
+        assert variables["informer_drift_repairs"] == 0
+        inf = cached._informers[("v1", "Node")]
+        inf.drift_repairs = 3
+        variables = json.loads(get("/debug/vars"))
+        assert variables["informer_drift_repairs"] == 3
     finally:
         srv.shutdown()
         mgr.stop()

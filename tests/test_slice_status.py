@@ -212,3 +212,39 @@ def test_reconcile_status_includes_slices(env):
     for i in range(2):
         node = client.get("v1", "Node", f"n{i}")
         assert node["metadata"]["labels"][consts.SLICE_READY_LABEL] == "true"
+
+
+def test_partitioned_host_counts_as_healthy():
+    """A mixed-strategy partition stops the plain-resource plugin — the
+    kubelet zeroes google.com/tpu allocatable while capacity persists —
+    but the chips live on as subslice resources. Such a host must NOT
+    read as degraded (round-4 regression guard); only a host whose every
+    advertised TPU resource is zero-allocatable is unhealthy."""
+    from tpu_operator.controllers.slice_status import host_allocatable_ok
+
+    partitioned = {
+        "status": {
+            "capacity": {"google.com/tpu": "8", "google.com/tpu-1x2": "4"},
+            "allocatable": {"google.com/tpu": "0", "google.com/tpu-1x2": "4"},
+        }
+    }
+    assert host_allocatable_ok(partitioned) is True
+
+    dead = {
+        "status": {
+            "capacity": {"google.com/tpu": "8", "google.com/tpu-1x2": "4"},
+            "allocatable": {"google.com/tpu": "0", "google.com/tpu-1x2": "0"},
+        }
+    }
+    assert host_allocatable_ok(dead) is False
+
+    bringing_up = {"status": {"capacity": {}, "allocatable": {}}}
+    assert host_allocatable_ok(bringing_up) is None
+
+    healthy = {
+        "status": {
+            "capacity": {"google.com/tpu": "8"},
+            "allocatable": {"google.com/tpu": "8"},
+        }
+    }
+    assert host_allocatable_ok(healthy) is True

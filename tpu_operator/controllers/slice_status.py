@@ -142,21 +142,34 @@ def validator_ready_nodes(
 def host_allocatable_ok(node: Obj) -> Optional[bool]:
     """Kubelet-derived chip health for a member host — the reference's
     capacity check (``validator/main.go:1083-1161``) at slice
-    granularity. ``None`` = the TPU resource is not advertised yet
-    (bring-up: the validator verdict stands alone); ``False`` = the
-    device plugin advertises the resource but every chip is Unhealthy
-    (allocatable 0) — a host that cannot serve its slice even though its
-    validator pod passed at startup."""
+    granularity. ``None`` = no TPU resource advertised yet (bring-up:
+    the validator verdict stands alone); ``True`` = ANY TPU-prefixed
+    resource (plain chips or subslices) has nonzero allocatable;
+    ``False`` = everything advertised reads zero — a host that cannot
+    serve work even though its validator pod passed at startup.
+
+    Subslice resources count deliberately: a mixed-strategy partition
+    stops the plain-resource plugin (the kubelet then zeroes its
+    allocatable while retaining capacity), and the chips live on under
+    ``google.com/tpu-<shape>`` — that host is healthy, not degraded."""
     status = node.get("status", {}) or {}
-    if consts.TPU_RESOURCE not in (status.get("capacity", {}) or {}):
+    cap = status.get("capacity", {}) or {}
+    alloc = status.get("allocatable", {}) or {}
+    tpu_resources = [
+        k
+        for k in cap
+        if k == consts.TPU_RESOURCE
+        or k.startswith(consts.TPU_SUBSLICE_RESOURCE_PREFIX)
+    ]
+    if not tpu_resources:
         return None
-    try:
-        alloc = (status.get("allocatable", {}) or {}).get(
-            consts.TPU_RESOURCE, "0"
-        )
-        return int(alloc) > 0
-    except (TypeError, ValueError):
-        return False
+    for k in tpu_resources:
+        try:
+            if int(alloc.get(k, "0")) > 0:
+                return True
+        except (TypeError, ValueError):
+            continue
+    return False
 
 
 def group_slices(tpu_nodes: List[Obj]) -> Dict[str, SliceInfo]:

@@ -76,14 +76,21 @@ def _seed_bulk_pods(client, count: int, namespaces: int) -> None:
             "status": {"phase": "Running"},
         }
         # tens of thousands of concurrent creates can reset an accept
-        # queue connection; the seeding is scaffolding, so retry briefly
+        # queue connection; the seeding is scaffolding, so retry briefly.
+        # A 409 after a reset means the interrupted create COMMITTED
+        # server-side — that is success, not an error.
         for attempt in range(5):
             try:
                 client.create(body)
                 return
+            except ConflictError:
+                return
             except (OSError, TransientAPIError):
                 time.sleep(0.05 * (attempt + 1))
-        client.create(body)
+        try:
+            client.create(body)
+        except ConflictError:
+            pass
 
     with ThreadPoolExecutor(max_workers=8) as ex:
         list(ex.map(mk, range(count)))

@@ -598,11 +598,21 @@ def test_pod_informer_scoped_to_operator_and_tpu_pods(fake):
     names = {o["metadata"]["name"] for o in inf.list()}
     assert names == {"operand", "train"}
 
-    # cached cluster-wide list serves the scope (callers filter to TPU
-    # pods anyway); the unrelated pod is not in operator memory
+    # opt-in scoped list serves the scope (TPU-sweep callers assert
+    # their filter ⊆ scope); the unrelated pod is not in operator memory
+    assert {
+        o["metadata"]["name"] for o in cached.list_scoped("v1", "Pod")
+    } == {"operand", "train"}
+    # the PLAIN cluster-wide list cannot be silently truncated by the
+    # scope: it falls through live and stays complete
     assert {
         o["metadata"]["name"] for o in cached.list("v1", "Pod")
-    } == {"operand", "train"}
+    } == {"operand", "train", "web"}
+    # in the operator namespace the informer is authoritative: served
+    # from cache
+    assert {
+        o["metadata"]["name"] for o in cached.list("v1", "Pod", NS)
+    } == {"operand"}
 
     # a get of the filtered pod still answers from live (scoped informer
     # cannot prove absence outside its authoritative namespace)
@@ -616,3 +626,30 @@ def test_pod_informer_scoped_to_operator_and_tpu_pods(fake):
 
     # resync respects the scope: no repair-adds for filtered pods
     assert cached.resync_once() == 0
+
+
+def test_resync_does_not_resurrect_concurrently_deleted_objects():
+    """The ADDED-repair direction's symmetric guard (round-4 review):
+    an object deleted AFTER the resync LIST snapshot was cut — its
+    watch DELETED already processed — must not be re-added from the
+    stale snapshot; no further watch event would ever bury it again."""
+    inf = Informer("v1", "ConfigMap", "")
+    mk = lambda name, rv: {  # noqa: E731
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": NS, "resourceVersion": str(rv)},
+    }
+    inf.replace([mk("doomed", 5)])
+    # snapshot cut at list_rv=6 (still contains doomed@5), then the
+    # watch delivers the deletion at rv 7
+    inf.on_event("DELETED", mk("doomed", 7))
+    repairs = inf.resync([mk("doomed", 5)], list_rv=6)
+    assert repairs == [], "resync resurrected a deleted object"
+    with pytest.raises(NotFoundError):
+        inf.get("doomed", NS)
+    # a genuine re-CREATE (new rv above the deletion) does repair
+    repairs = inf.resync([mk("doomed", 9)], list_rv=10)
+    assert [(t, o["metadata"]["name"]) for t, o in repairs] == [
+        ("ADDED", "doomed")
+    ]
+    assert inf.get("doomed", NS)

@@ -280,4 +280,7 @@ def _record_degradation(client: Client, namespace: str, info: SliceInfo) -> None
         TYPE_WARNING,
         "SliceDegraded",
         f"slice {info.slice_id} is no longer ready: {detail}",
+        # one Event per slice: two slices flipping must not collapse
+        # into one record that only names the later one's hosts
+        dedup_extra=info.slice_id,
     )

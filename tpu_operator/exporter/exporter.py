@@ -169,6 +169,20 @@ class Exporter:
                     values[key] = float(chip[key])
                 else:
                     continue
+                # a provenance FLIP (same key+chip, new source) removes
+                # the superseded child BEFORE setting the new one — a
+                # scrape must never see both sources coexist, or
+                # `sum by (node, chip)` double-counts for that scrape
+                for old in [
+                    s
+                    for s in self._last_series
+                    if s[0] == key and s[1] == cid and s[2] != source
+                ]:
+                    try:
+                        self.gauges[key].remove(self.node_name, cid, old[2])
+                    except KeyError:
+                        pass
+                    self._last_series.discard(old)
                 current_series.add((key, cid, source))
                 self._last_series.add((key, cid, source))
                 self.gauges[key].labels(

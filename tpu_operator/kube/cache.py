@@ -120,6 +120,17 @@ def _slim(obj: Obj) -> Obj:
     return out
 
 
+def _monotonic() -> float:
+    import time
+
+    return time.monotonic()
+
+
+# graveyard entries only need to outlive one resync pass; keep them well
+# past any sane resync interval's LIST duration, then let resync prune
+GRAVEYARD_TTL_S = 600.0
+
+
 def _rv_int(obj: Obj) -> Optional[int]:
     """resourceVersion as an int, or None when non-numeric.
 
@@ -168,6 +179,13 @@ class Informer:
         # DELETED between list() and replace() must not be resurrected by
         # the older snapshot
         self._tombstones: Dict[Tuple[str, str], int] = {}
+        # recent deletions (key -> (rv, monotonic)) consulted by resync's
+        # ADDED-repair direction: an object deleted between the resync
+        # LIST being cut and the repair pass must not be resurrected from
+        # the stale snapshot (the delete guard has list_rv; this is its
+        # symmetric add guard). Pruned on a timer — entries only need to
+        # outlive one resync pass.
+        self._graveyard: Dict[Tuple[str, str], Tuple[Optional[int], float]] = {}
 
     # -- event ingestion -------------------------------------------------
     def on_event(self, etype: str, obj: Obj) -> None:
@@ -178,9 +196,12 @@ class Informer:
         if etype != "DELETED" and self.keep is not None and not self.keep(obj):
             # out of scope — and an in-scope object mutated OUT of scope
             # must leave the store, like a label-selector cache would drop
-            # it (fall through to the DELETED path if we hold it)
+            # it (fall through to the DELETED path if we hold it).
+            # PRE-sync the fall-through must happen even on a store miss:
+            # the DELETED path records the tombstone that stops replace()
+            # from reseeding the snapshot's stale in-scope version.
             with self._lock:
-                if key not in self._store:
+                if self.synced.is_set() and key not in self._store:
                     return
             etype = "DELETED"
         with self._lock:
@@ -193,6 +214,7 @@ class Informer:
                     return
             if etype == "DELETED":
                 self._store.pop(key, None)
+                self._graveyard[key] = (_rv_int(obj), _monotonic())
                 if not self.synced.is_set():
                     self._tombstones[key] = _rv_int(obj) or 0
             elif etype in ("ADDED", "MODIFIED"):
@@ -252,9 +274,29 @@ class Informer:
                 key = (meta.get("namespace", ""), meta.get("name", ""))
                 if key[1]:
                     fresh[key] = o
+            now = _monotonic()
+            for k in [
+                k
+                for k, (_, t) in self._graveyard.items()
+                if now - t > GRAVEYARD_TTL_S
+            ]:
+                del self._graveyard[k]
             for key, o in fresh.items():
                 have = self._store.get(key)
                 if have is None:
+                    dead = self._graveyard.get(key)
+                    if dead is not None:
+                        dead_rv, o_rv = dead[0], _rv_int(o)
+                        if (
+                            dead_rv is None
+                            or o_rv is None
+                            or o_rv <= dead_rv
+                        ):
+                            # deleted at/after this snapshot version —
+                            # re-adding it would resurrect a ghost the
+                            # watch already buried (no further event
+                            # would ever remove it again)
+                            continue
                     self._store[key] = _slim(o)
                     repairs.append(("ADDED", o))
                     continue
@@ -356,6 +398,10 @@ class CachedClient(Client):
         self._hooks: List[Callable[[str, Obj], None]] = []
         self._started = False
         self._threads: List[threading.Thread] = []
+        # one resync pass at a time: overlapping passes (background
+        # thread + an explicit caller) would widen the stale-snapshot
+        # race the graveyard guard narrows
+        self._resync_lock = threading.Lock()
 
     # -- wiring ----------------------------------------------------------
     def add_event_hook(self, fn: Callable[[str, Obj], None]) -> None:
@@ -463,9 +509,18 @@ class CachedClient(Client):
         """One repair pass over every synced informer: fresh LIST, diff,
         repair, and re-dispatch repair events through the hooks so the
         workqueue reconciles anything a swallowed watch event hid.
-        Returns the number of repairs applied."""
+        Returns the number of repairs applied. Concurrent calls coalesce
+        (the second returns 0 immediately)."""
         from tpu_operator.kube.client import NotFoundError as _NF
 
+        if not self._resync_lock.acquire(blocking=False):
+            return 0
+        try:
+            return self._resync_once_locked(stop_event, _NF)
+        finally:
+            self._resync_lock.release()
+
+    def _resync_once_locked(self, stop_event, _NF) -> int:
         total = 0
         for (av, kind), inf in self._informers.items():
             if stop_event is not None and stop_event.is_set():
@@ -561,6 +616,32 @@ class CachedClient(Client):
         label_selector=None,
         field_selector=None,
     ):
+        inf = self._informer_for(api_version, kind, namespace)
+        if inf is None:
+            return self.live.list(
+                api_version, kind, namespace, label_selector, field_selector
+            )
+        if inf.keep is not None and namespace != self.namespace:
+            # a scope-filtered informer cannot answer a general query it
+            # might hold only partially (cluster-wide or foreign-ns Pod
+            # lists would be silently truncated to TPU/operand pods);
+            # callers whose own filter ⊆ the scope opt in via
+            # list_scoped, everyone else reads live and stays correct
+            return self.live.list(
+                api_version, kind, namespace, label_selector, field_selector
+            )
+        return inf.list(namespace, label_selector, field_selector)
+
+    def list_scoped(
+        self,
+        api_version,
+        kind,
+        namespace="",
+        label_selector=None,
+        field_selector=None,
+    ):
+        """Served from the informer even when scope-filtered — the
+        caller asserts its filter ⊆ the scope (see Client.list_scoped)."""
         inf = self._informer_for(api_version, kind, namespace)
         if inf is None:
             return self.live.list(

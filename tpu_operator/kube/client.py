@@ -220,6 +220,24 @@ class Client:
             api_version, kind, namespace, label_selector, field_selector
         )
 
+    def list_scoped(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str = "",
+        label_selector=None,
+        field_selector=None,
+    ) -> List[Obj]:
+        """List that MAY be served from a scope-filtered cache. By
+        calling this the caller asserts its own filter is a subset of
+        the informer scope (operand + TPU-requesting pods) — the upgrade
+        engine's TPU-pod sweeps qualify; anything evaluating arbitrary
+        user selectors does not (use ``list_live``). On plain clients
+        this IS ``list``."""
+        return self.list(
+            api_version, kind, namespace, label_selector, field_selector
+        )
+
     def get_or_none(
         self, api_version: str, kind: str, name: str, namespace: str = ""
     ) -> Optional[Obj]:

@@ -33,8 +33,14 @@ def record_event(
     event_type: str,
     reason: str,
     message: str,
+    dedup_extra: str = "",
 ) -> None:
-    """Create-or-bump an Event (best-effort: never raises)."""
+    """Create-or-bump an Event (best-effort: never raises).
+
+    ``dedup_extra`` joins the dedup key for reasons whose messages carry
+    per-subject detail (e.g. one SliceDegraded Event PER SLICE on the
+    shared ClusterPolicy — without it a second slice's flip would
+    overwrite the first one's host list)."""
     try:
         meta = involved.get("metadata", {})
         key = hashlib.sha1(
@@ -44,6 +50,7 @@ def record_event(
                     meta.get("namespace", ""),
                     meta.get("name", ""),
                     reason,
+                    dedup_extra,
                 ]
             ).encode()
         ).hexdigest()[:12]

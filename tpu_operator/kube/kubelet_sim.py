@@ -29,6 +29,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from concurrent import futures
 from typing import Dict, Optional
 
@@ -155,22 +156,27 @@ class KubeletDeviceManager:
         transient blip must not bury a live plugin's advertisement."""
         # retry budget: 5 dials with exponential backoff (~6 s total) —
         # wide enough to ride out a superseded server's shutdown guard
-        # briefly renaming the socket, and a clean stream END (no
-        # RpcError) consumes from the same budget so a plugin that keeps
-        # completing streams instantly cannot spin this thread hot
+        # briefly renaming the socket. Both a clean stream END and an
+        # RpcError consume from the budget, and the budget only refills
+        # after a DURABLE stream (delivered a response AND lived ≥1 s):
+        # a crash-looping plugin that advertises once per dial must
+        # still run out of road and read as dead, not spin forever.
         MAX_ATTEMPTS = 5
+        DURABLE_S = 1.0
         attempts = 0
         while not self._stop.is_set():
             channel = self._dial(resource, endpoint, gen)
             if channel is None:
                 return  # superseded
             stub = grpc_glue.DevicePluginStub(channel)
+            stream_t0 = time.monotonic()
+            got_response = False
             try:
                 stub.GetDevicePluginOptions(pb2.Empty(), timeout=5)
                 for resp in stub.ListAndWatch(pb2.Empty()):
                     if self._stop.is_set():
                         return
-                    attempts = 0  # a live stream resets the death clock
+                    got_response = True
                     with self._lock:
                         if self._generations.get(resource) != gen:
                             return  # superseded by a re-registration
@@ -185,6 +191,8 @@ class KubeletDeviceManager:
             with self._lock:
                 if self._generations.get(resource) != gen:
                     return  # a newer registration owns this resource
+            if got_response and time.monotonic() - stream_t0 >= DURABLE_S:
+                attempts = 0  # the stream was real; fresh budget
             attempts += 1
             if attempts < MAX_ATTEMPTS:
                 self._stop.wait(0.2 * (2 ** (attempts - 1)))

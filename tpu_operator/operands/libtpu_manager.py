@@ -75,9 +75,10 @@ def uninstall_libtpu(
             # up to the whole drain timeout, so a second cluster-wide LIST
             # per pass would double the API load for nothing. The USER
             # selector half must read LIVE: the scoped Pod informer only
-            # holds TPU/operand pods, and a user selector may name others.
+            # holds TPU/operand pods, and a user selector may name others;
+            # the TPU-only sweep stays on the scoped cache.
             lister = (
-                pm.client.list_live if pod_selector else pm.client.list
+                pm.client.list_live if pod_selector else pm.client.list_scoped
             )
             return [
                 pod

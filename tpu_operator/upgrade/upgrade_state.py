@@ -252,7 +252,10 @@ class PodManager:
 
     def tpu_pods_on_node(self, node_name: str) -> List[Obj]:
         pods = []
-        for pod in self.client.list("v1", "Pod"):
+        # list_scoped: this sweep's own filter (TPU-requesting pods) is
+        # a subset of the Pod informer's scope, so the hot drain loop
+        # stays on the cache
+        for pod in self.client.list_scoped("v1", "Pod"):
             if pod.get("spec", {}).get("nodeName") != node_name:
                 continue
             if pod_requests_tpu(pod):

@@ -653,3 +653,139 @@ def test_resync_does_not_resurrect_concurrently_deleted_objects():
         ("ADDED", "doomed")
     ]
     assert inf.get("doomed", NS)
+
+
+def test_graveyard_pruned_on_delete_ingest_without_resync():
+    """Round-4 advisor: with the background resync disabled
+    (INFORMER_RESYNC_INTERVAL_S=0) graveyard pruning must not depend on
+    resync() running — the DELETED ingest path itself prunes TTL-expired
+    entries (time-gated), or the churny Event informer grows the dict for
+    the process lifetime."""
+    from tpu_operator.kube import cache as cache_mod
+
+    inf = Informer("v1", "Event", "")
+    inf.replace([])
+    mk = lambda name, rv: {  # noqa: E731
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {"name": name, "namespace": NS, "resourceVersion": str(rv)},
+    }
+    for i in range(50):
+        inf.on_event("DELETED", mk(f"e{i}", i + 1))
+    assert len(inf._graveyard) == 50
+    # age every entry past the TTL and open the prune gate
+    with inf._lock:
+        inf._graveyard = {
+            k: (rv, t - cache_mod.GRAVEYARD_TTL_S - 1)
+            for k, (rv, t) in inf._graveyard.items()
+        }
+        inf._graveyard_next_prune = 0.0
+    inf.on_event("DELETED", mk("fresh", 999))
+    assert set(inf._graveyard) == {(NS, "fresh")}, (
+        "DELETED ingest did not prune expired graveyard entries"
+    )
+
+
+def test_transient_notfound_resync_does_not_flush_store(fake):
+    """Round-4 advisor: a single NotFound LIST during resync (CRD
+    re-registration / discovery flap) must NOT be treated as authoritative
+    emptiness — flushing the kind's store would dispatch a DELETED storm
+    and the operator would report 'no ClusterPolicy' for a resync
+    interval. Only a consecutive streak of NotFounds flushes."""
+    client, cached = fake
+
+    class Flaky:
+        def __init__(self, inner):
+            self._inner = inner
+            self.fail_kinds = set()
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def list_with_rv(self, av, kind, ns=""):
+            if kind in self.fail_kinds:
+                raise NotFoundError(f"{kind} not served")
+            return self._inner.list_with_rv(av, kind, ns)
+
+        def list(self, av, kind, ns="", label_selector=None, field_selector=None):
+            if kind in self.fail_kinds:
+                raise NotFoundError(f"{kind} not served")
+            return self._inner.list(av, kind, ns, label_selector, field_selector)
+
+    flaky = Flaky(client)
+    cached.live = flaky
+    deleted = []
+    cached.add_event_hook(
+        lambda t, o: deleted.append(o) if t == "DELETED" else None
+    )
+    assert cached.get("v1", "ConfigMap", "cm1", NS)
+
+    # pass 1: transient 404 — store intact, no DELETED repairs dispatched
+    flaky.fail_kinds = {"ConfigMap"}
+    cached.resync_once()
+    assert cached.get("v1", "ConfigMap", "cm1", NS)
+    assert not deleted
+
+    # a successful pass in between resets the streak
+    flaky.fail_kinds = set()
+    cached.resync_once()
+    flaky.fail_kinds = {"ConfigMap"}
+    cached.resync_once()
+    assert cached.get("v1", "ConfigMap", "cm1", NS), "streak did not reset"
+
+    # a second CONSECUTIVE NotFound is authoritative: the kind is gone
+    cached.resync_once()
+    with pytest.raises(NotFoundError):
+        cached.get("v1", "ConfigMap", "cm1", NS)
+    assert any(o["metadata"]["name"] == "cm1" for o in deleted)
+
+
+def test_cached_client_stop_joins_threads():
+    """VERDICT r4 item 8: CachedClient owns its shutdown — stop() signals
+    and JOINS the informer watch threads and the resync loop, so no
+    daemon thread keeps LISTing a dead apiserver after teardown (the
+    post-suite 'resync list failed; skipping' noise)."""
+    from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+    from tpu_operator.kube.testing import seed_cluster
+
+    server = KubeSimServer(
+        KubeSim(compact_keep=64, bookmark_interval_s=0.2)
+    ).start()
+    client = make_client(server.port)
+    seed_cluster(client, NS, node_names=("s-node-1",))
+    cached = CachedClient(client, namespace=NS, resync_interval_s=0.2)
+    try:
+        assert cached.start_informers(timeout_s=30) is True
+        assert cached._threads, "informer threads expected"
+        cached.stop()
+        assert cached._threads == [], "stop() left live threads behind"
+        # resync after stop is a no-op even against a dead server
+        server.stop()
+        assert cached.resync_once() == 0
+        cached.stop()  # idempotent
+    finally:
+        server.stop()
+
+
+def test_caller_stop_event_links_into_cache_stop():
+    """A stop event passed by the caller (the manager's _stop) must stop
+    the cache's internal threads too — the linked-event contract."""
+    from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+    from tpu_operator.kube.testing import seed_cluster
+
+    server = KubeSimServer(
+        KubeSim(compact_keep=64, bookmark_interval_s=0.2)
+    ).start()
+    client = make_client(server.port)
+    seed_cluster(client, NS, node_names=("l-node-1",))
+    stop = threading.Event()
+    cached = CachedClient(client, namespace=NS, resync_interval_s=0.2)
+    try:
+        assert cached.start_informers(stop, timeout_s=30) is True
+        stop.set()
+        assert wait_until(
+            lambda: all(not t.is_alive() for t in cached._threads),
+            timeout_s=15,
+        ), "caller stop event did not propagate to cache threads"
+    finally:
+        server.stop()

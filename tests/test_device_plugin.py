@@ -3,6 +3,7 @@
 
 import os
 import threading
+import time
 from concurrent import futures
 
 import grpc
@@ -551,3 +552,94 @@ def test_preferred_allocation_must_include_outside_mesh_survives(plugin):
     resp = stub.GetPreferredAllocation(req)
     ids = [int(i) for i in resp.container_responses[0].deviceIDs]
     assert 9 in ids and len(ids) == 2 and len(set(ids)) == 2
+
+
+def test_stop_leaves_guard_when_shutdown_unconfirmed(tmp_path, dev_root):
+    """Round-4 advisor: if grpc shutdown does not CONFIRM within the wait
+    budget, the successor's socket must stay parked under the guard name —
+    restoring it while the old server's path unlink may still be in
+    flight could delete the successor's live socket. A guarded file is
+    recoverable (kubelet re-dials); a deleted socket is not."""
+    servicer = TPUDevicePluginServicer(
+        dev_root=dev_root,
+        generation="v5e",
+        host_topology="2x4",
+        cdi_enabled=True,
+        slice_env={},
+        poll_interval_s=0.2,
+    )
+    server = DevicePluginServer(
+        servicer, socket_dir=str(tmp_path / "kubelet"), socket_name="tpu.sock"
+    )
+    server.start()
+    real = server.server
+    try:
+        # a successor re-bound the fixed socket name: the path's inode is
+        # no longer ours
+        os.rename(server.socket_path, server.socket_path + ".old")
+        with open(server.socket_path, "w") as f:
+            f.write("successor")
+
+        late = threading.Event()
+
+        class HungShutdown:
+            def stop(self, grace=None):
+                class Late:
+                    def wait(self, timeout=None):
+                        if timeout is not None:
+                            return False  # not confirmed within the budget
+                        late.wait()  # deferred-restore path blocks here
+                        return True
+
+                return Late()
+
+        server.server = HungShutdown()
+        server.stop()
+        guard = server.socket_path + ".shutdown-guard"
+        assert os.path.exists(guard), "guard removed before shutdown confirmed"
+        assert not os.path.exists(server.socket_path), (
+            "successor socket restored while the old unlink may still fire"
+        )
+        with open(guard) as f:
+            assert f.read() == "successor"
+
+        # once the LATE shutdown finally completes, the deferred restore
+        # puts the successor's socket back for the kubelet's re-dial
+        late.set()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not os.path.exists(
+            server.socket_path
+        ):
+            time.sleep(0.05)
+        assert os.path.exists(server.socket_path), "deferred restore never ran"
+        with open(server.socket_path) as f:
+            assert f.read() == "successor"
+    finally:
+        real.stop(grace=0)
+
+
+def test_stop_restores_successor_socket_on_confirmed_shutdown(
+    tmp_path, dev_root
+):
+    """The happy half of the guard contract: once shutdown CONFIRMS, the
+    successor's socket file returns to its real path."""
+    servicer = TPUDevicePluginServicer(
+        dev_root=dev_root,
+        generation="v5e",
+        host_topology="2x4",
+        cdi_enabled=True,
+        slice_env={},
+        poll_interval_s=0.2,
+    )
+    server = DevicePluginServer(
+        servicer, socket_dir=str(tmp_path / "kubelet"), socket_name="tpu.sock"
+    )
+    server.start()
+    os.rename(server.socket_path, server.socket_path + ".old")
+    with open(server.socket_path, "w") as f:
+        f.write("successor")
+    server.stop()  # real shutdown: confirms within the wait budget
+    assert os.path.exists(server.socket_path)
+    with open(server.socket_path) as f:
+        assert f.read() == "successor"
+    assert not os.path.exists(server.socket_path + ".shutdown-guard")

@@ -130,6 +130,18 @@ def _monotonic() -> float:
 # past any sane resync interval's LIST duration, then let resync prune
 GRAVEYARD_TTL_S = 600.0
 
+# the DELETED ingest path also prunes (resync may be disabled with
+# INFORMER_RESYNC_INTERVAL_S=0, and the churny Event informer would then
+# grow the graveyard for the process lifetime); a time-gate amortises the
+# O(len) scan so a delete storm doesn't go quadratic
+GRAVEYARD_PRUNE_EVERY_S = 60.0
+
+# consecutive NotFound LIST passes required before resync accepts "kind
+# not served" as authoritative emptiness — a single transient 404 (CRD
+# re-registration, apiserver discovery flap) must not flush a kind's
+# store and storm the workqueue with DELETED repairs
+RESYNC_NOTFOUND_STREAK = 2
+
 
 def _rv_int(obj: Obj) -> Optional[int]:
     """resourceVersion as an int, or None when non-numeric.
@@ -186,6 +198,16 @@ class Informer:
         # symmetric add guard). Pruned on a timer — entries only need to
         # outlive one resync pass.
         self._graveyard: Dict[Tuple[str, str], Tuple[Optional[int], float]] = {}
+        self._graveyard_next_prune = 0.0
+
+    def _prune_graveyard_locked(self, now: float) -> None:
+        """TTL-expire graveyard entries; caller holds ``_lock``."""
+        for k in [
+            k
+            for k, (_, t) in self._graveyard.items()
+            if now - t > GRAVEYARD_TTL_S
+        ]:
+            del self._graveyard[k]
 
     # -- event ingestion -------------------------------------------------
     def on_event(self, etype: str, obj: Obj) -> None:
@@ -214,7 +236,11 @@ class Informer:
                     return
             if etype == "DELETED":
                 self._store.pop(key, None)
-                self._graveyard[key] = (_rv_int(obj), _monotonic())
+                now = _monotonic()
+                if now >= self._graveyard_next_prune:
+                    self._graveyard_next_prune = now + GRAVEYARD_PRUNE_EVERY_S
+                    self._prune_graveyard_locked(now)
+                self._graveyard[key] = (_rv_int(obj), now)
                 if not self.synced.is_set():
                     self._tombstones[key] = _rv_int(obj) or 0
             elif etype in ("ADDED", "MODIFIED"):
@@ -274,13 +300,7 @@ class Informer:
                 key = (meta.get("namespace", ""), meta.get("name", ""))
                 if key[1]:
                     fresh[key] = o
-            now = _monotonic()
-            for k in [
-                k
-                for k, (_, t) in self._graveyard.items()
-                if now - t > GRAVEYARD_TTL_S
-            ]:
-                del self._graveyard[k]
+            self._prune_graveyard_locked(_monotonic())
             for key, o in fresh.items():
                 have = self._store.get(key)
                 if have is None:
@@ -398,6 +418,14 @@ class CachedClient(Client):
         self._hooks: List[Callable[[str, Obj], None]] = []
         self._started = False
         self._threads: List[threading.Thread] = []
+        # owned by this cache so stop() works even when the caller never
+        # passes a stop_event (controller-runtime's manager owns its
+        # cache's shutdown the same way, /root/reference/main.go:88-108);
+        # start_informers links a caller-provided event to this one
+        self._stop_event = threading.Event()
+        # per-kind consecutive NotFound LIST passes (see
+        # RESYNC_NOTFOUND_STREAK)
+        self._notfound_streak: Dict[Tuple[str, str], int] = {}
         # one resync pass at a time: overlapping passes (background
         # thread + an explicit caller) would widen the stale-snapshot
         # race the graveyard guard narrows
@@ -429,10 +457,27 @@ class CachedClient(Client):
         if self._started:
             return True
         self._started = True
-        stop_event = stop_event or threading.Event()
+        if stop_event is not None and stop_event is not self._stop_event:
+            # all internal threads observe the OWNED event so stop() works
+            # regardless of who started us; a linker mirrors the caller's
+            # event in. It polls rather than waits forever: if the cache
+            # is stopped directly the linker must exit too, not pin the
+            # CachedClient (and every informer store) for the process
+            # lifetime. Stays off _threads — join would race the poll.
+            def _link():
+                while not stop_event.wait(1.0):
+                    if self._stop_event.is_set():
+                        return
+                self._stop_event.set()
+
+            threading.Thread(
+                target=_link, daemon=True, name="cache-stop-link"
+            ).start()
         if hasattr(self.live, "add_watcher"):
             # FakeClient: synchronous in-process events; seed then subscribe
             def fan_out(etype, obj):
+                if self._stop_event.is_set():
+                    return
                 inf = self._informers.get(
                     (obj.get("apiVersion", ""), obj.get("kind", ""))
                 )
@@ -442,7 +487,7 @@ class CachedClient(Client):
             self.live.add_watcher(fan_out)
             for (av, kind), inf in self._informers.items():
                 inf.replace(self.live.list(av, kind, inf.namespace))
-            self._start_resync_thread(stop_event)
+            self._start_resync_thread(self._stop_event)
             return True
         if not hasattr(self.live, "watch"):
             log.warning("underlying client has no watch; cache stays passthrough")
@@ -453,7 +498,7 @@ class CachedClient(Client):
                 args=(av, kind, lambda e, o, i=inf: self._dispatch(i, e, o)),
                 kwargs={
                     "namespace": inf.namespace,
-                    "stop_event": stop_event,
+                    "stop_event": self._stop_event,
                     "on_sync": inf.synced.set,
                     # rest.WATCH_WINDOW_S windows bound SILENT staleness:
                     # a watch whose server half died without closing the
@@ -466,7 +511,7 @@ class CachedClient(Client):
             )
             t.start()
             self._threads.append(t)
-        self._start_resync_thread(stop_event)
+        self._start_resync_thread(self._stop_event)
         deadline = timeout_s
         ok = True
         import time as _time
@@ -493,6 +538,25 @@ class CachedClient(Client):
         t = threading.Thread(target=loop, daemon=True, name="informer-resync")
         t.start()
         self._threads.append(t)
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        """Graceful cache shutdown: signal every informer watch loop and
+        the resync loop, then JOIN them so no thread LISTs a dead server
+        after the caller tears its fixture (or process) down —
+        controller-runtime's manager stops its cache the same way before
+        returning from Start (/root/reference/main.go:88-108). Idempotent;
+        safe to call even if start_informers never ran."""
+        self._stop_event.set()
+        deadline = _monotonic() + timeout_s
+        for t in self._threads:
+            t.join(max(0.0, deadline - _monotonic()))
+        leftover = [t.name for t in self._threads if t.is_alive()]
+        if leftover:
+            # daemon threads: they cannot outlive the process, but a
+            # watch blocked inside a socket read can outlast the join
+            # budget — report it rather than hang shutdown
+            log.warning("cache stop timed out waiting for: %s", leftover)
+        self._threads = [t for t in self._threads if t.is_alive()]
 
     def _list_live_with_rv(
         self, api_version: str, kind: str, namespace: str
@@ -521,17 +585,40 @@ class CachedClient(Client):
             self._resync_lock.release()
 
     def _resync_once_locked(self, stop_event, _NF) -> int:
+        def stopping() -> bool:
+            return self._stop_event.is_set() or (
+                stop_event is not None and stop_event.is_set()
+            )
+
         total = 0
         for (av, kind), inf in self._informers.items():
-            if stop_event is not None and stop_event.is_set():
+            if stopping():
                 return total  # shutting down: don't log list noise
             if not inf.synced.is_set():
                 continue
             try:
                 objs, list_rv = self._list_live_with_rv(av, kind, inf.namespace)
+                self._notfound_streak.pop((av, kind), None)
             except _NF:
-                objs, list_rv = [], None  # kind not served: empty is truth
+                # kind not served — but only a *streak* of NotFounds is
+                # authoritative emptiness; one transient 404 (CRD
+                # re-registration, discovery flap) must not flush the
+                # store and dispatch a DELETED storm
+                streak = self._notfound_streak.get((av, kind), 0) + 1
+                self._notfound_streak[(av, kind)] = streak
+                if streak < RESYNC_NOTFOUND_STREAK:
+                    log.warning(
+                        "resync list for %s returned NotFound (%d/%d); "
+                        "skipping until the streak confirms it",
+                        kind,
+                        streak,
+                        RESYNC_NOTFOUND_STREAK,
+                    )
+                    continue
+                objs, list_rv = [], None
             except Exception:
+                if stopping():
+                    return total  # shutdown race, not drift
                 log.warning("resync list for %s failed; skipping", kind)
                 continue
             for o in objs:

@@ -68,8 +68,15 @@ def _scaled(value, total: int) -> Optional[int]:
             import math
 
             return math.ceil(total * int(value[:-1]) / 100.0)
+        if isinstance(value, float) and value != int(value):
+            # minAvailable: 1.5 is as malformed as "10.5%" — silently
+            # truncating to 1 would weaken the budget; take the same
+            # fail-closed block path
+            return None
         return int(value)
-    except (TypeError, ValueError):
+    except (TypeError, ValueError, OverflowError):
+        # OverflowError: float('inf') budgets (YAML `.inf`) must also
+        # take the fail-closed path, not crash the evict handler
         return None
 
 

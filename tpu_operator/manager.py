@@ -356,6 +356,15 @@ class Manager:
 
     def stop(self) -> None:
         self._stop.set()
+        # graceful cache shutdown: join informer + resync threads so no
+        # loop LISTs a dead apiserver after the manager stops (the
+        # reference's manager stops its cache before Start returns,
+        # /root/reference/main.go:88-108)
+        if hasattr(self.client, "stop"):
+            try:
+                self.client.stop()
+            except Exception:
+                log.exception("cache stop failed")
 
     def run_forever(self) -> None:
         self.start()

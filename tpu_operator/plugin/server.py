@@ -464,14 +464,45 @@ class DevicePluginServer:
                 os.rename(self.socket_path, guard)
         except OSError:
             pass
+        stopped = False
+        done = None
         try:
-            self.server.stop(grace=1).wait(timeout=5)
+            done = self.server.stop(grace=1)
+            stopped = done.wait(timeout=5)
         finally:
+            # only restore the successor's socket once shutdown has
+            # CONFIRMED completion — a timed-out stop may still unlink
+            # the path after os.replace put the real file back, deleting
+            # the very socket the guard existed to protect.
             if guard is not None:
-                try:
-                    os.replace(guard, self.socket_path)
-                except OSError:
-                    pass
+                if stopped:
+                    try:
+                        os.replace(guard, self.socket_path)
+                    except OSError:
+                        pass
+                else:
+                    log.warning(
+                        "grpc shutdown did not confirm within 5s; holding "
+                        "socket guard %s until it does",
+                        guard,
+                    )
+                    if done is not None:
+                        # deferred restore: once the late shutdown (and
+                        # its unlink) finally completes, put the
+                        # successor's socket back so the kubelet's
+                        # re-dial finds it again
+                        def _restore(ev=done, g=guard, path=self.socket_path):
+                            ev.wait()
+                            try:
+                                os.replace(g, path)
+                            except OSError:
+                                pass
+
+                        threading.Thread(
+                            target=_restore,
+                            daemon=True,
+                            name="socket-guard-restore",
+                        ).start()
 
 
 def main(argv=None) -> int:

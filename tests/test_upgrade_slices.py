@@ -1,0 +1,512 @@
+"""Slice-aware disruption in the upgrade engine (round-5 redesign).
+
+The reference's upgrade library cordons, drains and budgets **per node**
+(``vendor/github.com/NVIDIA/k8s-operator-libs/pkg/upgrade/upgrade_state.go:59-110``,
+``consts.go:33-58``) — the wrong physics on a multi-host TPU slice, where
+draining one host kills the slice's workload on every host. These tests
+prove the slice is the disruption unit: batch admission, slice-counted
+budgets, irreversible-step barriers, a PDB veto on one member pinning the
+whole slice, slice-scoped validation before uncordon, and batch release.
+"""
+
+import os
+import time
+
+import pytest
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+from tests.conftest import make_tpu_node, wait_until
+from tests.test_upgrade import driver_ds, driver_pod, validator_pod, workload_pod
+from tpu_operator import consts
+from tpu_operator.api.v1.clusterpolicy_types import UpgradePolicySpec
+from tpu_operator.kube import FakeClient
+from tpu_operator.upgrade import upgrade_state as us
+
+NS = "tpu-operator"
+
+
+def slice_node(name, sid, hosts=4):
+    node = make_tpu_node(
+        name,
+        extra_labels={
+            consts.TFD_SLICE_ID_LABEL: sid,
+            consts.TFD_SLICE_HOSTS_LABEL: str(hosts),
+        },
+    )
+    node["metadata"]["labels"][
+        consts.DEPLOY_LABEL_PREFIX + consts.COMPONENT_LIBTPU
+    ] = "true"
+    return node
+
+
+MEMBERS = {
+    "slice-a": [f"a-host-{i}" for i in range(1, 5)],
+    "slice-b": [f"b-host-{i}" for i in range(1, 5)],
+}
+
+
+@pytest.fixture()
+def two_slices():
+    """2 slices × 4 hosts, every libtpu operand pod stale."""
+    client = FakeClient(
+        [{"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}]
+    )
+    for sid, names in MEMBERS.items():
+        for n in names:
+            client.create(slice_node(n, sid))
+            client.create(driver_pod(n, "stale-hash"))
+            client.create(validator_pod(n))
+    client.create(driver_ds())
+    return client
+
+
+def node_state(client, name):
+    return client.get("v1", "Node", name)["metadata"]["labels"].get(
+        consts.UPGRADE_STATE_LABEL
+    )
+
+
+def states_of(client, sid):
+    return {n: node_state(client, n) for n in MEMBERS[sid]}
+
+
+def pump(mgr, policy, times=1):
+    for _ in range(times):
+        mgr.apply_state(mgr.build_state(), policy)
+
+
+def test_build_state_groups_by_slice(two_slices):
+    mgr = us.ClusterUpgradeStateManager(two_slices, NS)
+    state = mgr.build_state()
+    assert set(state.slices) == {"slice-a", "slice-b"}
+    assert state.is_multihost("slice-a")
+    assert sorted(state.member_hosts("slice-a")) == MEMBERS["slice-a"]
+    assert state.slice_of["b-host-2"] == "slice-b"
+    groups = state.fsm_by_slice()
+    assert {sid: len(es) for sid, es in groups.items()} == {
+        "slice-a": 4,
+        "slice-b": 4,
+    }
+
+
+def test_slice_batch_admission_within_slice_budget(two_slices):
+    """maxUnavailable=50% of 2 slices = ONE slice: all four of slice-a's
+    hosts are admitted together (one outage, not four), slice-b is not
+    touched — under the reference's node arithmetic 50% of 8 nodes would
+    have admitted 4 nodes from mixed slices, wounding both."""
+    mgr = us.ClusterUpgradeStateManager(two_slices, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=2, max_unavailable="50%"
+    )
+    pump(mgr, policy, 1)
+    assert set(states_of(two_slices, "slice-a").values()) == {
+        us.STATE_CORDON_REQUIRED
+    }, states_of(two_slices, "slice-a")
+    assert set(states_of(two_slices, "slice-b").values()) == {
+        us.STATE_UPGRADE_REQUIRED
+    }, states_of(two_slices, "slice-b")
+    # the admission is announced per slice
+    events = two_slices.list("v1", "Event", NS)
+    started = [e for e in events if e.get("reason") == "SliceUpgradeStarted"]
+    assert len(started) == 1 and "slice-a" in started[0]["message"]
+
+    # slice-b stays pending while slice-a rolls, across further passes
+    pump(mgr, policy, 3)
+    assert set(states_of(two_slices, "slice-b").values()) == {
+        us.STATE_UPGRADE_REQUIRED
+    }
+
+
+def test_full_slice_roll_completes_and_b_follows_a(two_slices):
+    """The whole two-slice roll under the slice budget: slice-a's four
+    hosts move through the FSM in lockstep and return to service
+    together; slice-b enters only after slice-a completed."""
+    mgr = us.ClusterUpgradeStateManager(two_slices, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=2, max_unavailable="50%"
+    )
+    b_started_at = None
+    a_done_at = None
+    for i in range(40):
+        pump(mgr, policy, 1)
+        # the faithful-OnDelete kubelet role: recreate deleted operand
+        # pods at the new hash
+        for sid, names in MEMBERS.items():
+            for n in names:
+                if two_slices.get_or_none("v1", "Pod", f"libtpu-{n}", NS) is None:
+                    two_slices.create(driver_pod(n, "new-hash"))
+        a_states = set(states_of(two_slices, "slice-a").values())
+        b_states = set(states_of(two_slices, "slice-b").values())
+        # lockstep witness: slice-a's members are never spread across
+        # more than 2 adjacent steps (one-step-per-pass skew only)
+        assert len(a_states) <= 2, a_states
+        if a_done_at is None and a_states == {us.STATE_DONE}:
+            a_done_at = i
+        if b_started_at is None and b_states & set(us.ACTIVE_STATES):
+            b_started_at = i
+        if a_states == {us.STATE_DONE} and b_states == {us.STATE_DONE}:
+            break
+    assert states_of(two_slices, "slice-a") == {
+        n: us.STATE_DONE for n in MEMBERS["slice-a"]
+    }
+    assert states_of(two_slices, "slice-b") == {
+        n: us.STATE_DONE for n in MEMBERS["slice-b"]
+    }
+    assert a_done_at is not None and b_started_at is not None
+    assert b_started_at >= a_done_at, (
+        f"slice-b entered the roll (pass {b_started_at}) before slice-a "
+        f"completed (pass {a_done_at})"
+    )
+    # everyone schedulable again
+    for names in MEMBERS.values():
+        for n in names:
+            assert not two_slices.get("v1", "Node", n).get("spec", {}).get(
+                "unschedulable", False
+            )
+    events = two_slices.list("v1", "Event", NS)
+    completed = {
+        e["message"].split(":")[0].replace("slice ", "")
+        for e in events
+        if e.get("reason") == "SliceUpgradeCompleted"
+    }
+    assert completed == {"slice-a", "slice-b"}, completed
+
+
+def test_pdb_veto_on_one_member_pins_whole_slice(two_slices):
+    """A PDB guarding a workload pod on ONE member host vetoes that
+    host's drain — and no member of the slice advances past drain (their
+    operand restart would yank libtpu under the very workload the budget
+    protects). The veto is named in a per-slice Warning Event."""
+    two_slices.create(workload_pod("gang-0", "a-host-1"))
+    pod = two_slices.get("v1", "Pod", "gang-0", "default")
+    pod["metadata"]["labels"] = {"app": "gang"}
+    two_slices.update(pod)
+    two_slices.create(
+        {
+            "apiVersion": "policy/v1",
+            "kind": "PodDisruptionBudget",
+            "metadata": {"name": "gang-pdb", "namespace": "default"},
+            "spec": {
+                "minAvailable": 1,
+                "selector": {"matchLabels": {"app": "gang"}},
+            },
+        }
+    )
+    mgr = us.ClusterUpgradeStateManager(two_slices, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=2, max_unavailable="50%"
+    )
+    pump(mgr, policy, 8)
+    # the whole slice is pinned in drain: hosts 2-4 have nothing to
+    # drain, yet none advanced to pod-restart/validation
+    held = states_of(two_slices, "slice-a")
+    assert set(held.values()) == {us.STATE_DRAIN_REQUIRED}, held
+    assert mgr.pinned_slices == {"slice-a"}
+    # the workload survived (the budget actually protected it)
+    assert two_slices.get_or_none("v1", "Pod", "gang-0", "default") is not None
+    events = two_slices.list("v1", "Event", NS)
+    pinned = [e for e in events if e.get("reason") == "SliceUpgradePinned"]
+    assert pinned, [e.get("reason") for e in events]
+    msg = pinned[0]["message"]
+    assert "slice-a" in msg and "a-host-1" in msg and "gang-pdb" in msg, msg
+
+    # dropping the budget releases the whole slice together
+    two_slices.delete("policy/v1", "PodDisruptionBudget", "gang-pdb", "default")
+    pump(mgr, policy, 2)
+    released = states_of(two_slices, "slice-a")
+    assert set(released.values()) <= {
+        us.STATE_POD_RESTART_REQUIRED,
+        us.STATE_VALIDATION_REQUIRED,
+    }, released
+    assert mgr.pinned_slices == set()
+
+
+def test_slice_validation_gate_holds_until_every_member_validates(two_slices):
+    """Slice-scoped validation: members whose own validator passes still
+    hold in validation-required while ANY member host is unvalidated —
+    slice-ready, not node-ready (a v5p slice with 3 of 4 hosts validated
+    is 0% usable). All four then uncordon together."""
+    # drive slice-a to validation-required with host 3's validator broken
+    val3 = two_slices.get("v1", "Pod", "validator-a-host-3", NS)
+    val3["status"]["phase"] = "Pending"
+    two_slices.update(val3)
+    mgr = us.ClusterUpgradeStateManager(two_slices, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=2, max_unavailable="50%"
+    )
+    for _ in range(8):
+        pump(mgr, policy, 1)
+        for n in MEMBERS["slice-a"]:
+            if two_slices.get_or_none("v1", "Pod", f"libtpu-{n}", NS) is None:
+                two_slices.create(driver_pod(n, "new-hash"))
+    held = states_of(two_slices, "slice-a")
+    assert set(held.values()) == {us.STATE_VALIDATION_REQUIRED}, held
+    # hosts 1,2,4 validate individually — yet none uncordoned
+    for n in MEMBERS["slice-a"]:
+        assert two_slices.get("v1", "Node", n)["spec"]["unschedulable"] is True
+
+    # heal host 3's validator: the slice re-validates and releases as one
+    val3 = two_slices.get("v1", "Pod", "validator-a-host-3", NS)
+    val3["status"]["phase"] = "Running"
+    two_slices.update(val3)
+    pump(mgr, policy, 2)
+    done = states_of(two_slices, "slice-a")
+    assert set(done.values()) == {us.STATE_DONE}, done
+    for n in MEMBERS["slice-a"]:
+        assert not two_slices.get("v1", "Node", n)["spec"].get(
+            "unschedulable", False
+        )
+
+
+def test_wait_for_jobs_barrier_holds_whole_slice(two_slices):
+    """One member host still running selector-matched jobs holds EVERY
+    member at wait-for-jobs: the outage must start once, together — not
+    host-by-host while the 'waited-for' jobs die under a sibling's
+    drain."""
+    two_slices.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": "coord-0",
+                "namespace": "default",
+                "labels": {"app": "train"},
+            },
+            "spec": {"nodeName": "a-host-2", "containers": [{"name": "c"}]},
+            "status": {"phase": "Running"},
+        }
+    )
+    mgr = us.ClusterUpgradeStateManager(two_slices, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=2,
+        max_unavailable="50%",
+        wait_for_completion={"podSelector": "app=train"},
+    )
+    pump(mgr, policy, 6)
+    held = states_of(two_slices, "slice-a")
+    assert set(held.values()) == {us.STATE_WAIT_FOR_JOBS_REQUIRED}, held
+
+    # job finishes → the whole slice proceeds together (one FSM step per
+    # pass: wait → pod-deletion, then pod-deletion → drain)
+    two_slices.delete("v1", "Pod", "coord-0", "default")
+    pump(mgr, policy, 1)
+    moved = states_of(two_slices, "slice-a")
+    assert set(moved.values()) == {us.STATE_POD_DELETION_REQUIRED}, moved
+    pump(mgr, policy, 1)
+    moved = states_of(two_slices, "slice-a")
+    assert set(moved.values()) == {us.STATE_DRAIN_REQUIRED}, moved
+
+
+def test_single_host_fleet_keeps_reference_arithmetic():
+    """Nodes without slice labels are slices of one: budgets count nodes
+    exactly as the reference's per-node engine did."""
+    client = FakeClient(
+        [{"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}]
+    )
+    from tests.test_upgrade import driver_ds as _ds
+
+    for i in range(1, 5):
+        node = make_tpu_node(f"solo-{i}")
+        node["metadata"]["labels"][
+            consts.DEPLOY_LABEL_PREFIX + consts.COMPONENT_LIBTPU
+        ] = "true"
+        client.create(node)
+        client.create(driver_pod(f"solo-{i}", "stale-hash"))
+        client.create(validator_pod(f"solo-{i}"))
+    client.create(_ds())
+    mgr = us.ClusterUpgradeStateManager(client, NS)
+    state = mgr.build_state()
+    assert len(state.slices) == 4
+    assert not any(state.is_multihost(sid) for sid in state.slices)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=4, max_unavailable="50%"
+    )
+    mgr.apply_state(state, policy)
+    admitted = sum(
+        1
+        for i in range(1, 5)
+        if node_state(client, f"solo-{i}") == us.STATE_CORDON_REQUIRED
+    )
+    assert admitted == 2  # 50% of 4 single-host slices
+
+
+# ---------------------------------------------------------------------------
+# Wire e2e: 2 slices × 4 hosts over kubesim (VERDICT r4 item 1 done-criterion)
+# ---------------------------------------------------------------------------
+
+
+def test_two_slice_rolling_upgrade_over_the_wire():
+    """The full Manager runtime against kubesim: slice-a's four hosts
+    roll TOGETHER (≥3 observed simultaneously active — impossible under
+    per-node maxParallelUpgrades=2) while slice-b stays Ready; no sample
+    ever shows both slices active; slice-b starts only after every
+    slice-a member is done; per-slice Events record the roll."""
+    from tests.conftest import running_operator
+    from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+    from tpu_operator.kube.rest import TransientAPIError
+    from tpu_operator.kube.testing import edit_clusterpolicy as edit_cp
+    from tpu_operator.kube.testing import seed_cluster
+
+    all_nodes = MEMBERS["slice-a"] + MEMBERS["slice-b"]
+    server = KubeSimServer(KubeSim(bookmark_interval_s=1.0)).start()
+    client = make_client(server.port)
+    client.GET_RETRY_BACKOFF_S = 0.05
+    seed_cluster(client, NS, node_names=())
+    for sid, names in MEMBERS.items():
+        for n in names:
+            client.create(
+                make_tpu_node(
+                    n,
+                    extra_labels={
+                        consts.TFD_SLICE_ID_LABEL: sid,
+                        consts.TFD_SLICE_HOSTS_LABEL: "4",
+                    },
+                )
+            )
+
+    def upgrade_label(node):
+        return (node["metadata"].get("labels") or {}).get(
+            consts.UPGRADE_STATE_LABEL
+        )
+
+    max_active_a = [0]
+    overlap = []
+    b_before_a_done = []
+
+    def sampler(halt):
+        while not halt.is_set():
+            try:
+                nodes = {
+                    n["metadata"]["name"]: n for n in client.list("v1", "Node")
+                }
+                active = {
+                    name
+                    for name, n in nodes.items()
+                    if upgrade_label(n) in us.ACTIVE_STATES
+                }
+                a_active = [n for n in MEMBERS["slice-a"] if n in active]
+                b_active = [n for n in MEMBERS["slice-b"] if n in active]
+                max_active_a[0] = max(max_active_a[0], len(a_active))
+                if a_active and b_active:
+                    overlap.append((list(a_active), list(b_active)))
+                if b_active and any(
+                    upgrade_label(nodes[n]) != us.STATE_DONE
+                    for n in MEMBERS["slice-a"]
+                    if n in nodes
+                ):
+                    b_before_a_done.append(list(b_active))
+            except (TransientAPIError, OSError):
+                pass
+            time.sleep(0.03)
+
+    try:
+        with running_operator(client, NS, all_nodes, extra_threads=(sampler,)):
+            assert wait_until(
+                lambda: (
+                    client.get_or_none(
+                        consts.API_VERSION, "ClusterPolicy", "cluster-policy"
+                    )
+                    or {}
+                )
+                .get("status", {})
+                .get("state")
+                == "ready",
+                120,
+            ), "cluster never converged before the upgrade"
+
+            edit_cp(
+                client,
+                lambda cp: cp["spec"]["libtpu"].update(
+                    upgradePolicy={
+                        "autoUpgrade": True,
+                        "maxParallelUpgrades": 2,
+                        "maxUnavailable": "50%",
+                        "drain": {"enable": True, "timeoutSeconds": 300},
+                    },
+                    version="2026.2.0",
+                ),
+            )
+
+            def all_done():
+                return all(
+                    upgrade_label(client.get("v1", "Node", n)) == us.STATE_DONE
+                    for n in all_nodes
+                )
+
+            assert wait_until(all_done, 180), {
+                n: upgrade_label(client.get("v1", "Node", n))
+                for n in all_nodes
+                if upgrade_label(client.get("v1", "Node", n)) != us.STATE_DONE
+            }
+
+        # the slice rolled as a batch: at least 3 of slice-a's 4 hosts
+        # were active at one sampled instant (node-granular budgets with
+        # maxParallelUpgrades=2 could never exceed 2)
+        assert max_active_a[0] >= 3, (
+            f"slice-a members never rolled together (max simultaneous "
+            f"active {max_active_a[0]})"
+        )
+        assert not overlap, (
+            f"both slices were disrupted at the same instant: {overlap[:3]}"
+        )
+        assert not b_before_a_done, (
+            f"slice-b entered the roll before slice-a completed: "
+            f"{b_before_a_done[:3]}"
+        )
+        for n in all_nodes:
+            assert not client.get("v1", "Node", n).get("spec", {}).get(
+                "unschedulable", False
+            ), f"{n} left cordoned"
+        events = client.list("v1", "Event", NS)
+        reasons = {e.get("reason") for e in events}
+        assert "SliceUpgradeStarted" in reasons, sorted(reasons)
+        assert "SliceUpgradeCompleted" in reasons, sorted(reasons)
+    finally:
+        server.stop()
+
+
+def test_maintenance_on_one_member_holds_whole_slice_cordoned(two_slices):
+    """A maintenance window on ONE member at uncordon time holds the
+    WHOLE slice cordoned — releasing the siblings would advertise a
+    slice that cannot gang-schedule while host 3 is about to lose its
+    chips."""
+    mgr = us.ClusterUpgradeStateManager(two_slices, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=2, max_unavailable="50%"
+    )
+    # roll slice-a up to the uncordon step
+    for _ in range(7):
+        pump(mgr, policy, 1)
+        for n in MEMBERS["slice-a"]:
+            if two_slices.get_or_none("v1", "Pod", f"libtpu-{n}", NS) is None:
+                two_slices.create(driver_pod(n, "new-hash"))
+        if set(states_of(two_slices, "slice-a").values()) == {
+            us.STATE_UNCORDON_REQUIRED
+        }:
+            break
+    assert set(states_of(two_slices, "slice-a").values()) == {
+        us.STATE_UNCORDON_REQUIRED
+    }, states_of(two_slices, "slice-a")
+
+    node = two_slices.get("v1", "Node", "a-host-3")
+    node["metadata"]["labels"][consts.MAINTENANCE_STATE_LABEL] = "pending"
+    two_slices.update(node)
+    pump(mgr, policy, 2)
+    held = states_of(two_slices, "slice-a")
+    assert set(held.values()) == {us.STATE_UNCORDON_REQUIRED}, held
+    for n in MEMBERS["slice-a"]:
+        assert two_slices.get("v1", "Node", n)["spec"]["unschedulable"] is True
+
+    # window clears → the slice releases together
+    node = two_slices.get("v1", "Node", "a-host-3")
+    del node["metadata"]["labels"][consts.MAINTENANCE_STATE_LABEL]
+    two_slices.update(node)
+    pump(mgr, policy, 1)
+    assert set(states_of(two_slices, "slice-a").values()) == {us.STATE_DONE}
+    for n in MEMBERS["slice-a"]:
+        assert not two_slices.get("v1", "Node", n)["spec"].get(
+            "unschedulable", False
+        )

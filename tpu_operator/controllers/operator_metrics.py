@@ -87,13 +87,28 @@ class OperatorMetrics:
         self.upgrades_done = g("libtpu_upgrades_done", "Nodes at upgrade-done")
         self.upgrades_failed = g("libtpu_upgrades_failed", "Nodes at upgrade-failed")
         self.upgrades_available = g(
-            "libtpu_upgrades_available", "Nodes allowed to start upgrading now"
+            "libtpu_upgrades_available",
+            "Slices (disruption units; single-host nodes are slices of "
+            "one) the upgrade budget would admit now",
         )
         self.upgrades_pending = g(
             "libtpu_upgrades_pending", "Nodes with upgrade-required"
         )
         self.upgrades_unknown = g(
             "libtpu_upgrades_unknown", "Nodes with unknown upgrade state"
+        )
+        # slice-granular disruption (TPU-first redesign of the reference's
+        # per-node budgets): the roll admits/batches whole slices, so the
+        # in-flight/pinned truth is per slice, not per node
+        self.upgrade_slices_in_progress = g(
+            "libtpu_upgrade_slices_in_progress",
+            "Slices (disruption units) with at least one member host "
+            "mid-upgrade",
+        )
+        self.upgrade_slices_pinned = g(
+            "libtpu_upgrade_slices_pinned",
+            "Slices whose upgrade drain is pinned by a disruption-budget "
+            "veto on a member host",
         )
         # PDB-veto pressure (reference drain path
         # vendor/.../upgrade/drain_manager.go:76-89): each count is one

@@ -67,18 +67,11 @@ class UpgradeReconciler:
         m.upgrades_failed.set(state.count(us.STATE_FAILED))
         m.upgrades_pending.set(state.count(us.STATE_UPGRADE_REQUIRED))
         m.upgrades_unknown.set(state.count(us.STATE_UNKNOWN))
-        # "available" = how many pending nodes the budget would admit NOW —
-        # the same arithmetic apply_state uses, not the raw pending count
-        total = len(state.all())
-        max_unavail = us.parse_max_unavailable(pol.max_unavailable, total)
-        unavailable = in_progress + state.count(us.STATE_FAILED)
-        budget = max(
-            0,
-            min(
-                (pol.max_parallel_upgrades or 1) - in_progress,
-                max_unavail - unavailable,
-            ),
-        )
-        m.upgrades_available.set(
-            min(budget, state.count(us.STATE_UPGRADE_REQUIRED))
-        )
+        # budget arithmetic in SLICE units — slice_budget is the SAME
+        # computation apply_state admits with, so the exported "available"
+        # cannot drift from real admission
+        budget = us.slice_budget(state, pol)
+        m.upgrades_available.set(min(budget.admit, len(budget.pending_sids)))
+        if getattr(m, "upgrade_slices_in_progress", None):
+            m.upgrade_slices_in_progress.set(len(budget.active_sids))
+            m.upgrade_slices_pinned.set(len(self.manager.pinned_slices))

@@ -72,12 +72,43 @@ class NodeUpgradeState:
 @dataclass
 class ClusterUpgradeState:
     node_states: Dict[str, List[NodeUpgradeState]] = field(default_factory=dict)
+    # The DISRUPTION UNIT is the slice, not the node (TPU-first redesign
+    # of the reference's per-node arithmetic, upgrade_state.go:59-110):
+    # draining one host of a 4-host v5p slice kills the slice's workload
+    # on all four hosts, so per-node budgets multiply the blast radius
+    # (N slices wounded concurrently) while node-by-node rolls stretch
+    # one slice's outage ×N for no benefit. Every libtpu-managed node is
+    # grouped by slice membership (slice_status.group_slices); a
+    # single-host node is a slice of one, so node-pool fleets keep the
+    # reference's arithmetic exactly.
+    slices: Dict[str, object] = field(default_factory=dict)  # sid -> SliceInfo
+    slice_of: Dict[str, str] = field(default_factory=dict)  # node -> sid
 
     def all(self) -> List[NodeUpgradeState]:
         return [s for states in self.node_states.values() for s in states]
 
     def count(self, state: str) -> int:
         return len(self.node_states.get(state, []))
+
+    def fsm_by_slice(self) -> Dict[str, List[NodeUpgradeState]]:
+        """FSM-tracked nodes grouped by their disruption unit."""
+        groups: Dict[str, List[NodeUpgradeState]] = {}
+        for ns in self.all():
+            name = ns.node["metadata"]["name"]
+            groups.setdefault(self.slice_of.get(name, name), []).append(ns)
+        return groups
+
+    def is_multihost(self, sid: str) -> bool:
+        info = self.slices.get(sid)
+        return info is not None and (
+            info.expected_hosts > 1 or len(info.member_nodes) > 1
+        )
+
+    def member_hosts(self, sid: str) -> List[str]:
+        """ALL member hosts of the slice (including nodes outside the
+        FSM, e.g. skip-labeled) — slice validation spans every host."""
+        info = self.slices.get(sid)
+        return list(info.member_nodes) if info is not None else []
 
 
 class NodeStateProvider:
@@ -376,6 +407,22 @@ class ValidationManager:
             return pod.get("status", {}).get("phase") == "Running"
         return False
 
+    def running_nodes(self) -> set:
+        """Nodes with a Running validator pod, in ONE listing — the
+        slice-scoped validation loop checks every member host of every
+        validating slice per pass, and a per-host list would be
+        O(member_hosts × namespace_pods)."""
+        out = set()
+        for pod in self.client.list(
+            "v1", "Pod", self.namespace, label_selector={"app": self.APP}
+        ):
+            if pod.get("status", {}).get("phase") != "Running":
+                continue
+            node = pod.get("spec", {}).get("nodeName")
+            if node:
+                out.add(node)
+        return out
+
 
 def pod_requests_tpu(pod: Obj) -> bool:
     """reference ``gpuPodSpecFilter`` (``main.go:161-183``) for
@@ -424,6 +471,47 @@ def parse_max_unavailable(value, total: int) -> int:
 VALIDATION_TIMEOUT_S = 1800.0
 
 
+@dataclass
+class SliceBudget:
+    """The slice-unit admission arithmetic, computed ONCE and shared by
+    ``apply_state`` (what actually admits) and the controller's gauge
+    export (what reports) so the two cannot drift."""
+
+    groups: Dict[str, List[NodeUpgradeState]]
+    active_sids: set
+    failed_sids: set
+    pending_sids: set
+    admit: int  # slices the budget would admit this pass
+
+
+def slice_budget(state: ClusterUpgradeState, policy) -> SliceBudget:
+    groups = state.fsm_by_slice()
+    active = {
+        sid
+        for sid, entries in groups.items()
+        if any(e.state in ACTIVE_STATES for e in entries)
+    }
+    failed = {
+        sid
+        for sid, entries in groups.items()
+        if any(e.state == STATE_FAILED for e in entries)
+    }
+    pending = {
+        sid
+        for sid, entries in groups.items()
+        if any(e.state == STATE_UPGRADE_REQUIRED for e in entries)
+    } - active - failed
+    max_unavailable = parse_max_unavailable(policy.max_unavailable, len(groups))
+    admit = max(
+        0,
+        min(
+            (policy.max_parallel_upgrades or 1) - len(active),
+            max_unavailable - len(active | failed),
+        ),
+    )
+    return SliceBudget(groups, active, failed, pending, admit)
+
+
 class ClusterUpgradeStateManager:
     """Orchestration (reference ``upgrade_state.go:59-110,160-212``)."""
 
@@ -437,12 +525,17 @@ class ClusterUpgradeStateManager:
         self.pod_manager = PodManager(client, namespace)
         self.drain = DrainManager(client, self.pod_manager)
         self.validation = ValidationManager(client, namespace)
+        # slices whose drain is currently pinned by a PDB veto (refreshed
+        # every apply_state pass; exported as a gauge)
+        self.pinned_slices: set = set()
 
     # ------------------------------------------------------------------
     def build_state(self) -> ClusterUpgradeState:
         """Group libtpu operand pods per node; nodes whose operand pod runs a
         stale revision (hash mismatch vs the DaemonSet template) need an
         upgrade (reference ``BuildState``, ``upgrade_state.go:160-212``)."""
+        from tpu_operator.controllers.slice_status import group_slices
+
         state = ClusterUpgradeState()
         desired_hashes = self._desired_hashes()
         # one pod listing indexed by node for the whole pass: the old
@@ -450,10 +543,15 @@ class ClusterUpgradeStateManager:
         # weak #2) — harmless behind the informer cache's request count
         # but still quadratic CPU at fleet scale
         pods_by_node = self._driver_pods_by_node()
+        managed_nodes: List[Obj] = []
         for node in self.client.list("v1", "Node"):
             labels = node.get("metadata", {}).get("labels", {}) or {}
             if labels.get(consts.DEPLOY_LABEL_PREFIX + consts.COMPONENT_LIBTPU) != "true":
                 continue
+            # slice membership spans nodes the FSM skips (skip-labeled,
+            # entry-deferred): their validators still gate slice-scoped
+            # validation
+            managed_nodes.append(node)
             node_name = node["metadata"]["name"]
             pod = pods_by_node.get(node_name)
             current = self.provider.get_state(node)
@@ -512,6 +610,10 @@ class ClusterUpgradeStateManager:
                     current = STATE_UNKNOWN
             entry = NodeUpgradeState(node=node, driver_pod=pod, state=current)
             state.node_states.setdefault(current, []).append(entry)
+        state.slices = group_slices(managed_nodes)
+        for sid, info in state.slices.items():
+            for member in info.member_nodes:
+                state.slice_of[member] = sid
         return state
 
     def _desired_hashes(self) -> Dict[str, str]:
@@ -580,28 +682,63 @@ class ClusterUpgradeStateManager:
 
     # ------------------------------------------------------------------
     def apply_state(self, state: ClusterUpgradeState, policy) -> None:
-        """Advance each node's FSM one step, throttled by
-        maxParallelUpgrades/maxUnavailable (reference ``ApplyState``)."""
+        """Advance the FSM one step per pass, throttled by
+        maxParallelUpgrades/maxUnavailable counted in SLICES (reference
+        ``ApplyState`` redesigned at slice granularity): a multi-host
+        slice's member hosts are admitted as one batch, hit the
+        irreversible steps (pod deletion, drain) only after every sibling
+        arrives, advance past validation only when the WHOLE slice
+        re-validates, and uncordon together. A PDB veto on any member
+        pins the whole slice in drain. Single-host nodes are slices of
+        one, which degenerates to the reference's per-node behavior."""
         total = len(state.all())
         if total == 0:
+            self.pinned_slices = set()
             return
-        max_parallel = policy.max_parallel_upgrades or 1
-        max_unavailable = parse_max_unavailable(policy.max_unavailable, total)
-        in_progress = sum(state.count(s) for s in ACTIVE_STATES)
-        unavailable = in_progress + state.count(STATE_FAILED)
+        budget = slice_budget(state, policy)
+        groups = budget.groups
+        active_sids = budget.active_sids
 
-        # promote upgrade-required -> cordon-required within budget
-        for ns in state.node_states.get(STATE_UPGRADE_REQUIRED, []):
-            if in_progress >= max_parallel or unavailable >= max_unavailable:
+        # late-arriving pending members of a slice already mid-roll JOIN
+        # its batch (no extra budget: the slice is already disrupted)
+        for sid in sorted(active_sids):
+            for ns in groups[sid]:
+                if ns.state == STATE_UPGRADE_REQUIRED:
+                    self._node_step(
+                        ns,
+                        lambda ns: self.provider.set_state(
+                            ns.node, STATE_CORDON_REQUIRED
+                        ),
+                    )
+
+        # admission: a slice enters as ONE unit within the slice budget
+        admit = budget.admit
+        for sid in sorted(budget.pending_sids):
+            if admit <= 0:
                 break
-            if self._node_step(
-                ns,
-                lambda ns: self.provider.set_state(
-                    ns.node, STATE_CORDON_REQUIRED
-                ),
-            ):
-                in_progress += 1
-                unavailable += 1
+            pending = [
+                e for e in groups[sid] if e.state == STATE_UPGRADE_REQUIRED
+            ]
+            promoted = 0
+            for ns in pending:
+                if self._node_step(
+                    ns,
+                    lambda ns: self.provider.set_state(
+                        ns.node, STATE_CORDON_REQUIRED
+                    ),
+                ):
+                    promoted += 1
+            if promoted:
+                admit -= 1
+                if state.is_multihost(sid):
+                    self._record_slice_event(
+                        "Normal",
+                        "SliceUpgradeStarted",
+                        f"slice {sid}: {promoted} member host(s) entering "
+                        f"a coordinated libtpu upgrade roll (the slice is "
+                        f"one disruption unit)",
+                        sid,
+                    )
 
         def cordon_step(ns):
             self.cordon.cordon(ns.node["metadata"]["name"])
@@ -610,28 +747,48 @@ class ClusterUpgradeStateManager:
         for ns in state.node_states.get(STATE_CORDON_REQUIRED, []):
             self._node_step(ns, cordon_step)
 
-        for ns in state.node_states.get(STATE_WAIT_FOR_JOBS_REQUIRED, []):
-            node_name = ns.node["metadata"]["name"]
+        # wait-for-jobs: the slice's outage must begin ONCE, together —
+        # host 1 must not start killing the gang while host 2 still
+        # "waits for jobs" that are about to die anyway. No member
+        # advances until every sibling arrived AND every member's own
+        # jobs gate cleared.
+        before_wait = (STATE_UPGRADE_REQUIRED, STATE_CORDON_REQUIRED)
+        for sid, entries in sorted(groups.items()):
+            waiting_members = [
+                e for e in entries if e.state == STATE_WAIT_FOR_JOBS_REQUIRED
+            ]
+            if not waiting_members:
+                continue
+            if any(e.state in before_wait for e in entries):
+                continue  # barrier: siblings still cordoning
             waiting = policy.wait_for_completion or {}
             selector = waiting.get("podSelector", "")
-            if selector and self._jobs_running(node_name, selector):
-                # waitForCompletion.timeoutSeconds (0/absent = wait forever):
-                # when exhausted, stop waiting and move on — the upgrade has
-                # priority over stragglers (reference wait-for-jobs budget)
-                timeout = float(waiting.get("timeoutSeconds") or 0)
-                if not self._timed_out(ns.node, timeout):
-                    continue  # stay; re-evaluated next reconcile
-                log.warning(
-                    "node %s: wait-for-jobs budget (%ss) exhausted; proceeding",
-                    node_name,
-                    timeout,
+            hold = False
+            for ns in waiting_members:
+                node_name = ns.node["metadata"]["name"]
+                if selector and self._jobs_running(node_name, selector):
+                    # waitForCompletion.timeoutSeconds (0/absent = wait
+                    # forever): when exhausted, stop waiting and move on —
+                    # the upgrade has priority over stragglers
+                    timeout = float(waiting.get("timeoutSeconds") or 0)
+                    if not self._timed_out(ns.node, timeout):
+                        hold = True
+                        break
+                    log.warning(
+                        "node %s: wait-for-jobs budget (%ss) exhausted; "
+                        "proceeding",
+                        node_name,
+                        timeout,
+                    )
+            if hold:
+                continue  # re-evaluated next reconcile
+            for ns in waiting_members:
+                self._node_step(
+                    ns,
+                    lambda ns: self.provider.set_state(
+                        ns.node, STATE_POD_DELETION_REQUIRED
+                    ),
                 )
-            self._node_step(
-                ns,
-                lambda ns: self.provider.set_state(
-                    ns.node, STATE_POD_DELETION_REQUIRED
-                ),
-            )
 
         def pod_deletion_step(ns):
             # pod deletion is opt-in via upgradePolicy.podDeletion
@@ -648,34 +805,86 @@ class ClusterUpgradeStateManager:
         for ns in state.node_states.get(STATE_POD_DELETION_REQUIRED, []):
             self._node_step(ns, pod_deletion_step)
 
-        def drain_step(ns):
-            node_name = ns.node["metadata"]["name"]
-            labels = ns.node["metadata"].get("labels", {}) or {}
-            skip = labels.get(consts.UPGRADE_SKIP_DRAIN_LABEL) == "true"
-            if skip or self.drain.drain(node_name, policy.drain):
-                self.provider.set_state(ns.node, STATE_POD_RESTART_REQUIRED)
-            elif self._timed_out(ns.node, self._drain_timeout(policy)):
-                # drain could not clear the node inside its budget:
-                # terminal failure, node stays cordoned for operator
-                # intervention (clearing the state label re-enters)
-                log.error(
-                    "node %s: drain exceeded %.0fs; marking upgrade-failed",
-                    node_name,
-                    self._drain_timeout(policy),
-                )
-                self.provider.set_state(ns.node, STATE_FAILED)
+        # drain: slice-coordinated. All member drains must clear before
+        # ANY member advances; a PDB veto on one member pins the WHOLE
+        # slice (advancing the others would restart their operands under
+        # a workload the budget is still protecting).
+        before_drain = before_wait + (
+            STATE_WAIT_FOR_JOBS_REQUIRED,
+            STATE_POD_DELETION_REQUIRED,
+        )
+        pinned: set = set()
+        for sid, entries in sorted(groups.items()):
+            draining = [e for e in entries if e.state == STATE_DRAIN_REQUIRED]
+            if not draining:
+                continue
+            if any(e.state in before_drain for e in entries):
+                continue  # barrier: siblings still on the way
+            cleared: Dict[str, bool] = {}
+            vetoes: List[tuple] = []
+            for ns in draining:
+                node_name = ns.node["metadata"]["name"]
+                labels = ns.node["metadata"].get("labels", {}) or {}
+                if labels.get(consts.UPGRADE_SKIP_DRAIN_LABEL) == "true":
+                    cleared[node_name] = True
+                    continue
+                try:
+                    cleared[node_name] = self.drain.drain(
+                        node_name, policy.drain
+                    )
+                except (NotFoundError, ConflictError):
+                    cleared[node_name] = False
                 veto = self.drain.last_block_reason.get(node_name)
-                self._record_failure(
-                    ns.node,
-                    "UpgradeDrainTimeout",
-                    f"libtpu upgrade drain exceeded "
-                    f"{self._drain_timeout(policy):.0f}s; node stays cordoned "
-                    f"(clear {consts.UPGRADE_STATE_LABEL} to retry)"
-                    + (f". Last eviction veto: {veto}" if veto else ""),
-                )
-
-        for ns in state.node_states.get(STATE_DRAIN_REQUIRED, []):
-            self._node_step(ns, drain_step)
+                if veto:
+                    vetoes.append((node_name, veto))
+            if not vetoes and all(cleared.values()):
+                for ns in draining:
+                    self._node_step(
+                        ns,
+                        lambda ns: self.provider.set_state(
+                            ns.node, STATE_POD_RESTART_REQUIRED
+                        ),
+                    )
+                continue
+            if vetoes:
+                pinned.add(sid)
+                if state.is_multihost(sid):
+                    host, veto = vetoes[0]
+                    self._record_slice_event(
+                        "Warning",
+                        "SliceUpgradePinned",
+                        f"slice {sid}: upgrade roll pinned in drain — "
+                        f"eviction on member host {host} vetoed: {veto}",
+                        sid,
+                    )
+            # held: per-member drain budget discipline (terminal failure
+            # leaves the node cordoned for operator intervention)
+            for ns in draining:
+                node_name = ns.node["metadata"]["name"]
+                if self._timed_out(ns.node, self._drain_timeout(policy)):
+                    log.error(
+                        "node %s: drain exceeded %.0fs; marking "
+                        "upgrade-failed",
+                        node_name,
+                        self._drain_timeout(policy),
+                    )
+                    veto = self.drain.last_block_reason.get(node_name)
+                    self._node_step(
+                        ns,
+                        lambda ns: self.provider.set_state(
+                            ns.node, STATE_FAILED
+                        ),
+                    )
+                    self._record_failure(
+                        ns.node,
+                        "UpgradeDrainTimeout",
+                        f"libtpu upgrade drain exceeded "
+                        f"{self._drain_timeout(policy):.0f}s; node stays "
+                        f"cordoned (clear {consts.UPGRADE_STATE_LABEL} to "
+                        f"retry)"
+                        + (f". Last eviction veto: {veto}" if veto else ""),
+                    )
+        self.pinned_slices = pinned
 
         def pod_restart_step(ns):
             # delete the operand pod; the OnDelete DaemonSet restarts
@@ -690,50 +899,167 @@ class ClusterUpgradeStateManager:
         for ns in state.node_states.get(STATE_POD_RESTART_REQUIRED, []):
             self._node_step(ns, pod_restart_step)
 
-        def validation_step(ns):
-            node_name = ns.node["metadata"]["name"]
-            if self.validation.validate(node_name):
-                self._to_uncordon_or_done(ns.node)
-            elif self._timed_out(ns.node, VALIDATION_TIMEOUT_S):
+        # validation: slice-scoped. A member leaves validation only when
+        # EVERY member host of the slice validates (slice-ready, not
+        # node-ready — one unvalidated host makes a v5p slice 0% usable)
+        # and no sibling is still earlier in the roll.
+        before_validation = before_drain + (
+            STATE_DRAIN_REQUIRED,
+            STATE_POD_RESTART_REQUIRED,
+        )
+        validated_hosts: Optional[set] = None  # one listing per pass
+        for sid, entries in sorted(groups.items()):
+            validating = [
+                e for e in entries if e.state == STATE_VALIDATION_REQUIRED
+            ]
+            if not validating:
+                continue
+            if any(e.state in before_validation for e in entries):
+                # a sibling is still earlier in the roll: hold WITHOUT
+                # the timeout clock — the sibling's own step budgets
+                # (drain timeout etc.) provide the liveness, and failing
+                # a host whose validation never got to run would be a lie
+                continue
+            if validated_hosts is None:
+                validated_hosts = self.validation.running_nodes()
+            member_hosts = state.member_hosts(sid) or [
+                e.node["metadata"]["name"] for e in validating
+            ]
+            unvalidated = sorted(
+                n for n in member_hosts if n not in validated_hosts
+            )
+            if not unvalidated:
+                for ns in validating:
+                    self._node_step(
+                        ns, lambda ns: self._to_uncordon_or_done(ns.node)
+                    )
+                continue
+            for ns in validating:
+                node_name = ns.node["metadata"]["name"]
+                if not self._timed_out(ns.node, VALIDATION_TIMEOUT_S):
+                    continue
+                if node_name not in unvalidated:
+                    # this host's OWN validation passes; only the slice
+                    # gate (another member host) holds it. Failing it
+                    # would poison healthy nodes — say what blocks
+                    # instead, and keep holding.
+                    self._record_slice_event(
+                        "Warning",
+                        "UpgradeSliceValidationHeld",
+                        f"slice {sid}: member host(s) "
+                        f"{', '.join(unvalidated)} not validating "
+                        f"{VALIDATION_TIMEOUT_S:.0f}s after the upgrade; "
+                        f"validated members stay cordoned until the slice "
+                        f"re-validates",
+                        sid,
+                    )
+                    continue
                 log.error(
                     "node %s: validation not passing after %.0fs; "
                     "marking upgrade-failed",
                     node_name,
                     VALIDATION_TIMEOUT_S,
                 )
-                self.provider.set_state(ns.node, STATE_FAILED)
+                self._node_step(
+                    ns,
+                    lambda ns: self.provider.set_state(
+                        ns.node, STATE_FAILED
+                    ),
+                )
+                detail = ""
+                if state.is_multihost(sid):
+                    detail = (
+                        f" (slice {sid} member host(s) not validating: "
+                        f"{', '.join(unvalidated)})"
+                    )
                 self._record_failure(
                     ns.node,
                     "UpgradeValidationTimeout",
-                    f"libtpu validation not passing {VALIDATION_TIMEOUT_S:.0f}s "
-                    f"after upgrade; node stays cordoned "
-                    f"(clear {consts.UPGRADE_STATE_LABEL} to retry)",
+                    f"libtpu validation not passing "
+                    f"{VALIDATION_TIMEOUT_S:.0f}s after upgrade; node "
+                    f"stays cordoned (clear {consts.UPGRADE_STATE_LABEL} "
+                    f"to retry){detail}",
                 )
-
-        for ns in state.node_states.get(STATE_VALIDATION_REQUIRED, []):
-            self._node_step(ns, validation_step)
 
         def uncordon_step(ns):
             self.cordon.uncordon(ns.node["metadata"]["name"])
             self.provider.set_state(ns.node, STATE_DONE)
 
-        for ns in state.node_states.get(STATE_UNCORDON_REQUIRED, []):
-            labels = ns.node["metadata"].get("labels", {}) or {}
-            if labels.get(consts.MAINTENANCE_STATE_LABEL):
-                # an active host-maintenance window owns the cordon now:
-                # uncordoning would hand the scheduler a node about to
-                # lose its chips, and the maintenance handler (which
-                # found the node already cordoned by this FSM) will NOT
-                # uncordon at all-clear. Stay in uncordon-required; the
-                # level-triggered reconcile finishes the upgrade once the
-                # window clears.
+        # uncordon: the slice returns to the scheduler as one unit —
+        # releasing host 1 while host 3 still validates would advertise
+        # a slice that cannot gang-schedule yet.
+        for sid, entries in sorted(groups.items()):
+            uncordoning = [
+                e for e in entries if e.state == STATE_UNCORDON_REQUIRED
+            ]
+            if not uncordoning:
+                continue
+            if any(
+                e.state not in (STATE_UNCORDON_REQUIRED, STATE_DONE)
+                for e in entries
+            ):
+                # a sibling mid-roll or failed: hold the slice cordoned
+                # (a failed member means the slice cannot serve anyway;
+                # the documented recovery clears the state label)
+                continue
+            under_maintenance = [
+                ns.node["metadata"]["name"]
+                for ns in uncordoning
+                if (ns.node["metadata"].get("labels", {}) or {}).get(
+                    consts.MAINTENANCE_STATE_LABEL
+                )
+            ]
+            if under_maintenance:
+                # an active host-maintenance window owns a member's
+                # cordon now: uncordoning IT would hand the scheduler a
+                # node about to lose its chips, and uncordoning its
+                # SIBLINGS would advertise a slice that cannot
+                # gang-schedule (the same hold every other phase
+                # enforces). Stay in uncordon-required; the
+                # level-triggered reconcile releases the whole slice once
+                # the window clears (the maintenance handler, which found
+                # the nodes already cordoned by this FSM, will NOT
+                # uncordon at all-clear).
                 log.info(
-                    "node %s: deferring uncordon during host maintenance",
-                    ns.node["metadata"]["name"],
+                    "slice %s: deferring uncordon during host maintenance "
+                    "on %s",
+                    sid,
+                    ", ".join(under_maintenance),
                 )
                 continue
+            released = 0
+            for ns in uncordoning:
+                if self._node_step(ns, uncordon_step):
+                    released += 1
+            if released == len(uncordoning) and state.is_multihost(sid):
+                self._record_slice_event(
+                    "Normal",
+                    "SliceUpgradeCompleted",
+                    f"slice {sid}: all member hosts re-validated and "
+                    f"uncordoned; the slice is back in service",
+                    sid,
+                )
 
-            self._node_step(ns, uncordon_step)
+    def _record_slice_event(
+        self, event_type: str, reason: str, message: str, slice_id: str
+    ) -> None:
+        """Per-slice upgrade state on the shared ClusterPolicy (dedup per
+        slice, like SliceDegraded)."""
+        from tpu_operator.kube.events import record_event
+
+        record_event(
+            self.client,
+            self.namespace,
+            {
+                "apiVersion": consts.API_VERSION,
+                "kind": "ClusterPolicy",
+                "metadata": {"name": "cluster-policy"},
+            },
+            event_type,
+            reason,
+            message,
+            dedup_extra=slice_id,
+        )
 
     def _record_failure(self, node: Obj, reason: str, message: str) -> None:
         """Warning Event on the Node for terminal upgrade failures, so the

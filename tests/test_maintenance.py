@@ -505,3 +505,157 @@ def test_skipped_unmanaged_not_reported_as_evicted(env):
     ]
     assert msgs and all("unmanaged pod(s) left alone" in m for m in msgs)
     assert not any("evicted" in m for m in msgs)
+
+
+def test_slice_flip_on_member_maintenance(monkeypatch):
+    """Unit: a member of a 4-host slice entering maintenance proactively
+    flips tpu.slice.ready=false on EVERY member before the drain and
+    records one per-slice Event naming window + host; the all-clear
+    records the per-slice clear Event (the aggregate restores the
+    verdict)."""
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+    client = FakeClient(
+        [{"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}]
+    )
+    members = [f"s-host-{i}" for i in range(1, 5)]
+    for n in members:
+        client.create(
+            make_tpu_node(
+                n,
+                extra_labels={
+                    consts.TFD_SLICE_ID_LABEL: "slice-m",
+                    consts.TFD_SLICE_HOSTS_LABEL: "4",
+                    consts.SLICE_READY_LABEL: "true",
+                },
+            )
+        )
+    feed = {"event": "TERMINATE_ON_HOST_MAINTENANCE"}
+    handler = MaintenanceHandler(
+        client, "s-host-2", reader=lambda url: feed["event"]
+    )
+    handler.reconcile_once()
+
+    # every member flipped BEFORE the outage, not just the doomed host
+    for n in members:
+        node = client.get("v1", "Node", n)
+        assert (
+            node["metadata"]["labels"][consts.SLICE_READY_LABEL] == "false"
+        ), n
+    events = client.list("v1", "Event", NS)
+    sched = [
+        e for e in events if e.get("reason") == "SliceMaintenanceScheduled"
+    ]
+    assert len(sched) == 1, [e.get("reason") for e in events]
+    msg = sched[0]["message"]
+    assert "slice-m" in msg and "s-host-2" in msg and "TERMINATE" in msg, msg
+
+    # all-clear: per-slice clear Event recorded
+    feed["event"] = EVENT_NONE
+    handler.reconcile_once()
+    events = client.list("v1", "Event", NS)
+    cleared = [
+        e for e in events if e.get("reason") == "SliceMaintenanceCleared"
+    ]
+    assert len(cleared) == 1 and "slice-m" in cleared[0]["message"]
+
+
+def test_single_host_maintenance_does_not_touch_slice_labels(monkeypatch):
+    """A single-host node's verdict is the aggregate's alone: the handler
+    must not write slice.ready or emit slice Events for a slice of one."""
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+    client = FakeClient(
+        [{"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}]
+    )
+    client.create(make_tpu_node(NODE))
+    handler = MaintenanceHandler(
+        client, NODE, reader=lambda url: "MIGRATE_ON_HOST_MAINTENANCE"
+    )
+    handler.reconcile_once()
+    node = client.get("v1", "Node", NODE)
+    assert consts.SLICE_READY_LABEL not in node["metadata"]["labels"]
+    assert not any(
+        e.get("reason") == "SliceMaintenanceScheduled"
+        for e in client.list("v1", "Event", NS)
+    )
+
+
+def test_slice_maintenance_end_to_end_over_the_wire():
+    """VERDICT r4 item 6 done-criterion on kubesim with the full Manager:
+    4-host slice, maintenance on one host → the slice goes not-ready
+    with the window named in a per-slice Event while the operator AGREES
+    (it does not flip the verdict back while the window is open);
+    restored to ready after the all-clear."""
+    import time
+
+    from tests.conftest import running_operator, wait_until
+    from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+    from tpu_operator.kube.testing import seed_cluster
+
+    members = [f"w-host-{i}" for i in range(1, 5)]
+    server = KubeSimServer(KubeSim(bookmark_interval_s=1.0)).start()
+    client = make_client(server.port)
+    client.GET_RETRY_BACKOFF_S = 0.05
+    seed_cluster(client, NS, node_names=())
+    for n in members:
+        client.create(
+            make_tpu_node(
+                n,
+                extra_labels={
+                    consts.TFD_SLICE_ID_LABEL: "slice-w",
+                    consts.TFD_SLICE_HOSTS_LABEL: "4",
+                },
+            )
+        )
+
+    def slice_ready_labels():
+        return {
+            n: (
+                client.get("v1", "Node", n)["metadata"].get("labels", {})
+            ).get(consts.SLICE_READY_LABEL)
+            for n in members
+        }
+
+    try:
+        with running_operator(client, NS, members):
+            assert wait_until(
+                lambda: set(slice_ready_labels().values()) == {"true"}, 120
+            ), slice_ready_labels()
+
+            feed = {"event": "TERMINATE_ON_HOST_MAINTENANCE"}
+            handler = MaintenanceHandler(
+                client, members[1], reader=lambda url: feed["event"]
+            )
+            handler.reconcile_once()
+            assert wait_until(
+                lambda: set(slice_ready_labels().values()) == {"false"}, 30
+            ), slice_ready_labels()
+
+            # the operator AGREES while the window is open: the verdict
+            # must hold false across several reconcile rounds
+            held = []
+
+            def still_false():
+                held.append(set(slice_ready_labels().values()))
+                return held[-1] != {"false"}
+
+            assert not wait_until(still_false, 5), (
+                f"operator flipped the slice back mid-window: {held[-1]}"
+            )
+            events = client.list("v1", "Event", NS)
+            assert any(
+                e.get("reason") == "SliceMaintenanceScheduled"
+                and "slice-w" in e.get("message", "")
+                and members[1] in e.get("message", "")
+                for e in events
+            ), [e.get("reason") for e in events]
+
+            # all-clear → the operator restores the verdict
+            feed["event"] = EVENT_NONE
+            handler.reconcile_once()
+            assert wait_until(
+                lambda: set(slice_ready_labels().values()) == {"true"}, 60
+            ), slice_ready_labels()
+            node = client.get("v1", "Node", members[1])
+            assert not node.get("spec", {}).get("unschedulable", False)
+    finally:
+        server.stop()

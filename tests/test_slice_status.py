@@ -248,3 +248,46 @@ def test_partitioned_host_counts_as_healthy():
         }
     }
     assert host_allocatable_ok(healthy) is True
+
+
+def test_maintenance_member_flips_slice_not_ready():
+    """A member host inside an announced maintenance window counts as
+    not-ready even though its validator still Runs — the chips are about
+    to vanish, and the slice verdict flips AHEAD of the outage with the
+    window named in the degradation Event (VERDICT r4 item 6)."""
+    client = FakeClient(
+        [{"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}]
+    )
+    nodes = [multihost_node(f"n{i}", hosts=4, worker=i) for i in range(4)]
+    for n in nodes:
+        client.create(n)
+        validator_pod(client, n["metadata"]["name"], ready=True)
+    summary = slice_status.aggregate(client, NS, nodes)
+    assert summary.ready == 1
+
+    # host n2 announces a window (the maintenance handler's label)
+    node = client.get("v1", "Node", "n2")
+    node["metadata"]["labels"][consts.MAINTENANCE_STATE_LABEL] = "pending"
+    client.update(node)
+    nodes = [client.get("v1", "Node", f"n{i}") for i in range(4)]
+    summary = slice_status.aggregate(client, NS, nodes)
+    assert summary.ready == 0 and summary.degraded == ["pool-a"]
+    info = summary.slices["pool-a"]
+    assert info.maintenance_hosts == ["n2"]
+    events = client.list("v1", "Event", NS)
+    degraded = [e for e in events if e.get("reason") == "SliceDegraded"]
+    assert degraded and "maintenance window" in degraded[0]["message"], [
+        e.get("message") for e in events
+    ]
+    assert "n2" in degraded[0]["message"]
+
+    # window ends -> verdict restored
+    node = client.get("v1", "Node", "n2")
+    del node["metadata"]["labels"][consts.MAINTENANCE_STATE_LABEL]
+    client.update(node)
+    nodes = [client.get("v1", "Node", f"n{i}") for i in range(4)]
+    summary = slice_status.aggregate(client, NS, nodes)
+    assert summary.ready == 1
+    for i in range(4):
+        n = client.get("v1", "Node", f"n{i}")
+        assert n["metadata"]["labels"][consts.SLICE_READY_LABEL] == "true"

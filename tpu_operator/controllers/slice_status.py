@@ -49,6 +49,11 @@ class SliceInfo:
     # members advertising the TPU resource with ZERO allocatable chips —
     # the per-host reason a slice is down, named in the degradation Event
     unhealthy_hosts: List[str] = field(default_factory=list)
+    # members inside an announced host-maintenance window: the host is
+    # ABOUT to lose its chips, so the slice verdict flips ahead of the
+    # outage (multi-host jobs drain once, proactively — not when the
+    # kubelet finally reports dead chips)
+    maintenance_hosts: List[str] = field(default_factory=list)
 
     @property
     def ready(self) -> bool:
@@ -203,13 +208,23 @@ def aggregate(
             for n in info.member_nodes
             if host_allocatable_ok(cached[n]) is False
         )
+        info.maintenance_hosts = sorted(
+            n
+            for n in info.member_nodes
+            if (
+                cached[n].get("metadata", {}).get("labels", {}) or {}
+            ).get(consts.MAINTENANCE_STATE_LABEL)
+        )
         # a member counts only when validated AND not advertising zero
         # allocatable chips (kubelet-derived health can sour a host long
-        # after its validator initContainer chain passed)
+        # after its validator initContainer chain passed) AND not inside
+        # a maintenance window (the chips are about to vanish)
         info.ready_nodes = sum(
             1
             for n in info.member_nodes
-            if n in validated and n not in info.unhealthy_hosts
+            if n in validated
+            and n not in info.unhealthy_hosts
+            and n not in info.maintenance_hosts
         )
         verdict = "true" if info.ready else "false"
         was_ready = any(
@@ -258,7 +273,12 @@ def _record_degradation(client: Client, namespace: str, info: SliceInfo) -> None
     from tpu_operator import consts as c
     from tpu_operator.kube.events import TYPE_WARNING, record_event
 
-    if info.unhealthy_hosts:
+    if info.maintenance_hosts:
+        detail = (
+            f"host(s) {', '.join(info.maintenance_hosts)} are inside a "
+            f"scheduled host-maintenance window"
+        )
+    elif info.unhealthy_hosts:
         detail = (
             f"host(s) {', '.join(info.unhealthy_hosts)} advertise 0 "
             f"allocatable {c.TPU_RESOURCE}"

@@ -156,6 +156,11 @@ class MaintenanceHandler:
             return changed
 
         self._mutate_node(mutate)
+        # flip the WHOLE slice's verdict BEFORE the drain: every other
+        # member host is about to become 0% useful too, and a multi-host
+        # job gated on tpu.slice.ready should drain ONCE, ahead of the
+        # window — not four times as each host's drain lands
+        self._flip_slice_ready(event)
         action = self._evict_sweep()
         from tpu_operator.kube.events import TYPE_WARNING
 
@@ -164,6 +169,71 @@ class MaintenanceHandler:
             "HostMaintenanceImminent",
             f"{event}: {action} ahead of host maintenance",
         )
+
+    def _slice_members(self):
+        """This node's slice id and its member nodes (empty for
+        single-host slices, whose verdict the aggregate owns alone)."""
+        from tpu_operator.controllers.slice_status import slice_id_for_node
+
+        node = self.client.get("v1", "Node", self.node_name)
+        sid = slice_id_for_node(node)
+        members = [
+            n
+            for n in self.client.list("v1", "Node")
+            if slice_id_for_node(n) == sid
+        ]
+        if len(members) <= 1:
+            return sid, []
+        return sid, members
+
+    def _flip_slice_ready(self, event: str) -> None:
+        """Proactive slice-verdict flip + ONE per-slice Event naming the
+        window and the host. The operator's aggregate independently
+        counts maintenance-labeled members as not-ready, so a reconcile
+        racing this write agrees rather than flipping the verdict back;
+        best-effort — never blocks the drain."""
+        from tpu_operator.kube.client import mutate_with_retry
+        from tpu_operator.kube.events import TYPE_WARNING, record_event
+
+        try:
+            sid, members = self._slice_members()
+            if not members:
+                return
+            for member in members:
+                name = member["metadata"]["name"]
+
+                def mutate(node):
+                    labels = node["metadata"].setdefault("labels", {})
+                    if labels.get(consts.SLICE_READY_LABEL) == "false":
+                        return False
+                    labels[consts.SLICE_READY_LABEL] = "false"
+                    return True
+
+                try:
+                    mutate_with_retry(
+                        self.client, "v1", "Node", name, mutate=mutate
+                    )
+                except Exception:
+                    log.exception(
+                        "failed to flip slice.ready on member %s", name
+                    )
+            record_event(
+                self.client,
+                os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "default"),
+                {
+                    "apiVersion": consts.API_VERSION,
+                    "kind": "ClusterPolicy",
+                    "metadata": {"name": "cluster-policy"},
+                },
+                TYPE_WARNING,
+                "SliceMaintenanceScheduled",
+                f"slice {sid}: member host {self.node_name} has a "
+                f"scheduled host-maintenance window ({event}); slice "
+                f"marked not-ready ahead of the drain",
+                dedup_extra=sid,
+            )
+        except Exception:
+            log.exception("proactive slice flip failed; drain proceeds")
 
     def _evict_sweep(self) -> str:
         """One eviction pass over the node's TPU pods; returns the
@@ -297,6 +367,31 @@ class MaintenanceHandler:
             "HostMaintenanceCleared",
             "maintenance window cleared; node restored" + detail,
         )
+        # per-slice all-clear: the aggregate restores tpu.slice.ready on
+        # its next pass (the label diff re-triggers it); the Event tells
+        # the multi-host story in one line
+        try:
+            from tpu_operator.kube.events import record_event
+
+            sid, members = self._slice_members()
+            if members:
+                record_event(
+                    self.client,
+                    os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "default"),
+                    {
+                        "apiVersion": consts.API_VERSION,
+                        "kind": "ClusterPolicy",
+                        "metadata": {"name": "cluster-policy"},
+                    },
+                    TYPE_NORMAL,
+                    "SliceMaintenanceCleared",
+                    f"slice {sid}: the maintenance window on member host "
+                    f"{self.node_name} ended; the slice verdict is "
+                    f"restored by the next readiness pass",
+                    dedup_extra=sid,
+                )
+        except Exception:
+            log.exception("slice maintenance-clear event failed")
 
     # -- the loop --------------------------------------------------------
     def reconcile_once(self) -> Optional[str]:

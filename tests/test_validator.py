@@ -418,3 +418,56 @@ def test_workload_pod_names_are_per_node():
 
             assert re.fullmatch(r"[a-z0-9]([a-z0-9-]*[a-z0-9])?", name), name
             assert pod["metadata"]["labels"]["app"] == name
+
+
+def test_slice_workload_single_host_gang_of_one(status):
+    """A single-host node degenerates to a gang of one: the component
+    spawns one gated pod and writes the slice-scoped status file."""
+    client = FakeClient(
+        [
+            {
+                "apiVersion": "v1",
+                "kind": "Namespace",
+                "metadata": {"name": "tpu-operator"},
+            },
+            make_node("solo-1", {consts.TPU_RESOURCE: "4"}),
+        ]
+    )
+
+    def kubelet():
+        import time as _t
+
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline:
+            for pod in client.list("v1", "Pod", "tpu-operator"):
+                if pod["metadata"]["name"].startswith("tpu-slice-gang"):
+                    pod["status"] = {"phase": "Succeeded"}
+                    client.update_status(pod)
+                    return
+            _t.sleep(0.02)
+
+    t = threading.Thread(target=kubelet, daemon=True)
+    t.start()
+    info = comp.validate_slice_workload(
+        status, client, "solo-1", "tpu-operator", retries=50, sleep_s=0.1
+    )
+    assert info["result"] == "Succeeded"
+    assert info["hosts"] == ["solo-1"]
+    assert info["role"] == "leader"
+    assert status.exists(consts.STATUS_FILE_SLICE_WORKLOAD)
+    # the gang pod carried the gate and the coordination env even at N=1
+    pods = [
+        p
+        for p in client.list("v1", "Pod", "tpu-operator")
+        if p["metadata"]["name"].startswith("tpu-slice-gang")
+    ]
+    assert len(pods) == 1
+    sel = pods[0]["spec"]["nodeSelector"]
+    assert sel[consts.SLICE_READY_LABEL] == "true"
+    env = {e["name"]: e["value"] for e in pods[0]["spec"]["containers"][0]["env"]}
+    assert env["TPU_SLICE_HOSTS"] == "1" and env["TPU_WORKER_ID"] == "0"
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"].endswith(":8476")
+    # chips sized from the node's capacity
+    assert pods[0]["spec"]["containers"][0]["resources"]["limits"][
+        consts.TPU_RESOURCE
+    ] == "4"

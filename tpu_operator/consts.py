@@ -168,6 +168,7 @@ STATUS_FILE_RUNTIME = "runtime-ready"
 STATUS_FILE_PLUGIN = "plugin-ready"
 STATUS_FILE_JAX = "jax-ready"
 STATUS_FILE_SLICE = "slice-ready"
+STATUS_FILE_SLICE_WORKLOAD = "slice-workload-ready"
 # diagnostic probes (opt-in / on-demand): surfaced by the node-status
 # exporter as tpu_validator_probe_ready{probe=...}
 PROBE_STATUS_FILES = (

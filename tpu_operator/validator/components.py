@@ -301,6 +301,80 @@ def validate_slice(
 
 
 # ---------------------------------------------------------------------------
+# slice-workload component (N-pod gang acceptance across member hosts)
+# ---------------------------------------------------------------------------
+
+
+def validate_slice_workload(
+    status: StatusFiles,
+    client,
+    node_name: str,
+    namespace: str,
+    retries: int = 60,
+    sleep_s: float = 5.0,
+) -> dict:
+    """Coordinated multi-host acceptance: ONE pod per member host of this
+    node's slice — gated on ``tpu.slice.ready``, worker ordinal +
+    coordinator env injected — all N must succeed before the slice-scoped
+    status file is written. The reference validates per node with a single
+    workload pod (``/root/reference/validator/main.go:931-1015``); a
+    multi-host slice's actual acceptance test is the gang.
+
+    Worker 0 (lowest TFD worker-id, name-ordered fallback) spawns the
+    gang; every other member WAITS on the same pods, so all N validators
+    converge on one verdict instead of racing N gangs. Single-host slices
+    degenerate to a gang of one."""
+    if client is None:
+        raise ValidationError("slice-workload validation needs a k8s client")
+    from tpu_operator.controllers.slice_status import slice_id_for_node
+    from tpu_operator.validator import workload_pods
+
+    node = client.get("v1", "Node", node_name)
+    sid = slice_id_for_node(node)
+    members_nodes = [
+        n
+        for n in client.list("v1", "Node")
+        if slice_id_for_node(n) == sid
+    ]
+
+    def ordinal(n):
+        labels = n["metadata"].get("labels", {}) or {}
+        wid = labels.get(consts.TFD_WORKER_ID_LABEL, "")
+        try:
+            return (0, int(wid), n["metadata"]["name"])
+        except (TypeError, ValueError):
+            return (1, 0, n["metadata"]["name"])
+
+    members_nodes.sort(key=ordinal)
+    members = []
+    for n in members_nodes:
+        chips = (n.get("status", {}).get("capacity", {}) or {}).get(
+            consts.TPU_RESOURCE, "1"
+        )
+        members.append((n["metadata"]["name"], str(chips or "1")))
+    if not members:
+        raise ValidationError(
+            f"node {node_name}: no member nodes found for slice {sid}"
+        )
+    leader = members[0][0] == node_name
+    try:
+        info = workload_pods.run_slice_gang(
+            client,
+            namespace,
+            sid,
+            members,
+            spawn=leader,
+            retries=retries,
+            sleep_s=sleep_s,
+        )
+    except RuntimeError as e:
+        raise ValidationError(str(e))
+    info["role"] = "leader" if leader else "follower"
+    status.write(consts.STATUS_FILE_SLICE_WORKLOAD, info)
+    return info
+
+
+# ---------------------------------------------------------------------------
 # ici component (ring probe: per-link health + bandwidth)
 # ---------------------------------------------------------------------------
 

@@ -23,6 +23,7 @@ COMPONENTS = (
     "plugin",
     "jax",
     "slice",
+    "slice-workload",
     "ici",
     "ringattn",
     "pipeline",
@@ -210,6 +211,13 @@ def main(argv=None) -> int:
         elif args.component == "slice":
             info = comp.validate_slice(
                 status, expect_devices=args.expect_devices
+            )
+        elif args.component == "slice-workload":
+            info = comp.validate_slice_workload(
+                status,
+                make_client(),
+                args.node_name,
+                namespace=args.namespace,
             )
         elif args.component == "ici":
             info = comp.validate_ici(

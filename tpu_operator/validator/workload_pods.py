@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import time
+from typing import Optional
 
 from tpu_operator import consts
 
@@ -136,6 +137,189 @@ def plugin_workload_pod(
         namespace,
         PLUGIN_SMOKE_SCRIPT,
         image,
+    )
+
+
+# Coordinated multi-host startup proof: every gang member initializes the
+# JAX distributed runtime off the injected coordination env and allgathers
+# across processes — one host failing to join hangs/fails EVERY member,
+# which is exactly the acceptance semantics of a multi-host slice
+# (reference validator/main.go:931-1015 at gang scale).
+SLICE_GANG_SCRIPT = (
+    "import os, jax; "
+    "jax.distributed.initialize(); "
+    "import jax.numpy as jnp; "
+    "from jax.experimental.multihost_utils import process_allgather; "
+    "g = process_allgather(jnp.ones((4,))); "
+    "want = int(os.environ.get('TPU_SLICE_HOSTS', '1')); "
+    "assert jax.process_count() == want, (jax.process_count(), want); "
+    "print('slice gang OK:', jax.process_index(), '/', jax.process_count())"
+)
+
+GANG_PORT = 8476  # the JAX coordination-service port
+
+
+def gang_name(slice_id: str) -> str:
+    return _per_node_name("tpu-slice-gang", slice_id)
+
+
+def gang_service(slice_id: str, namespace: str) -> dict:
+    """Headless Service giving gang pods stable DNS (the coordinator
+    address must resolve before any pod has an IP)."""
+    name = gang_name(slice_id)
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "clusterIP": "None",
+            "selector": {"app": name},
+            "ports": [{"name": "coordinator", "port": GANG_PORT}],
+        },
+    }
+
+
+def slice_gang_pod(
+    slice_id: str,
+    node_name: str,
+    namespace: str,
+    ordinal: int,
+    num_hosts: int,
+    chips: str = "1",
+    image: str = "",
+    extra_env: Optional[dict] = None,
+) -> dict:
+    """One gang member pod, GATED on ``tpu.slice.ready`` via nodeSelector
+    (the scheduler refuses the pod while the slice verdict is false —
+    the label bus is the gate, same as user multi-host jobs), pinned to
+    its member host by hostname, with worker ordinal + coordinator env
+    injected (the MEGASCALE pattern ``plugin/server.py::slice_env_from_node_labels``)."""
+    import os
+
+    name = gang_name(slice_id)
+    image = image or os.environ.get(
+        "JAX_WORKLOAD_IMAGE", consts.DEFAULT_JAX_WORKLOAD_IMAGE
+    )
+    hostnames = ",".join(
+        f"{name}-{i}.{name}.{namespace}" for i in range(num_hosts)
+    )
+    env = {
+        "TPU_WORKER_ID": str(ordinal),
+        "TPU_SLICE_HOSTS": str(num_hosts),
+        "TPU_WORKER_HOSTNAMES": hostnames,
+        "MEGASCALE_COORDINATOR_ADDRESS": (
+            f"{name}-0.{name}.{namespace}:{GANG_PORT}"
+        ),
+        # jax.distributed.initialize() picks these up directly
+        "JAX_COORDINATOR_ADDRESS": f"{name}-0.{name}.{namespace}:{GANG_PORT}",
+        "JAX_NUM_PROCESSES": str(num_hosts),
+        "JAX_PROCESS_ID": str(ordinal),
+    }
+    env.update(extra_env or {})
+    pod = _workload_pod(
+        f"{name}-{ordinal}", node_name, namespace, SLICE_GANG_SCRIPT, image
+    )
+    pod["metadata"]["labels"]["app"] = name
+    spec = pod["spec"]
+    # the slice-ready GATE: schedule via selector, not nodeName — a
+    # nodeName pin would bypass the scheduler and with it the gate
+    del spec["nodeName"]
+    spec["nodeSelector"] = {
+        "kubernetes.io/hostname": node_name,
+        consts.SLICE_READY_LABEL: "true",
+    }
+    spec["hostname"] = f"{name}-{ordinal}"
+    spec["subdomain"] = name
+    ctr = spec["containers"][0]
+    ctr["name"] = "gang"
+    ctr["env"] = [{"name": k, "value": v} for k, v in sorted(env.items())]
+    ctr["resources"] = {
+        "limits": {consts.TPU_RESOURCE: chips},
+        "requests": {consts.TPU_RESOURCE: chips},
+    }
+    return pod
+
+
+def run_slice_gang(
+    client,
+    namespace: str,
+    slice_id: str,
+    members,
+    spawn: bool = True,
+    image: str = "",
+    retries: int = POLL_RETRIES,
+    sleep_s: float = POLL_SLEEP_S,
+) -> dict:
+    """Spawn (leader) or observe (followers) one gang pod per member
+    host and wait for ALL to succeed. ``members`` is the ordered list of
+    ``(node_name, chips)`` pairs; failure names every host whose pod did
+    not make it — a member that cannot schedule is named with its phase
+    so the operator can see WHICH host holds the slice back."""
+    name = gang_name(slice_id)
+    pods = [
+        slice_gang_pod(
+            slice_id,
+            node,
+            namespace,
+            ordinal,
+            len(members),
+            chips=chips,
+            image=image,
+        )
+        for ordinal, (node, chips) in enumerate(members)
+    ]
+    host_of = {p["metadata"]["name"]: p["spec"]["nodeSelector"][
+        "kubernetes.io/hostname"
+    ] for p in pods}
+    if spawn:
+        svc = gang_service(slice_id, namespace)
+        set_owner_daemonset(client, svc, namespace, "tpu-operator-validator")
+        client.delete_if_exists("v1", "Service", name, namespace)
+        client.create(svc)
+        for pod in pods:
+            client.delete_if_exists(
+                "v1", "Pod", pod["metadata"]["name"], namespace
+            )
+            set_owner_daemonset(client, pod, namespace, "tpu-operator-validator")
+            client.create(pod)
+    phases: dict = {}
+    for _ in range(retries):
+        phases = {}
+        for pod in pods:
+            live = client.get_or_none(
+                "v1", "Pod", pod["metadata"]["name"], namespace
+            )
+            if live is None:
+                phases[pod["metadata"]["name"]] = (
+                    "Missing" if spawn else "NotCreated"
+                )
+                continue
+            phase = live.get("status", {}).get("phase", "Pending")
+            if phase == "Pending" and not live.get("spec", {}).get("nodeName"):
+                phase = "Unschedulable"
+            phases[pod["metadata"]["name"]] = phase
+        if all(p == "Succeeded" for p in phases.values()):
+            return {
+                "slice": slice_id,
+                "hosts": [n for n, _ in members],
+                "gang": name,
+                "result": "Succeeded",
+            }
+        if any(p == "Failed" for p in phases.values()):
+            break
+        time.sleep(sleep_s)
+    stragglers = "; ".join(
+        f"member host {host_of[pname]}: pod {pname} {phase}"
+        + (
+            " (slice gate tpu.slice.ready or cordon is refusing it)"
+            if phase == "Unschedulable"
+            else ""
+        )
+        for pname, phase in sorted(phases.items())
+        if phase != "Succeeded"
+    )
+    raise RuntimeError(
+        f"slice {slice_id} gang validation did not complete: {stragglers}"
     )
 
 

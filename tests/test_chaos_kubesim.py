@@ -38,12 +38,82 @@ CHAOS_WATCH_DROPS = int(os.environ.get("CHAOS_WATCH_DROPS", "2"))
 
 API_ERRORS = (ConflictError, NotFoundError, TransientAPIError, OSError)
 
+# multi-host slice wing (round-5: storm the slice loop): one 4-host slice
+# rides the same storm — 2 members with REAL gRPC kubelet rigs consuming
+# shipped DevicePluginServers (plugin kills included), 2 simulated
+SLICE_ID = "storm-slice"
+SLICE_MEMBERS = tuple(f"slice-storm-{i}" for i in range(4))
+RIG_MEMBERS = SLICE_MEMBERS[:2]
+
 
 def _safe_event_count(client):
     try:
         return len(client.list("v1", "Event", NS))
     except Exception:
         return None
+
+
+def _slice_member_features(client, name, worker_id, dev_root):
+    """Canonical TFD labels for a slice member, computed by the REAL
+    feature discovery (the same production path the 4-rig e2e drives)."""
+    from tpu_operator.discovery import tfd
+
+    node = client.get("v1", "Node", name)
+    feats = tfd.gather_features(
+        node,
+        dev_root=dev_root,
+        env={"TPU_WORKER_ID": str(worker_id), "TPU_SLICE_ID": SLICE_ID},
+    )
+    return feats
+
+
+def _expected_slice_verdicts(client):
+    """From-scratch recomputation of every slice verdict from LIVE
+    cluster state (nodes, validator pods, allocatable, maintenance) —
+    the settle oracle the operator's labels must agree with."""
+    from tpu_operator.controllers import slice_status
+
+    live_nodes = [
+        n
+        for n in client.list("v1", "Node")
+        if consts.GKE_TPU_ACCELERATOR_LABEL
+        in (n["metadata"].get("labels") or {})
+    ]
+    validated = slice_status.validator_ready_nodes(client, NS)
+    slices = slice_status.group_slices(live_nodes)
+    by_name = {n["metadata"]["name"]: n for n in live_nodes}
+    expected = {}
+    for info in slices.values():
+        ready_members = sum(
+            1
+            for m in info.member_nodes
+            if m in validated
+            and slice_status.host_allocatable_ok(by_name[m]) is not False
+            and not (
+                by_name[m]["metadata"].get("labels") or {}
+            ).get(consts.MAINTENANCE_STATE_LABEL)
+        )
+        want = info.expected_hosts or len(info.member_nodes)
+        verdict = (
+            "true"
+            if want > 0
+            and ready_members >= want
+            and len(info.member_nodes) >= want
+            else "false"
+        )
+        for m in info.member_nodes:
+            expected[m] = verdict
+    return expected
+
+
+def _actual_slice_verdicts(client):
+    out = {}
+    for n in client.list("v1", "Node"):
+        labels = n["metadata"].get("labels") or {}
+        if consts.GKE_TPU_ACCELERATOR_LABEL not in labels:
+            continue
+        out[n["metadata"]["name"]] = labels.get(consts.SLICE_READY_LABEL)
+    return out
 
 
 def test_chaos_churn_then_converge():
@@ -59,7 +129,52 @@ def test_chaos_churn_then_converge():
     client.GET_RETRY_BACKOFF_S = 0.05
     seed_cluster(client, NS, node_names=base)
 
-    nodes = list(base)  # shared, mutated by chaos; read by the kubelet
+    # --- multi-host slice wing: 4 members, 2 with REAL gRPC rigs -------
+    import tempfile
+
+    from tpu_operator.discovery import tfd
+    from tpu_operator.kube.kubelet_sim import KubeletDeviceManager
+    from tpu_operator.plugin.server import (
+        DevicePluginServer,
+        TPUDevicePluginServicer,
+    )
+
+    storm_root = tempfile.mkdtemp(prefix="slice-storm-")
+    member_features = {}
+    rigs = {}
+    for i, name in enumerate(SLICE_MEMBERS):
+        client.create(make_tpu_node(name, topology="4x8"))
+        dev_root = os.path.join(storm_root, f"dev-{i}")
+        os.makedirs(dev_root, exist_ok=True)
+        for c in range(8):
+            open(os.path.join(dev_root, f"accel{c}"), "w").close()
+        feats = _slice_member_features(client, name, i, dev_root)
+        member_features[name] = (feats, dev_root)
+        assert tfd.apply_features(client, name, feats)
+    for i, name in enumerate(RIG_MEMBERS):
+        _, dev_root = member_features[name]
+        socket_dir = os.path.join(storm_root, f"kubelet-{i}")
+        kubelet = KubeletDeviceManager(client, name, socket_dir)
+        kubelet.start()
+        servicer = TPUDevicePluginServicer(
+            dev_root=dev_root,
+            generation="v5e",
+            host_topology="2x4",
+            cdi_enabled=True,
+            poll_interval_s=0.2,
+            health_probe_interval_s=3600,
+        )
+        plugin = DevicePluginServer(servicer, socket_dir=socket_dir)
+        plugin.start()
+        plugin.register_with_kubelet(kubelet.kubelet_socket)
+        rigs[name] = {
+            "kubelet": kubelet,
+            "servicer": servicer,
+            "plugin": plugin,
+            "socket_dir": socket_dir,
+        }
+
+    nodes = list(base) + list(SLICE_MEMBERS)  # shared, mutated by chaos
     # deterministic in CI; override CHAOS_SEED to shake new interleavings
     rng = random.Random(CHAOS_SEED)
     next_node = [len(base)]
@@ -97,7 +212,14 @@ def test_chaos_churn_then_converge():
         def del_node():
             if len(nodes) <= 1:
                 return  # always keep one TPU node
-            name = rng.choice(nodes)
+            # rig members stay: their kubelet rigs would keep patching a
+            # deleted node (no real cluster deletes a node out from under
+            # a live kubelet); SIMULATED slice members are fair game —
+            # losing one is exactly the slice-grouping churn to storm
+            candidates = [n for n in nodes if n not in RIG_MEMBERS]
+            if not candidates:
+                return
+            name = rng.choice(candidates)
             try:
                 client.delete("v1", "Node", name)
             finally:
@@ -156,6 +278,68 @@ def test_chaos_churn_then_converge():
                 rng.choice(["pods", "nodes", "daemonsets", "configmaps"])
             )
 
+        # --- slice-wing storm actions ---------------------------------
+        def readd_slice_member():
+            """Resurrect a deleted simulated member with its canonical
+            TFD labels (autoscaler replacement-host pattern)."""
+            for name in SLICE_MEMBERS:
+                if name in RIG_MEMBERS:
+                    continue
+                if client.get_or_none("v1", "Node", name) is None:
+                    client.create(make_tpu_node(name, topology="4x8"))
+                    feats, _ = member_features[name]
+                    tfd.apply_features(client, name, feats)
+                    if name not in nodes:
+                        nodes.append(name)
+                    return
+
+        def scribble_slice_ready():
+            """Corrupt the OUTPUT label: the aggregate must converge
+            tpu.slice.ready back to the truth it computes."""
+            name = rng.choice(SLICE_MEMBERS)
+            node = client.get_or_none("v1", "Node", name)
+            if node is None:
+                return
+            node["metadata"].setdefault("labels", {})[
+                consts.SLICE_READY_LABEL
+            ] = rng.choice(["true", "false", "banana"])
+            client.update(node)
+
+        def flip_rig_chips():
+            name = rng.choice(RIG_MEMBERS)
+            servicer = rigs[name]["servicer"]
+            chip = str(rng.randrange(8))
+            if rng.random() < 0.5:
+                servicer.mark_unhealthy(chip)
+            else:
+                servicer.mark_healthy(chip)
+
+        def kill_restart_plugin():
+            """A device plugin crashes and a fresh PROCESS re-binds the
+            fixed socket + re-registers — fresh servicer too (a stopped
+            servicer's stop event is permanent, exactly like a dead
+            process's memory): the restart path the kubelet rig's
+            registration generations exist for."""
+            name = rng.choice(RIG_MEMBERS)
+            rig = rigs[name]
+            try:
+                rig["plugin"].stop()
+            except Exception:
+                pass
+            servicer = TPUDevicePluginServicer(
+                dev_root=member_features[name][1],
+                generation="v5e",
+                host_topology="2x4",
+                cdi_enabled=True,
+                poll_interval_s=0.2,
+                health_probe_interval_s=3600,
+            )
+            plugin = DevicePluginServer(servicer, socket_dir=rig["socket_dir"])
+            plugin.start()
+            plugin.register_with_kubelet(rig["kubelet"].kubelet_socket)
+            rig["servicer"] = servicer
+            rig["plugin"] = plugin
+
         actions = [
             add_node,
             del_node,
@@ -165,6 +349,10 @@ def test_chaos_churn_then_converge():
             bump_libtpu,
             scribble_labels,
             drop_watch_line,
+            readd_slice_member,
+            scribble_slice_ready,
+            flip_rig_chips,
+            kill_restart_plugin,
         ]
         deadline = time.monotonic() + CHURN_S
         while not halt.is_set() and time.monotonic() < deadline:
@@ -181,6 +369,9 @@ def test_chaos_churn_then_converge():
     soak_ok = False
     settle_s = None
     drift_repairs = None
+    slice_verdicts_ok = None
+    slice_events_deduped = None
+    storm_slice_degradations = None
     try:
         chaos_thread.start()
         with running_operator(client, NS, nodes):
@@ -204,11 +395,47 @@ def test_chaos_churn_then_converge():
             time.sleep(CHURN_S / 2 + 1.0)
 
             # restore a deterministic goal state: exporter on, and
-            # whatever nodes survived stay
+            # whatever nodes survived stay; the slice wing heals to full
+            # strength (missing members re-added, chips healthy, plugins
+            # serving) so settle can assert the slice goes READY again
             mutate_cp(
                 lambda cp: cp["spec"]["metricsExporter"].update(enabled=True)
             )
             assert nodes, "chaos deleted every node (guard failed)"
+            for name in SLICE_MEMBERS:
+                if client.get_or_none("v1", "Node", name) is None:
+                    client.create(make_tpu_node(name, topology="4x8"))
+                feats, _ = member_features[name]
+                try:
+                    tfd.apply_features(client, name, feats)
+                except API_ERRORS:
+                    pass
+                if name not in nodes:
+                    nodes.append(name)
+            for name in RIG_MEMBERS:
+                rig = rigs[name]
+                for chip in range(8):
+                    rig["servicer"].mark_healthy(str(chip))
+                try:  # a killed-but-never-restarted plugin: bring it back
+                    rig["plugin"].register_with_kubelet(
+                        rig["kubelet"].kubelet_socket
+                    )
+                except Exception:
+                    servicer = TPUDevicePluginServicer(
+                        dev_root=member_features[name][1],
+                        generation="v5e",
+                        host_topology="2x4",
+                        cdi_enabled=True,
+                        poll_interval_s=0.2,
+                        health_probe_interval_s=3600,
+                    )
+                    plugin = DevicePluginServer(
+                        servicer, socket_dir=rig["socket_dir"]
+                    )
+                    plugin.start()
+                    plugin.register_with_kubelet(rig["kubelet"].kubelet_socket)
+                    rig["servicer"] = servicer
+                    rig["plugin"] = plugin
 
             def settled():
                 cp = client.get_or_none(
@@ -352,6 +579,48 @@ def test_chaos_churn_then_converge():
                 else None
             )
 
+            # --- slice-wing settle assertions (round-5 verdict #2) ----
+            # 1) every slice verdict label matches a FROM-SCRATCH
+            #    recomputation off live cluster state (incl. the storm
+            #    slice healing back to ready after label scribbles, node
+            #    deletes, chip flips and plugin kills)
+            def slice_verdicts_converged():
+                try:
+                    expected = _expected_slice_verdicts(client)
+                    actual = _actual_slice_verdicts(client)
+                except API_ERRORS:
+                    return False
+                return expected == actual and expected.get(
+                    SLICE_MEMBERS[0]
+                ) == "true"
+
+            assert wait_until(slice_verdicts_converged, 120), (
+                "slice verdicts diverged from recomputation at settle: "
+                f"expected={_expected_slice_verdicts(client)} "
+                f"actual={_actual_slice_verdicts(client)}"
+            )
+            slice_verdicts_ok = True
+
+            # 2) SliceDegraded Events stayed dedup'd: at most ONE Event
+            #    object per slice, however many flips the storm caused
+            degraded = [
+                e
+                for e in client.list("v1", "Event", NS)
+                if e.get("reason") == "SliceDegraded"
+            ]
+            by_slice = {}
+            for e in degraded:
+                sid = e.get("message", "").split(" ")[1]
+                by_slice.setdefault(sid, []).append(e["metadata"]["name"])
+            dup = {s: names for s, names in by_slice.items() if len(names) > 1}
+            assert not dup, f"SliceDegraded events not dedup'd per slice: {dup}"
+            slice_events_deduped = True
+            storm_slice_degradations = sum(
+                int(e.get("count", 1))
+                for e in degraded
+                if f"slice {SLICE_ID} " in e.get("message", "")
+            )
+
         soak_ok = True
     finally:
         chaos_halt.set()
@@ -379,6 +648,19 @@ def test_chaos_churn_then_converge():
                 # apiserver — KUBESIM_EVENT_TTL_S tightens it for soaks)
                 "events_at_settle": _safe_event_count(client),
                 "event_ttl_s": server.sim.event_ttl_s,
+                # slice-wing truth (round-5): the 4-host storm slice with
+                # 2 real gRPC rigs survived the weather and converged
+                "slice_members": len(SLICE_MEMBERS),
+                "slice_rigs": len(RIG_MEMBERS),
+                "slice_verdicts_ok": (
+                    slice_verdicts_ok if soak_ok else None
+                ),
+                "slice_events_deduped": (
+                    slice_events_deduped if soak_ok else None
+                ),
+                "slice_degradations_observed": (
+                    storm_slice_degradations if soak_ok else None
+                ),
                 "ok": soak_ok,
             },
         }
@@ -395,4 +677,13 @@ def test_chaos_churn_then_converge():
             os.environ.pop("INFORMER_RESYNC_INTERVAL_S", None)
         else:
             os.environ["INFORMER_RESYNC_INTERVAL_S"] = prev_resync
+        for rig in rigs.values():
+            try:
+                rig["plugin"].stop()
+            except Exception:
+                pass
+            try:
+                rig["kubelet"].stop()
+            except Exception:
+                pass
         server.stop()

@@ -471,3 +471,85 @@ def test_slice_workload_single_host_gang_of_one(status):
     assert pods[0]["spec"]["containers"][0]["resources"]["limits"][
         consts.TPU_RESOURCE
     ] == "4"
+
+
+def test_slice_workload_follower_rejects_stale_epoch_gang(status, tmp_path):
+    """A follower must not converge on a PREVIOUS epoch's Succeeded gang:
+    after the validator DS re-rolls (uid/generation change), old pods
+    read as StaleEpoch and the follower keeps waiting for the leader's
+    respawn instead of passing against history."""
+    from tpu_operator.validator import workload_pods as wp
+
+    ns = "tpu-operator"
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": ns}},
+            make_node("g-1", {consts.TPU_RESOURCE: "4"}),
+            {
+                "apiVersion": "apps/v1",
+                "kind": "DaemonSet",
+                "metadata": {"name": "tpu-operator-validator", "namespace": ns},
+                "spec": {
+                    "selector": {"matchLabels": {"app": "tpu-operator-validator"}}
+                },
+            },
+        ]
+    )
+    # a leader spawns the gang at the CURRENT epoch and the kubelet runs it
+    sid, members = "g-1", [("g-1", "4")]
+    epoch = wp.gang_epoch(client, ns)
+    assert epoch
+
+    def kubelet():
+        import time as _t
+
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline:
+            for pod in client.list("v1", "Pod", ns):
+                if pod["metadata"]["name"].startswith("tpu-slice-gang"):
+                    pod["status"] = {"phase": "Succeeded"}
+                    client.update_status(pod)
+                    return
+            _t.sleep(0.02)
+
+    t = threading.Thread(target=kubelet, daemon=True)
+    t.start()
+    info = wp.run_slice_gang(
+        client, ns, sid, members, spawn=True, retries=50, sleep_s=0.05
+    )
+    assert info["result"] == "Succeeded"
+
+    # validator DS re-rolls: delete + recreate gives a NEW uid → new epoch
+    client.delete("apps/v1", "DaemonSet", "tpu-operator-validator", ns)
+    # (server-side GC took the gang pods with the DS; recreate both the DS
+    # and a STALE-epoch Succeeded pod, as left behind by a slower GC)
+    client.create(
+        {
+            "apiVersion": "apps/v1",
+            "kind": "DaemonSet",
+            "metadata": {
+                "name": "tpu-operator-validator",
+                "namespace": ns,
+                # FakeClient mints a constant uid; a real apiserver bumps
+                # generation on template change — model that explicitly
+                "generation": 2,
+            },
+            "spec": {
+                "selector": {"matchLabels": {"app": "tpu-operator-validator"}}
+            },
+        }
+    )
+    assert wp.gang_epoch(client, ns) != epoch
+    stale = wp.slice_gang_pod(sid, "g-1", ns, 0, 1, chips="4")
+    stale["metadata"]["labels"][wp.GANG_EPOCH_LABEL] = epoch
+    stale["status"] = {"phase": "Succeeded"}
+    client.create(stale)
+
+    # the follower sees only the stale gang → must FAIL naming it stale,
+    # not pass against the previous epoch
+    with pytest.raises(RuntimeError) as exc:
+        wp.run_slice_gang(
+            client, ns, sid, members, spawn=False, retries=3, sleep_s=0.02
+        )
+    assert "StaleEpoch" in str(exc.value), str(exc.value)
+    assert "previous-epoch" in str(exc.value)

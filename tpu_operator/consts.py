@@ -173,6 +173,7 @@ STATUS_FILE_SLICE_WORKLOAD = "slice-workload-ready"
 # exporter as tpu_validator_probe_ready{probe=...}
 PROBE_STATUS_FILES = (
     "slice-ready",
+    "slice-workload-ready",
     "ici-ready",
     "ringattn-ready",
     "pipeline-ready",

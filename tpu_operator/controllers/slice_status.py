@@ -177,6 +177,18 @@ def host_allocatable_ok(node: Obj) -> Optional[bool]:
     return False
 
 
+def slice_members(client: Client, node: Obj):
+    """``(slice_id, member node objects)`` for the slice this node
+    belongs to — the ONE membership computation shared by every consumer
+    (maintenance flip, gang validator), so they cannot disagree about
+    who the members are."""
+    sid = slice_id_for_node(node)
+    members = [
+        n for n in client.list("v1", "Node") if slice_id_for_node(n) == sid
+    ]
+    return sid, members
+
+
 def group_slices(tpu_nodes: List[Obj]) -> Dict[str, SliceInfo]:
     slices: Dict[str, SliceInfo] = {}
     for node in tpu_nodes:
@@ -271,7 +283,11 @@ def _record_degradation(client: Client, namespace: str, info: SliceInfo) -> None
     slice down — a v5p-64 losing one host is invisible in per-node
     readiness; this is where the operator says so out loud."""
     from tpu_operator import consts as c
-    from tpu_operator.kube.events import TYPE_WARNING, record_event
+    from tpu_operator.kube.events import (
+        TYPE_WARNING,
+        cluster_policy_ref,
+        record_event,
+    )
 
     if info.maintenance_hosts:
         detail = (
@@ -292,11 +308,7 @@ def _record_degradation(client: Client, namespace: str, info: SliceInfo) -> None
     record_event(
         client,
         namespace,
-        {
-            "apiVersion": c.API_VERSION,
-            "kind": "ClusterPolicy",
-            "metadata": {"name": "cluster-policy"},
-        },
+        cluster_policy_ref(),
         TYPE_WARNING,
         "SliceDegraded",
         f"slice {info.slice_id} is no longer ready: {detail}",

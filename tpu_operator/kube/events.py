@@ -22,6 +22,19 @@ TYPE_WARNING = "Warning"
 COMPONENT = "tpu-operator"
 
 
+def cluster_policy_ref() -> Obj:
+    """The singleton ClusterPolicy as an Event involved-object — the
+    shared events bus for slice-scoped records (degradation, upgrade
+    rolls, maintenance windows)."""
+    from tpu_operator import consts
+
+    return {
+        "apiVersion": consts.API_VERSION,
+        "kind": "ClusterPolicy",
+        "metadata": {"name": "cluster-policy"},
+    }
+
+
 def _now() -> str:
     return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 

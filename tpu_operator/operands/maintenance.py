@@ -173,15 +173,10 @@ class MaintenanceHandler:
     def _slice_members(self):
         """This node's slice id and its member nodes (empty for
         single-host slices, whose verdict the aggregate owns alone)."""
-        from tpu_operator.controllers.slice_status import slice_id_for_node
+        from tpu_operator.controllers.slice_status import slice_members
 
         node = self.client.get("v1", "Node", self.node_name)
-        sid = slice_id_for_node(node)
-        members = [
-            n
-            for n in self.client.list("v1", "Node")
-            if slice_id_for_node(n) == sid
-        ]
+        sid, members = slice_members(self.client, node)
         if len(members) <= 1:
             return sid, []
         return sid, members
@@ -193,7 +188,11 @@ class MaintenanceHandler:
         racing this write agrees rather than flipping the verdict back;
         best-effort — never blocks the drain."""
         from tpu_operator.kube.client import mutate_with_retry
-        from tpu_operator.kube.events import TYPE_WARNING, record_event
+        from tpu_operator.kube.events import (
+            TYPE_WARNING,
+            cluster_policy_ref,
+            record_event,
+        )
 
         try:
             sid, members = self._slice_members()
@@ -220,11 +219,7 @@ class MaintenanceHandler:
             record_event(
                 self.client,
                 os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "default"),
-                {
-                    "apiVersion": consts.API_VERSION,
-                    "kind": "ClusterPolicy",
-                    "metadata": {"name": "cluster-policy"},
-                },
+                cluster_policy_ref(),
                 TYPE_WARNING,
                 "SliceMaintenanceScheduled",
                 f"slice {sid}: member host {self.node_name} has a "
@@ -371,18 +366,14 @@ class MaintenanceHandler:
         # its next pass (the label diff re-triggers it); the Event tells
         # the multi-host story in one line
         try:
-            from tpu_operator.kube.events import record_event
+            from tpu_operator.kube.events import cluster_policy_ref, record_event
 
             sid, members = self._slice_members()
             if members:
                 record_event(
                     self.client,
                     os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "default"),
-                    {
-                        "apiVersion": consts.API_VERSION,
-                        "kind": "ClusterPolicy",
-                        "metadata": {"name": "cluster-policy"},
-                    },
+                    cluster_policy_ref(),
                     TYPE_NORMAL,
                     "SliceMaintenanceCleared",
                     f"slice {sid}: the maintenance window on member host "

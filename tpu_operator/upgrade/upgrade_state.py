@@ -1045,16 +1045,12 @@ class ClusterUpgradeStateManager:
     ) -> None:
         """Per-slice upgrade state on the shared ClusterPolicy (dedup per
         slice, like SliceDegraded)."""
-        from tpu_operator.kube.events import record_event
+        from tpu_operator.kube.events import cluster_policy_ref, record_event
 
         record_event(
             self.client,
             self.namespace,
-            {
-                "apiVersion": consts.API_VERSION,
-                "kind": "ClusterPolicy",
-                "metadata": {"name": "cluster-policy"},
-            },
+            cluster_policy_ref(),
             event_type,
             reason,
             message,

@@ -158,6 +158,23 @@ SLICE_GANG_SCRIPT = (
 
 GANG_PORT = 8476  # the JAX coordination-service port
 
+# epoch label: ties a gang to the validator DaemonSet revision that
+# spawned it, so a follower cannot converge on a STALE gang's Succeeded
+# pods from before a re-roll (the leader is about to replace them)
+GANG_EPOCH_LABEL = f"{consts.GROUP}/gang-epoch"
+
+
+def gang_epoch(client, namespace: str) -> str:
+    """Current gang epoch: validator DS uid+generation ('' degrades the
+    check away when the DS does not exist, e.g. bare CLI runs)."""
+    ds = client.get_or_none(
+        "apps/v1", "DaemonSet", "tpu-operator-validator", namespace
+    )
+    if ds is None:
+        return ""
+    meta = ds.get("metadata", {})
+    return f"{(meta.get('uid') or 'x')[:8]}-{meta.get('generation', 0)}"
+
 
 def gang_name(slice_id: str) -> str:
     return _per_node_name("tpu-slice-gang", slice_id)
@@ -256,6 +273,7 @@ def run_slice_gang(
     not make it — a member that cannot schedule is named with its phase
     so the operator can see WHICH host holds the slice back."""
     name = gang_name(slice_id)
+    epoch = gang_epoch(client, namespace)
     pods = [
         slice_gang_pod(
             slice_id,
@@ -271,6 +289,9 @@ def run_slice_gang(
     host_of = {p["metadata"]["name"]: p["spec"]["nodeSelector"][
         "kubernetes.io/hostname"
     ] for p in pods}
+    if epoch:
+        for pod in pods:
+            pod["metadata"]["labels"][GANG_EPOCH_LABEL] = epoch
     if spawn:
         svc = gang_service(slice_id, namespace)
         set_owner_daemonset(client, svc, namespace, "tpu-operator-validator")
@@ -294,6 +315,16 @@ def run_slice_gang(
                     "Missing" if spawn else "NotCreated"
                 )
                 continue
+            live_epoch = (
+                live["metadata"].get("labels", {}) or {}
+            ).get(GANG_EPOCH_LABEL, "")
+            if epoch and live_epoch != epoch:
+                # a previous epoch's gang (validator re-rolled since):
+                # its Succeeded means nothing now — a follower must wait
+                # for the leader to respawn the current epoch, not pass
+                # against history
+                phases[pod["metadata"]["name"]] = "StaleEpoch"
+                continue
             phase = live.get("status", {}).get("phase", "Pending")
             if phase == "Pending" and not live.get("spec", {}).get("nodeName"):
                 phase = "Unschedulable"
@@ -308,13 +339,13 @@ def run_slice_gang(
         if any(p == "Failed" for p in phases.values()):
             break
         time.sleep(sleep_s)
+    notes = {
+        "Unschedulable": " (slice gate tpu.slice.ready or cordon is refusing it)",
+        "StaleEpoch": " (previous-epoch gang; leader respawn pending)",
+    }
     stragglers = "; ".join(
         f"member host {host_of[pname]}: pod {pname} {phase}"
-        + (
-            " (slice gate tpu.slice.ready or cordon is refusing it)"
-            if phase == "Unschedulable"
-            else ""
-        )
+        + notes.get(phase, "")
         for pname, phase in sorted(phases.items())
         if phase != "Succeeded"
     )

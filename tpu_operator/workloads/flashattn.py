@@ -71,12 +71,28 @@ def make_flash_fn(
     block_k: int = 1024,
     causal: bool = True,
     interpret: bool = False,
+    variant: str = "full",
 ):
     """Build the jitted flash-attention forward over ``(H, S, D)`` bf16
     Q/K/V. Grid is (head, q-block); each kernel instance streams K/V
     blocks for its head with a running-max/denominator carry (the flash
     recurrence), masking nothing it can skip: causal q-blocks stop at
-    their diagonal block."""
+    their diagonal block.
+
+    ``variant`` selects instrumented kernels for phase ATTRIBUTION of the
+    flashattn-vs-matmul gap (round-4 verdict #3) — same grid, same block
+    streaming, surgically removed phases (numerics are wrong by design
+    for the stubs; only "full"/"pipelined" pass the oracle):
+
+    * ``full``          — the shipped kernel;
+    * ``pipelined``     — software-pipelined: block j's QKᵀ (MXU) issued
+      in the same loop body as block j-1's softmax (VPU) + PV, giving
+      Mosaic's static scheduler visibility to overlap the units;
+    * ``softmax_stub``  — both matmuls, softmax replaced by a copy
+      (t_full − t_stub ≈ the un-overlapped softmax/VPU cost);
+    * ``qk_only``       — the QKᵀ matmul alone (half the FLOPs: pure
+      MXU + K-streaming rate).
+    """
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -84,6 +100,10 @@ def make_flash_fn(
 
     if seq % block_q or seq % block_k:
         raise ValueError(f"seq={seq} must tile by {block_q}/{block_k}")
+    if variant not in (
+        "full", "pipelined", "softmax_stub", "qk_only", "bf16exp"
+    ):
+        raise ValueError(f"unknown flash variant {variant!r}")
     scale = 1.0 / (head_dim**0.5)
     n_k_blocks = seq // block_k
 
@@ -105,41 +125,132 @@ def make_flash_fn(
             hi = n_k_blocks
             n_full = n_k_blocks
 
-        def make_body(masked: bool):
-            def body(j, carry):
-                m, l, acc = carry
-                k = k_ref[0, pl.ds(j * block_k, block_k), :]
-                s = (
-                    lax.dot_general(
-                        q, k, (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32,
-                    )
-                    * scale
-                )
-                if masked:
-                    qpos = i * block_q + lax.broadcasted_iota(
-                        jnp.int32, (block_q, block_k), 0
-                    )
-                    kpos = j * block_k + lax.broadcasted_iota(
-                        jnp.int32, (block_q, block_k), 1
-                    )
-                    s = jnp.where(qpos >= kpos, s, -jnp.inf)
-                m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-                alpha = jnp.exp(m - m_new)
-                p = jnp.exp(s - m_new)
-                l_new = alpha * l + p.sum(axis=-1, keepdims=True)
-                v = v_ref[0, pl.ds(j * block_k, block_k), :]
-                acc_new = acc * alpha + lax.dot_general(
-                    p.astype(jnp.bfloat16), v, (((1,), (0,)), ((), ())),
+        def scores(j):
+            k = k_ref[0, pl.ds(j * block_k, block_k), :]
+            return (
+                lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
-                return m_new, l_new, acc_new
+                * scale
+            )
 
-            return body
+        def mask(j, s):
+            qpos = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            return jnp.where(qpos >= kpos, s, -jnp.inf)
+
+        def soft_update(j, s, m, l, acc):
+            """One online-softmax + PV step against block ``j``'s V."""
+            m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            if variant == "bf16exp":
+                # the (block_q, block_k) exp is the VPU phase's bulk; the
+                # MXU consumes p as bf16 anyway, so computing the exp in
+                # bf16 (packed VPU lanes) halves the element width on the
+                # hot path. Stability lives in the f32 row-max SUBTRACTION
+                # (s - m_new ≤ 0, computed in f32 before the cast) and
+                # the f32 running denominator; only exp's output mantissa
+                # drops, which the bf16 PV matmul was dropping anyway.
+                p = jnp.exp((s - m_new).astype(jnp.bfloat16))
+                l_new = alpha * l + jnp.sum(
+                    p, axis=-1, keepdims=True, dtype=jnp.float32
+                )
+                pv = p
+            else:
+                p = jnp.exp(s - m_new)
+                l_new = alpha * l + p.sum(axis=-1, keepdims=True)
+                pv = p.astype(jnp.bfloat16)
+            v = v_ref[0, pl.ds(j * block_k, block_k), :]
+            acc_new = acc * alpha + lax.dot_general(
+                pv, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new
 
         m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
         l0 = jnp.zeros((block_q, 1), jnp.float32)
         acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+
+        if variant == "qk_only":
+            # attribution: QKᵀ + K-streaming alone — the output is a
+            # reduction of the scores so the matmul cannot be DCE'd
+            def body(j, acc):
+                s = scores(j)
+                return acc + s[:, :head_dim]
+
+            acc = lax.fori_loop(0, hi, body, acc0)
+            o_ref[0] = acc.astype(o_ref.dtype)
+            return
+
+        if variant == "softmax_stub":
+            # attribution: both matmuls at full rate, softmax replaced
+            # by a cast (no exp/max/renorm — the VPU phase removed)
+            def body(j, acc):
+                s = scores(j)
+                v = v_ref[0, pl.ds(j * block_k, block_k), :]
+                return acc + lax.dot_general(
+                    (s * 0.001).astype(jnp.bfloat16),
+                    v,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+            acc = lax.fori_loop(0, hi, body, acc0)
+            o_ref[0] = acc.astype(o_ref.dtype)
+            return
+
+        if variant == "pipelined":
+            # software pipeline over the UNMASKED range: the loop body
+            # computes block j's scores (MXU) next to block j-1's
+            # softmax+PV (VPU + MXU) — independent work, so the static
+            # scheduler can overlap the units instead of serializing
+            # qkT → softmax → pv per block
+            def pipe_body(j, carry):
+                m, l, acc, s_prev = carry
+                s_cur = scores(j)
+                m2, l2, acc2 = soft_update(j - 1, s_prev, m, l, acc)
+                return m2, l2, acc2, s_cur
+
+            # no outer cond: when n_full == 0 the prefetch reads block 0
+            # (harmless) and the drain below is select-skipped — keeping
+            # ONE score carry live instead of cond-duplicated buffers
+            # (the cond form blew the 16M scoped-vmem limit at bk=2048)
+            s0 = scores(0)
+            m, l, acc, s_last = lax.fori_loop(
+                1, n_full, pipe_body, (m0, l0, acc0, s0)
+            )
+            carry = lax.cond(
+                n_full > 0,
+                lambda c: soft_update(n_full - 1, c[3], c[0], c[1], c[2]),
+                lambda c: (c[0], c[1], c[2]),
+                (m, l, acc, s_last),
+            )
+            if causal:
+
+                def tail_body(j, carry):
+                    m, l, acc = carry
+                    return soft_update(j, mask(j, scores(j)), m, l, acc)
+
+                carry = lax.fori_loop(n_full, hi, tail_body, carry)
+            m, l, acc = carry
+            o_ref[0] = (acc / l).astype(o_ref.dtype)
+            return
+
+        def make_body(masked: bool):
+            def body(j, carry):
+                m, l, acc = carry
+                s = scores(j)
+                if masked:
+                    s = mask(j, s)
+                return soft_update(j, s, m, l, acc)
+
+            return body
+
         carry = lax.fori_loop(0, n_full, make_body(False), (m0, l0, acc0))
         if causal:
             # only the diagonal-straddling tail pays for masking
@@ -165,9 +276,20 @@ def make_flash_fn(
             sem = getattr(pltpu, "GridDimensionSemantics", None)
             parallel = sem.PARALLEL if sem is not None else "parallel"
             if params_cls is not None:
-                kwargs["compiler_params"] = params_cls(
-                    dimension_semantics=(parallel, parallel)
-                )
+                params = {"dimension_semantics": (parallel, parallel)}
+                # Mosaic's DEFAULT scoped-vmem budget is 16 MiB — a
+                # compiler default, not the hardware (v5e carries 128 MiB
+                # VMEM). The round-3 tuning note "512/4096 exceeds VMEM"
+                # was this default's ceiling, and the pipelined variant's
+                # score carry tips 512/2048 over it too. 64 MiB leaves
+                # the pipeline framework ample headroom while freeing
+                # the block space the tuning actually wants.
+                try:
+                    kwargs["compiler_params"] = params_cls(
+                        vmem_limit_bytes=64 * 1024 * 1024, **params
+                    )
+                except TypeError:  # older API without the knob
+                    kwargs["compiler_params"] = params_cls(**params)
         except Exception:  # pragma: no cover - version-dependent
             pass
 
@@ -217,6 +339,125 @@ def causal_flops(seq: int, heads: int, head_dim: int, block_q: int, block_k: int
     return 4.0 * heads * total_blocks * block_q * block_k * head_dim
 
 
+def run_flashattn_breakdown(
+    seq: int = 8192,
+    heads: int = 8,
+    head_dim: int = LANES,
+    block_q: int = 512,
+    block_k: int = 2048,
+    iters: int = 32,
+) -> dict:
+    """Measured phase attribution of the flash-vs-matmul gap (round-4
+    verdict #3): time the instrumented variants at the tuned shape and
+    decompose one block-pair's cost into MXU matmul time vs softmax/VPU
+    time vs everything else. TPU only; returns ``{"ok": False}`` off-TPU.
+
+    The causal FLOPs accounting is per-variant (qk_only performs half
+    the matmul work), so each variant's ``tflops`` is honest against the
+    work IT does; ``per_pair_us`` (microseconds per processed q×k block
+    pair) is the comparable cost unit across variants."""
+    out = {"ok": False, "seq": seq, "heads": heads,
+           "block_q": block_q, "block_k": block_k}
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            out["error"] = "breakdown requires the TPU"
+            return out
+        from tpu_operator.workloads.timing import chain_per_iter_seconds
+
+        key = jax.random.PRNGKey(13)
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (heads, seq, head_dim)
+        q = jax.random.normal(kq, shape, jnp.bfloat16)
+        k = jax.random.normal(kk, shape, jnp.bfloat16)
+        v = jax.random.normal(kv, shape, jnp.bfloat16)
+
+        n_q = seq // block_q
+        pairs = heads * sum(diag_stop(i, block_q, block_k) for i in range(n_q))
+        flops_full = causal_flops(seq, heads, head_dim, block_q, block_k)
+
+        from tpu_operator.workloads.matmul import device_generation
+        from tpu_operator.workloads.topology import PEAK_BF16_TFLOPS
+
+        gen = device_generation(dev.device_kind)
+        peak = PEAK_BF16_TFLOPS.get(gen) if gen else None
+
+        variants = {}
+        for name in ("full", "pipelined", "softmax_stub", "qk_only"):
+            fn = make_flash_fn(
+                seq, heads, head_dim, block_q, block_k,
+                causal=True, interpret=False, variant=name,
+            )
+
+            def step(x, fn=fn):
+                return fn(x, k, v)
+
+            def force(x):
+                return float(jnp.sum(x[0, 0, :8]))
+
+            flops = flops_full if name != "qk_only" else flops_full / 2
+
+            def plausible(per_iter):
+                # every variant's MXU work is bounded by the chip peak;
+                # a super-peak reading is a tunnel timing-sync artifact,
+                # not a fast kernel (same policy as the probe's gate)
+                return peak is None or flops / per_iter / 1e12 <= peak * 1.05
+
+            # best-of-2 with up to 2 plausibility retries: single runs
+            # swing with tunnel state and can read impossibly fast
+            readings = [
+                chain_per_iter_seconds(step, q, force, iters)
+                for _ in range(2)
+            ]
+            while True:
+                sane = [r for r in readings if plausible(r)]
+                if sane or len(readings) >= 4:
+                    break
+                readings.append(chain_per_iter_seconds(step, q, force, iters))
+            entry_implausible = not sane
+            # all-implausible fallback: the SLOWEST reading — the fastest
+            # one is the most corrupted (super-peak sync artifact), and
+            # the attribution math must not ride it
+            per_iter = min(sane) if sane else max(readings)
+            variants[name] = {
+                "tflops": round(flops / per_iter / 1e12, 1),
+                "per_pair_us": round(per_iter / pairs * 1e6, 3),
+                "per_iter_ms": round(per_iter * 1e3, 3),
+                **({"implausible": True} if entry_implausible else {}),
+            }
+        out["variants"] = variants
+
+        t_full = variants["full"]["per_pair_us"]
+        t_pipe = variants["pipelined"]["per_pair_us"]
+        t_stub = variants["softmax_stub"]["per_pair_us"]
+        t_qk = variants["qk_only"]["per_pair_us"]
+        out["attribution"] = {
+            # both matmuls at full rate, no softmax: the MXU+streaming floor
+            "matmuls_us": t_stub,
+            # what the online softmax ADDS on top of the matmuls when
+            # serialized (the shipped kernel's structure)
+            "softmax_added_us": round(t_full - t_stub, 3),
+            "softmax_fraction_of_full": round(
+                max(0.0, (t_full - t_stub)) / t_full, 4
+            ),
+            # second matmul's marginal cost over QKᵀ alone
+            "pv_added_us": round(t_stub - t_qk, 3),
+            # what software-pipelining recovers of the softmax cost
+            "pipeline_recovered_us": round(t_full - t_pipe, 3),
+        }
+        out["measurement_clean"] = not any(
+            v.get("implausible") for v in variants.values()
+        )
+        out["ok"] = True
+        return out
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+        return out
+
+
 def run_flashattn_probe(
     seq: int = 2048,
     heads: int = 8,
@@ -227,6 +468,7 @@ def run_flashattn_probe(
     iters: int = 64,
     expect_tpu: bool = False,
     tol: float = 2e-2,
+    variant: str = "full",
 ) -> FlashAttnResult:
     """Correctness vs the f32 oracle, then throughput (fixed-overhead-
     cancelling chain timing, like the matmul/membw probes; ``iters``
@@ -259,7 +501,7 @@ def run_flashattn_probe(
         v = jax.random.normal(kv, shape, jnp.bfloat16)
 
         flash = make_flash_fn(
-            seq, heads, head_dim, bq, bk, causal, interpret
+            seq, heads, head_dim, bq, bk, causal, interpret, variant=variant
         )
         out = flash(q, k, v)
         ref = reference_attention(q, k, v, causal)

@@ -3,7 +3,8 @@
 Same 17-series shape as the reference (``controllers/operator_metrics.go:13-185``),
 re-pointed at TPU concepts: reconciliation status/totals, TPU node gauge,
 feature-label presence, per-generation libtpu DaemonSet gauges (DTK slot),
-and six upgrade-FSM gauges.
+and eight upgrade-FSM gauges (six node-state gauges plus the
+slice-granular in-progress/pinned pair — the round-5 disruption unit).
 """
 
 from __future__ import annotations

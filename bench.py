@@ -39,15 +39,19 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 
 # flash-attention regression gate (round-4 verdict #4): the adjacent-
 # matmul ratio is the chip-state-invariant comparator, and the bench
-# EXIT CODE now rides it — a kernel regression (wrong blocks, broken
-# pipeline) cannot record a green bench. Floor below the measured
-# steady-state ratio (~0.66-0.68 across r3/r4) with headroom for noise;
-# ratchet as the kernel improves.
+# EXIT CODE rides it — a kernel regression (wrong blocks, broken
+# pipeline) cannot record a green bench. Ratcheted 0.55 -> 0.60 in
+# round 5 with the 256/1024 retune: the measured healthy band at the
+# shipped point is 0.70-0.80 across sessions (docs/flashattn-
+# roofline.md), and 0.60 sits two noise-bands (2x ±0.05) below the
+# band's low end — a real regression trips, chip-hour noise does not.
+# Ratchet from the doc's measured band, not from historical ratios.
 FLASHATTN_VS_MATMUL_FLOOR = float(
-    os.environ.get("BENCH_FLASHATTN_VS_MATMUL_FLOOR", "0.55")
+    os.environ.get("BENCH_FLASHATTN_VS_MATMUL_FLOOR", "0.60")
 )
-# deliberate-degradation knobs (gate self-test: block 128/256 reads ~½
-# the tuned throughput and must flunk the floor)
+# deliberate-degradation knobs (gate self-test: block 64/1024 measures
+# ~0.59x the tuned per-FLOP rate -> vs_matmul ~0.40-0.47, well under
+# the 0.60 floor; numbers from the walltune table in the roofline doc)
 _FA_BLOCK_Q = int(os.environ.get("BENCH_FLASHATTN_BLOCK_Q", "0")) or None
 _FA_BLOCK_K = int(os.environ.get("BENCH_FLASHATTN_BLOCK_K", "0")) or None
 
@@ -684,6 +688,12 @@ def main() -> int:
         "flashattn": {
             "ok": bool(fa.ok),
             "tflops": round(fa.tflops, 1),
+            # tiling-independent task rate: useful causal-triangle FLOPs
+            # over wall time, no credit for masked-region compute — the
+            # number two block tilings can be honestly compared on
+            # (round-5 retune to 256/1024 was chosen on THIS, +13-16%
+            # wall, while per-performed-FLOP tflops moved only ~+4%)
+            "tflops_effective": round(fa.tflops_effective, 1),
             # ADJACENT-matmul ratio: the chip-state-invariant comparator
             # (gate round-over-round regressions on THIS, not on raw
             # TFLOPS, which swings with tunnel/chip hour); denominator

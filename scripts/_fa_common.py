@@ -1,0 +1,43 @@
+"""Shared harness for the fa_* measurement instruments: one QKV setup
+(fixed seed so every instrument times the same tensors) and one
+measure() factory wrapping the fixed-overhead-cancelling chain timer.
+The drift-cancelled comparison itself lives in
+tpu_operator.workloads.timing.adjacent_ratio_stats."""
+import jax
+import jax.numpy as jnp
+
+from tpu_operator.workloads.flashattn import reference_attention
+from tpu_operator.workloads.timing import chain_per_iter_seconds
+
+SEQ, HEADS, HEAD_DIM = 8192, 8, 128
+
+
+def setup(seq=SEQ, heads=HEADS, hd=HEAD_DIM, with_ref=True):
+    """Returns (q, k, v, ref) — ref is the f32 oracle, or None."""
+    key = jax.random.PRNGKey(13)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (heads, seq, hd)
+    q = jax.random.normal(kq, shape, jnp.bfloat16)
+    k = jax.random.normal(kk, shape, jnp.bfloat16)
+    v = jax.random.normal(kv, shape, jnp.bfloat16)
+    ref = reference_attention(q, k, v) if with_ref else None
+    return q, k, v, ref
+
+
+def make_measure(q, k, v, iters=32):
+    """measure(flash_fn) -> seconds per iteration of the serial chain."""
+
+    def measure(fn):
+        def step(x, fn=fn):
+            return fn(x, k, v)
+
+        def force(x):
+            return float(jnp.sum(x[0, 0, :8]))
+
+        return chain_per_iter_seconds(step, q, force, iters)
+
+    return measure
+
+
+def max_err(fn, q, k, v, ref):
+    return float(jnp.max(jnp.abs(fn(q, k, v).astype(jnp.float32) - ref)))

@@ -1,0 +1,45 @@
+"""Flash block sweep under the 64 MiB scoped-vmem budget,
+drift-cancelled against the 512/2048 operating point.
+
+CAUTION: this instrument compares PER-PERFORMED-FLOP rates, which
+reward tilings that do more masked-region work (a coarse k-block
+performs more FLOPs for the same task). scripts/fa_walltune.py is the
+wall-time-honest comparator the round-5 retune was decided on; this
+file is kept because its 512/4096 "+2.7%" reading next to walltune's
+"-17% wall" is the measured demonstration of that trap
+(docs/flashattn-roofline.md)."""
+from _fa_common import make_measure, max_err, setup
+
+from tpu_operator.workloads.flashattn import causal_flops, make_flash_fn
+from tpu_operator.workloads.timing import adjacent_ratio_stats
+
+seq, heads, hd = 8192, 8, 128
+q, k, v, ref = setup(seq, heads, hd)
+
+base = make_flash_fn(seq, heads, hd, 512, 2048, causal=True)
+cands = {}
+for bq, bk in [(512, 4096), (1024, 2048), (1024, 4096), (256, 2048),
+               (512, 8192), (1024, 1024), (2048, 2048)]:
+    try:
+        fn = make_flash_fn(seq, heads, hd, bq, bk, causal=True)
+        fn(q, k, v).block_until_ready()
+        cands[(bq, bk)] = fn
+    except Exception as e:
+        print(f"{bq}/{bk}: build failed: {type(e).__name__}")
+
+flops_base = causal_flops(seq, heads, hd, 512, 2048)
+
+
+def per_flop_ratio(key_, b, c):
+    # causal flops differ per tiling: this compares rate per PERFORMED
+    # flop (see module docstring for why that can mislead)
+    bq, bk = key_
+    return (causal_flops(seq, heads, hd, bq, bk) / c) / (flops_base / b)
+
+
+stats = adjacent_ratio_stats(make_measure(q, k, v), base, cands, reps=5,
+                             transform=per_flop_ratio)
+for (bq, bk), fn in cands.items():
+    med, lo, hi, _ = stats[(bq, bk)]
+    print(f"{bq:5d}/{bk:<5d} max_err={max_err(fn, q, k, v, ref):.5f} "
+          f"perflop_speedup_median={med:.3f} iqr=[{lo:.3f},{hi:.3f}]")

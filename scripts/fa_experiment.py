@@ -1,0 +1,159 @@
+"""Scratch experiment: candidate flashattn kernel structures vs shipped."""
+import functools, sys
+import jax, jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from tpu_operator.workloads.flashattn import make_flash_fn, diag_stop
+
+seq, heads, hd, bq, bk = 8192, 8, 128, 512, 2048
+scale = 1.0 / hd**0.5
+n_k = seq // bk
+
+def build(mode):
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        i = pl.program_id(1)
+        q = q_ref[0]
+        hi = diag_stop(i, bq, bk)
+        n_full = (i * bq) // bk
+
+        def scores(j):
+            k = k_ref[0, pl.ds(j * bk, bk), :]
+            return lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32) * scale
+
+        def mask(j, s):
+            qpos = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            return jnp.where(qpos >= kpos, s, -jnp.inf)
+
+        def soft_update(j, s, m, l, acc):
+            m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = alpha * l + p.sum(axis=-1, keepdims=True)
+            v = v_ref[0, pl.ds(j * bk, bk), :]
+            acc_new = acc * alpha + lax.dot_general(
+                p.astype(jnp.bfloat16), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((bq, 1), jnp.float32)
+        acc0 = jnp.zeros((bq, hd), jnp.float32)
+
+        if mode in ("paired16", "bf16s"):
+            def scores_b(j):
+                k = k_ref[0, pl.ds(j * bk, bk), :]
+                s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+                return (s * scale).astype(jnp.bfloat16)
+            def soft_b(j, s, m, l, acc):
+                m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True).astype(jnp.float32))
+                alpha = jnp.exp(m - m_new)
+                p = jnp.exp(s - m_new.astype(jnp.bfloat16))
+                l_new = alpha * l + p.sum(axis=-1, keepdims=True, dtype=jnp.float32)
+                v = v_ref[0, pl.ds(j * bk, bk), :]
+                acc_new = acc * alpha + lax.dot_general(
+                    p, v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return m_new, l_new, acc_new
+
+        if mode == "paired16":
+            n_pairs = n_full // 2
+            def body2(t, carry):
+                m, l, acc = carry
+                s1 = scores_b(2 * t)
+                s2 = scores_b(2 * t + 1)
+                m1, l1, a1 = soft_b(2 * t, s1, m, l, acc)
+                return soft_b(2 * t + 1, s2, m1, l1, a1)
+            carry = lax.fori_loop(0, n_pairs, body2, (m0, l0, acc0))
+            def body1(j, carry):
+                m, l, acc = carry
+                return soft_b(j, scores_b(j), m, l, acc)
+            carry = lax.fori_loop(2 * n_pairs, n_full, body1, carry)
+            def tail(j, carry):
+                m, l, acc = carry
+                s = scores_b(j)
+                qpos = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                kpos = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+                s = jnp.where(qpos >= kpos, s, jnp.bfloat16(-jnp.inf))
+                return soft_b(j, s, m, l, acc)
+            carry = lax.fori_loop(n_full, hi, tail, carry)
+        elif mode == "paired":
+            # two blocks per body: s2's MXU matmul is independent of s1's
+            # softmax, visible to Mosaic in ONE body, no loop-carried s
+            n_pairs = n_full // 2
+            def body2(t, carry):
+                m, l, acc = carry
+                s1 = scores(2 * t)
+                s2 = scores(2 * t + 1)
+                m1, l1, a1 = soft_update(2 * t, s1, m, l, acc)
+                return soft_update(2 * t + 1, s2, m1, l1, a1)
+            carry = lax.fori_loop(0, n_pairs, body2, (m0, l0, acc0))
+            def body1(j, carry):
+                m, l, acc = carry
+                return soft_update(j, scores(j), m, l, acc)
+            carry = lax.fori_loop(2 * n_pairs, n_full, body1, carry)
+            def tail(j, carry):
+                m, l, acc = carry
+                return soft_update(j, mask(j, scores(j)), m, l, acc)
+            carry = lax.fori_loop(n_full, hi, tail, carry)
+        elif mode == "bf16s":
+            # scores cast once to bf16: the whole softmax runs
+            # half-width (same scores_b/soft_b as paired16 — one
+            # definition, so the variants cannot silently diverge)
+            def body1(j, carry):
+                m, l, acc = carry
+                return soft_b(j, scores_b(j), m, l, acc)
+            carry = lax.fori_loop(0, n_full, body1, (m0, l0, acc0))
+            def tail(j, carry):
+                m, l, acc = carry
+                s = scores_b(j)
+                qpos = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                kpos = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+                s = jnp.where(qpos >= kpos, s, jnp.bfloat16(-jnp.inf))
+                return soft_b(j, s, m, l, acc)
+            carry = lax.fori_loop(n_full, hi, tail, carry)
+        m, l, acc = carry
+        o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+    params = pltpu.CompilerParams(
+        vmem_limit_bytes=64 * 1024 * 1024,
+        dimension_semantics=(pltpu.GridDimensionSemantics.PARALLEL,
+                             pltpu.GridDimensionSemantics.PARALLEL))
+    def flash(q, k, v):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((heads, seq, hd), q.dtype),
+            grid=(heads, seq // bq),
+            in_specs=[pl.BlockSpec((1, bq, hd), lambda h, i: (h, i, 0)),
+                      pl.BlockSpec((1, seq, hd), lambda h, i: (h, 0, 0)),
+                      pl.BlockSpec((1, seq, hd), lambda h, i: (h, 0, 0))],
+            out_specs=pl.BlockSpec((1, bq, hd), lambda h, i: (h, i, 0)),
+            compiler_params=params,
+        )(q, k, v)
+    return jax.jit(flash)
+
+from _fa_common import make_measure, setup
+
+q, k, v, ref = setup(seq, heads, hd)
+
+cands = {"shipped": make_flash_fn(seq, heads, hd, bq, bk, causal=True)}
+for mode in sys.argv[1:] or ["paired", "bf16s"]:
+    cands[mode] = build(mode)
+
+errs = {}
+for name, fn in cands.items():
+    o = fn(q, k, v)
+    errs[name] = float(jnp.max(jnp.abs(o.astype(jnp.float32) - ref)))
+
+from tpu_operator.workloads.timing import adjacent_ratio_stats
+
+base = cands.pop("shipped")
+stats = adjacent_ratio_stats(make_measure(q, k, v), base, cands, reps=7)
+print(f"{'shipped':10s} max_err={errs['shipped']:.5f}")
+for name in cands:
+    med, lo, hi, _ = stats[name]
+    print(f"{name:10s} max_err={errs[name]:.5f} "
+          f"wall_speedup_median={med:.3f} iqr=[{lo:.3f},{hi:.3f}]")

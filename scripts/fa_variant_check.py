@@ -1,0 +1,23 @@
+"""Drift-cancelled full-vs-pipelined at the shipped 256/1024 point.
+
+Round-5 note: a single-shot breakdown session read pipelined FASTER
+(140 vs 133 TFLOPS); this instrument's adjacent-ratio median read it
+0.78 [0.73, 0.84] — 22% slower. Single-shot cross-variant deltas on
+the tunneled chip are noise; decisions ride this comparator."""
+from _fa_common import make_measure, max_err, setup
+
+from tpu_operator.workloads.flashattn import make_flash_fn
+from tpu_operator.workloads.timing import adjacent_ratio_stats
+
+seq, heads, hd, bq, bk = 8192, 8, 128, 256, 1024
+q, k, v, ref = setup(seq, heads, hd)
+
+base = make_flash_fn(seq, heads, hd, bq, bk, causal=True, variant="full")
+pipe = make_flash_fn(seq, heads, hd, bq, bk, causal=True, variant="pipelined")
+for name, fn in (("full", base), ("pipelined", pipe)):
+    print(f"{name} max_err={max_err(fn, q, k, v, ref):.5f}")
+
+stats = adjacent_ratio_stats(
+    make_measure(q, k, v), base, {"pipelined": pipe}, reps=9)
+med, lo, hi, _ = stats["pipelined"]
+print(f"pipelined wall_speedup_median={med:.3f} iqr=[{lo:.3f},{hi:.3f}]")

@@ -1,0 +1,31 @@
+"""Wall-time head-to-head at fixed task (8192-seq causal attention):
+drift-cancelled adjacent ratios vs the round-3 512/2048 operating
+point. Smaller blocks track the causal diagonal tighter (less masked
+compute) — performed FLOPs differ BY DESIGN, so wall time is the only
+honest comparator (see docs/flashattn-roofline.md). This is the
+instrument the round-5 retune to 256/1024 was decided on; the
+candidate list below is exactly the published table's rows."""
+from _fa_common import make_measure, max_err, setup
+
+from tpu_operator.workloads.flashattn import causal_flops, make_flash_fn
+from tpu_operator.workloads.timing import adjacent_ratio_stats
+
+seq, heads, hd = 8192, 8, 128
+q, k, v, ref = setup(seq, heads, hd)
+
+base = make_flash_fn(seq, heads, hd, 512, 2048, causal=True)
+cands = {}
+for bq, bk in [(256, 1024), (512, 1024), (512, 512), (1024, 1024),
+               (256, 2048), (128, 1024), (64, 1024)]:
+    fn = make_flash_fn(seq, heads, hd, bq, bk, causal=True)
+    fn(q, k, v).block_until_ready()
+    cands[(bq, bk)] = fn
+
+stats = adjacent_ratio_stats(make_measure(q, k, v), base, cands, reps=9)
+fb = causal_flops(seq, heads, hd, 512, 2048)
+for (bq, bk), fn in cands.items():
+    med, lo, hi, _ = stats[(bq, bk)]
+    fc = causal_flops(seq, heads, hd, bq, bk)
+    print(f"{bq:5d}/{bk:<5d} max_err={max_err(fn, q, k, v, ref):.5f} "
+          f"flops_x{fc/fb:.3f} "
+          f"wall_speedup_median={med:.3f} iqr=[{lo:.3f},{hi:.3f}]")

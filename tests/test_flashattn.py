@@ -156,3 +156,14 @@ def test_unknown_variant_rejected():
 
     with _pytest.raises(ValueError):
         make_flash_fn(512, 2, 128, 128, 128, variant="nope")
+
+
+def test_probe_default_blocks_divide_nonpow2_seq():
+    """Round-5 regression: defaults must divide seqs the old 512/2048
+    defaults handled (1536 % 1024 != 0 — the largest-divisor fallback
+    picks 768), not fail make_flash_fn's tiling check."""
+    from tpu_operator.workloads.flashattn import run_flashattn_probe
+
+    res = run_flashattn_probe(seq=1536, heads=2)
+    assert res.ok, res.error
+    assert res.seq == 1536

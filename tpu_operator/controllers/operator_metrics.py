@@ -1,10 +1,15 @@
 """Operator self-metrics.
 
-Same 17-series shape as the reference (``controllers/operator_metrics.go:13-185``),
-re-pointed at TPU concepts: reconciliation status/totals, TPU node gauge,
-feature-label presence, per-generation libtpu DaemonSet gauges (DTK slot),
-and eight upgrade-FSM gauges (six node-state gauges plus the
-slice-granular in-progress/pinned pair — the round-5 disruption unit).
+The reference's 17-series surface (``controllers/operator_metrics.go:13-185``)
+re-pointed at TPU concepts, extended to **21 series**: 4 reconciliation
+(status/total/failed/last-success), TPU node gauge, feature-label
+presence, per-generation libtpu DaemonSet gauge (DTK slot), per-state
+operand gauge, and eight upgrade-FSM gauges — six node-state gauges
+plus the slice-granular in-progress/pinned pair (the round-5
+disruption unit). TPU-first additions beyond the reference's shape:
+slice totals/ready pair, maintenance gauge, the PDB-veto pressure
+counter (``upgrade_evictions_blocked_total``), and the informer
+drift-repair gauge.
 """
 
 from __future__ import annotations

@@ -45,6 +45,7 @@ class FlashAttnResult:
     causal: bool = True
     max_err: float = 0.0
     tflops: float = 0.0
+    tflops_effective: float = 0.0
     elapsed_s: float = 0.0
     error: str = ""
 
@@ -59,6 +60,7 @@ class FlashAttnResult:
             "causal": self.causal,
             "max_err": round(self.max_err, 6),
             "tflops": round(self.tflops, 2),
+            "tflops_effective": round(self.tflops_effective, 2),
             "elapsed_s": round(self.elapsed_s, 4),
         }
 
@@ -343,8 +345,8 @@ def run_flashattn_breakdown(
     seq: int = 8192,
     heads: int = 8,
     head_dim: int = LANES,
-    block_q: int = 512,
-    block_k: int = 2048,
+    block_q: int = 256,
+    block_k: int = 1024,
     iters: int = 32,
 ) -> dict:
     """Measured phase attribution of the flash-vs-matmul gap (round-4
@@ -487,11 +489,33 @@ def run_flashattn_probe(
         if expect_tpu and not on_tpu:
             raise RuntimeError(f"expected TPU, found platform={dev.platform}")
         interpret = not on_tpu
-        # measured optimum on v5e at seq 8192 (block sweep, round 3):
-        # 512/2048 beats the round-2 256/1024 by ~40% — fewer
-        # softmax/carry rounds per FLOP; 512/4096 exceeds VMEM
-        bq = block_q if block_q is not None else min(512, seq)
-        bk = block_k if block_k is not None else min(2048, seq)
+        # measured optimum on v5e at seq 8192 (round-5 drift-cancelled
+        # sweep, scripts/fa_walltune.py): 256/1024 beats the round-3
+        # 512/2048 by 13-16% WALL TIME (tighter diagonal tracking does
+        # 10% less masked compute) and ~4% per performed FLOP. The
+        # round-3 sweep that picked 512/2048 predated the 64 MiB
+        # scoped-vmem raise and was not drift-cancelled; larger blocks
+        # (512/4096) only look faster per-FLOP because causal tiling
+        # with coarse k-blocks performs MORE flops for the same task.
+        def _default_block(cap: int) -> int:
+            # largest sublane-aligned divisor of seq <= cap: a bare
+            # min(cap, seq) breaks seqs the old 512/2048 defaults
+            # handled (1536 % 1024 != 0). Alignment floor of 8 rejects
+            # degenerate tilings (prime seq would otherwise "succeed"
+            # with 1-row blocks and a meaningless rate) — those fall
+            # through to min(cap, seq) so make_flash_fn raises its
+            # clear must-tile error instead.
+            return next(
+                (
+                    d
+                    for d in range(min(cap, seq), 7, -1)
+                    if seq % d == 0 and d % 8 == 0
+                ),
+                min(cap, seq),
+            )
+
+        bq = block_q if block_q is not None else _default_block(256)
+        bk = block_k if block_k is not None else _default_block(1024)
 
         key = jax.random.PRNGKey(11)
         kq, kk, kv = jax.random.split(key, 3)
@@ -518,6 +542,16 @@ def run_flashattn_probe(
             if causal
             else 4.0 * heads * seq * seq * head_dim
         )
+        # tiling-INDEPENDENT useful work: the exact causal triangle
+        # (each query attends to q+1 keys), no credit for masked-region
+        # compute a coarse tiling performs. ``tflops`` rewards tilings
+        # that do more redundant work; ``tflops_effective`` is the
+        # task-level number two tilings can be honestly compared on.
+        flops_effective = (
+            4.0 * heads * head_dim * seq * (seq + 1) / 2.0
+            if causal
+            else 4.0 * heads * seq * seq * head_dim
+        )
         if on_tpu:
             from tpu_operator.workloads.timing import chain_per_iter_seconds
 
@@ -530,6 +564,7 @@ def run_flashattn_probe(
 
             per_iter = chain_per_iter_seconds(step, q, force, iters)
             tflops = flops / per_iter / 1e12
+            tflops_effective = flops_effective / per_iter / 1e12
             elapsed = per_iter * iters
             from tpu_operator.workloads.matmul import device_generation
             from tpu_operator.workloads.topology import PEAK_BF16_TFLOPS
@@ -543,6 +578,7 @@ def run_flashattn_probe(
                 )
         else:
             tflops = 0.0  # interpret mode: numerics only
+            tflops_effective = 0.0
             elapsed = 0.0
         return FlashAttnResult(
             ok=True,
@@ -554,6 +590,7 @@ def run_flashattn_probe(
             causal=causal,
             max_err=max_err,
             tflops=tflops,
+            tflops_effective=tflops_effective,
             elapsed_s=elapsed,
         )
     except Exception as e:

@@ -56,3 +56,44 @@ def chain_per_iter_seconds(step: Callable, x, force: Callable, iters: int) -> fl
     # lower-middle on even counts: with [plain, sub0] the overhead-corrected
     # estimate must win, not the overhead-inclusive plain mean
     return candidates[(len(candidates) - 1) // 2]
+
+def adjacent_ratio_stats(
+    measure: Callable,
+    base,
+    cands: dict,
+    reps: int = 9,
+    transform: Callable = None,
+):
+    """Drift-cancelled A/B comparison on a chip whose state wanders by
+    the hour: each rep times every candidate ADJACENT to a fresh base
+    measurement and records ``base/candidate`` (>1 means the candidate
+    is faster) — slow drift multiplies both sides of a rep equally, so
+    the ratio isolates the kernel/structure difference the raw numbers
+    bury. Returns ``{key: (median, iqr_lo, iqr_hi, ratios)}``.
+
+    ``measure(fn) -> seconds`` is supplied by the caller (typically a
+    ``chain_per_iter_seconds`` closure). ``transform(key, base_s,
+    cand_s) -> ratio`` overrides the plain wall ratio — e.g. the
+    per-performed-FLOP comparator in ``scripts/fa_blocktune.py``
+    (whose docstring explains why wall time is the honest default).
+    """
+    import statistics
+
+    ratios = {k: [] for k in cands}
+    for _ in range(reps):
+        for k_, fn in cands.items():
+            b = measure(base)
+            c = measure(fn)
+            ratios[k_].append(
+                transform(k_, b, c) if transform is not None else b / c
+            )
+    out = {}
+    for k_, rs in ratios.items():
+        rs = sorted(rs)
+        out[k_] = (
+            statistics.median(rs),
+            rs[len(rs) // 4],
+            rs[-1 - len(rs) // 4],
+            rs,
+        )
+    return out

@@ -1,0 +1,38 @@
+"""Gate self-test on the real chip (round-4 verdict #4's 'done' bar):
+run the SHIPPED probe with the deliberate-degradation knobs' blocks
+(64/1024) next to an adjacent matmul, compute vs_matmul exactly the way
+bench.py does, and assert the shipped floor flunks it. Exit 0 means the
+gate catches the regression; exit 1 means it wouldn't."""
+import sys
+
+from tpu_operator.workloads.flashattn import run_flashattn_probe
+from tpu_operator.workloads.matmul import run_matmul_validation
+
+
+def main() -> int:
+    sys.path.insert(0, ".")
+    from bench import FLASHATTN_VS_MATMUL_FLOOR, flashattn_gate_ok
+
+    runs = [
+        run_flashattn_probe(
+            seq=8192, heads=8, block_q=64, block_k=1024, expect_tpu=True
+        )
+        for _ in range(3)
+    ]
+    fa = max(runs, key=lambda r: r.tflops if r.ok else -1.0)
+    mm = run_matmul_validation(size=8192, depth=8, iters=4, expect_tpu=True)
+    if not (fa.ok and mm.ok and mm.tflops):
+        print(f"measurement failed: fa={fa.error} mm={mm.error}")
+        return 1
+    ratio = fa.tflops / mm.tflops
+    tripped = not flashattn_gate_ok(ratio, on_tpu=True)
+    print(
+        f"degraded 64/1024: fa={fa.tflops:.1f} TFLOPS adjacent "
+        f"mm={mm.tflops:.1f} vs_matmul={ratio:.4f} "
+        f"floor={FLASHATTN_VS_MATMUL_FLOOR} gate_tripped={tripped}"
+    )
+    return 0 if tripped else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,106 @@
+"""The bench's flash-attention regression gate and the drift-cancelled
+measurement helper — the two pieces of round-5's perf methodology that
+can be proven without a chip.
+
+The gate decides ``bench.py``'s exit code (round-4 verdict #4: a kernel
+regression must not record a green bench); ``adjacent_ratio_stats`` is
+the comparator every round-5 tuning decision rode
+(docs/flashattn-roofline.md)."""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_gate_trips_below_floor_and_on_missing_ratio():
+    bench = _load_bench()
+    floor = bench.FLASHATTN_VS_MATMUL_FLOOR
+    assert floor == 0.60  # round-5 ratchet; move with the doc's band
+    # healthy band (0.70-0.80 measured) passes
+    assert bench.flashattn_gate_ok(0.70, on_tpu=True)
+    assert bench.flashattn_gate_ok(floor, on_tpu=True)  # boundary
+    # a real regression trips (deliberate 64/1024 degradation measures
+    # vs_matmul ~0.40-0.47)
+    assert not bench.flashattn_gate_ok(0.47, on_tpu=True)
+    assert not bench.flashattn_gate_ok(floor - 1e-6, on_tpu=True)
+    # a failed adjacent-matmul denominator is a failed MEASUREMENT
+    assert not bench.flashattn_gate_ok(None, on_tpu=True)
+    # off-TPU there is no hardware ratio to gate
+    assert bench.flashattn_gate_ok(None, on_tpu=False)
+    assert bench.flashattn_gate_ok(0.1, on_tpu=False)
+
+
+def test_gate_floor_env_override(monkeypatch):
+    monkeypatch.setenv("BENCH_FLASHATTN_VS_MATMUL_FLOOR", "0.9")
+    bench = _load_bench()
+    assert not bench.flashattn_gate_ok(0.8, on_tpu=True)
+    assert bench.flashattn_gate_ok(0.95, on_tpu=True)
+
+
+def test_adjacent_ratio_stats_cancels_drift():
+    """A candidate that is a constant 2x faster must read speedup 2.0
+    even when the 'chip' drifts 10x across reps — the drift multiplies
+    both sides of each adjacent pair."""
+    from tpu_operator.workloads.timing import adjacent_ratio_stats
+
+    drift = {"t": 0}
+
+    def measure(fn):
+        drift["t"] += 1
+        scale = 1.0 + (drift["t"] % 7)  # wandering chip state
+        return fn() * scale
+
+    base = lambda: 1.0  # noqa: E731
+    fast = lambda: 0.5  # noqa: E731
+    stats = adjacent_ratio_stats(measure, base, {"fast": fast}, reps=5)
+    med, lo, hi, ratios = stats["fast"]
+    assert len(ratios) == 5
+    # adjacent pairs see DIFFERENT drift scales (t increments between
+    # the base and candidate measurement), so raw ratios vary — but the
+    # median is robustly near 2x and the IQR brackets it
+    assert lo <= med <= hi
+    assert 1.0 < med
+
+
+def test_adjacent_ratio_stats_exact_when_drift_is_slow():
+    """With drift constant within a rep (the real chip's seconds-scale
+    wander vs the microsecond measurement), every ratio is exact."""
+    from tpu_operator.workloads.timing import adjacent_ratio_stats
+
+    rep_scale = iter([1.0, 1.0, 3.0, 3.0, 10.0, 10.0])
+
+    def measure(fn):
+        return fn() * next(rep_scale)
+
+    stats = adjacent_ratio_stats(
+        measure, lambda: 1.0, {"fast": lambda: 0.25}, reps=3
+    )
+    med, lo, hi, ratios = stats["fast"]
+    assert ratios == [4.0, 4.0, 4.0]
+    assert (med, lo, hi) == (4.0, 4.0, 4.0)
+
+
+def test_adjacent_ratio_stats_transform_hook():
+    from tpu_operator.workloads.timing import adjacent_ratio_stats
+
+    def transform(key, b, c):
+        assert key == "k"
+        return (b / c) * 0.5  # e.g. a per-FLOP normalization
+
+    stats = adjacent_ratio_stats(
+        lambda fn: fn(), lambda: 2.0, {"k": lambda: 1.0}, reps=2,
+        transform=transform,
+    )
+    med, lo, hi, ratios = stats["k"]
+    assert ratios == [1.0, 1.0]

@@ -42,9 +42,9 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 # EXIT CODE rides it — a kernel regression (wrong blocks, broken
 # pipeline) cannot record a green bench. Ratcheted 0.55 -> 0.60 in
 # round 5 with the 256/1024 retune: the measured healthy band at the
-# shipped point is 0.70-0.80 across sessions (docs/flashattn-
-# roofline.md), and 0.60 sits two noise-bands (2x ±0.05) below the
-# band's low end — a real regression trips, chip-hour noise does not.
+# shipped point is 0.68-0.80 across sessions (docs/flashattn-
+# roofline.md), and 0.60 sits well over one noise-band (±0.05) below
+# the band's low end — a real regression trips, chip noise does not.
 # Ratchet from the doc's measured band, not from historical ratios.
 FLASHATTN_VS_MATMUL_FLOOR = float(
     os.environ.get("BENCH_FLASHATTN_VS_MATMUL_FLOOR", "0.60")
@@ -320,14 +320,20 @@ def run_validator_cli_chain() -> dict:
                     except (OSError, json.JSONDecodeError):
                         pass
                 if proc.returncode == 0 and entry["status_file"]:
-                    if comp == "membw":
-                        if best is None or entry.get("gbps", 0) > best.get(
-                            "gbps", 0
+                    if comp in ("membw", "flashattn"):
+                        # best-of-3 for the chip-window-sensitive
+                        # components, same estimator as the in-process
+                        # axes (a single CLI flash run read 95.1 TFLOPS
+                        # minutes after the in-process axis read 124 —
+                        # the window, not the binary)
+                        metric = "gbps" if comp == "membw" else "tflops"
+                        if best is None or entry.get(metric, 0) > best.get(
+                            metric, 0
                         ):
                             best = entry
                         continue  # best-of-3: keep measuring
                     break
-            if comp == "membw" and best is not None:
+            if comp in ("membw", "flashattn") and best is not None:
                 entry = best
                 proc_rc_ok = True
             else:

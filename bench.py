@@ -314,7 +314,9 @@ def run_validator_cli_chain() -> dict:
                     try:
                         with open(status_file) as f:
                             payload = json.load(f)
-                        for key in ("tflops", "gbps", "platform"):
+                        for key in (
+                            "tflops", "tflops_effective", "gbps", "platform"
+                        ):
                             if key in payload:
                                 entry[key] = payload[key]
                     except (OSError, json.JSONDecodeError):

@@ -40,14 +40,15 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 # flash-attention regression gate (round-4 verdict #4): the adjacent-
 # matmul ratio is the chip-state-invariant comparator, and the bench
 # EXIT CODE rides it — a kernel regression (wrong blocks, broken
-# pipeline) cannot record a green bench. Ratcheted 0.55 -> 0.60 in
-# round 5 with the 256/1024 retune: the measured healthy band at the
-# shipped point is 0.68-0.80 across sessions (docs/flashattn-
-# roofline.md), and 0.60 sits well over one noise-band (±0.05) below
-# the band's low end — a real regression trips, chip noise does not.
-# Ratchet from the doc's measured band, not from historical ratios.
+# pipeline) cannot record a green bench. Round-5 floor 0.57: four
+# healthy sessions at the shipped 256/1024 point measured 0.643-0.799
+# while the deliberately-degraded class measures 0.40-0.47
+# (docs/flashattn-roofline.md), so the floor sits at the midpoint of
+# the separation gap — a real regression trips, a bad-but-healthy
+# chip window does not. Ratchet from the doc's measured populations,
+# not from historical ratios or wishful margins.
 FLASHATTN_VS_MATMUL_FLOOR = float(
-    os.environ.get("BENCH_FLASHATTN_VS_MATMUL_FLOOR", "0.60")
+    os.environ.get("BENCH_FLASHATTN_VS_MATMUL_FLOOR", "0.57")
 )
 # deliberate-degradation knobs (gate self-test: block 64/1024 measures
 # ~0.59x the tuned per-FLOP rate -> vs_matmul ~0.40-0.47, well under

@@ -26,8 +26,8 @@ def _load_bench():
 def test_gate_trips_below_floor_and_on_missing_ratio():
     bench = _load_bench()
     floor = bench.FLASHATTN_VS_MATMUL_FLOOR
-    assert floor == 0.60  # round-5 ratchet; move with the doc's band
-    # healthy band (0.70-0.80 measured) passes
+    assert floor == 0.57  # round-5 separator midpoint; move with the doc
+    # healthy band (0.64-0.80 measured) passes
     assert bench.flashattn_gate_ok(0.70, on_tpu=True)
     assert bench.flashattn_gate_ok(floor, on_tpu=True)  # boundary
     # a real regression trips (deliberate 64/1024 degradation measures

@@ -1,7 +1,8 @@
 """Pallas flash-attention probe (hot-op depth): numerics vs the f32
 oracle in interpret mode (CPU CI), the exact-FLOPs accounting for causal
 tiling, and the validator component wiring. On the real chip this kernel
-measures ~55-60% of v5e matmul peak at seq 8192 vs ~0.7 TFLOPS for XLA's
+measures 0.64-0.80 of an adjacent matmul at seq 8192 (round-5 256/1024
+retune, docs/flashattn-roofline.md) vs ~0.7 TFLOPS for XLA's
 materialized-scores attention at the same shape."""
 
 import numpy as np
@@ -167,3 +168,26 @@ def test_probe_default_blocks_divide_nonpow2_seq():
     res = run_flashattn_probe(seq=1536, heads=2)
     assert res.ok, res.error
     assert res.seq == 1536
+
+
+def test_default_blocks_are_the_shipped_operating_point():
+    """Locks the round-5 retune: at the flagship shape the defaults must
+    be exactly 256/1024 (docs/flashattn-roofline.md) — a silent change
+    here would shift every recorded bench axis."""
+    from tpu_operator.workloads import flashattn as fa
+
+    captured = {}
+    orig = fa.make_flash_fn
+
+    def spy(seq, heads, head_dim=fa.LANES, block_q=256, block_k=1024,
+            *a, **kw):
+        captured["bq"], captured["bk"] = block_q, block_k
+        return orig(seq, heads, head_dim, block_q, block_k, *a, **kw)
+
+    fa.make_flash_fn = spy
+    try:
+        res = fa.run_flashattn_probe(seq=2048, heads=1)
+    finally:
+        fa.make_flash_fn = orig
+    assert res.ok, res.error
+    assert (captured["bq"], captured["bk"]) == (256, 1024)

@@ -1,4 +1,11 @@
-"""Scratch experiment: candidate flashattn kernel structures vs shipped."""
+"""Structural-variant instrument (cited from docs/flashattn-roofline.md):
+candidate flashattn kernel structures measured against the shipped
+kernel with the drift-cancelled adjacent-ratio comparator. Usage:
+``python fa_experiment.py [paired bf16s paired16]`` from scripts/.
+Every candidate measured at both operating points lost (see the doc's
+variants table); kept so future structure ideas start from a working
+harness instead of a fresh single-shot measurement (which misleads —
+the chip wanders 103-161 TFLOPS by the hour)."""
 import functools, sys
 import jax, jax.numpy as jnp
 from jax import lax

@@ -6,24 +6,37 @@ Primary metric (unchanged from round 1): achieved bf16 TFLOPS of the
 jax-validation matmul vs the chip's rated peak (the reference's CUDA
 vectorAdd is pass/fail only; BASELINE.md).
 
-Extra fields (VERDICT r1 item 2 — prove the telemetry path on the real
-chip and track every perf axis round-over-round):
+Extra fields (accumulated round-over-round; every hardware number comes
+from the SHIPPED binaries at the shipped operating points):
 
-* ``membw_*`` — achieved HBM bandwidth (pallas DMA copy + XLA stream);
+* ``validator_cli`` — the full validator-binary chain run as
+  subprocesses on the real chip FIRST (libtpu → runtime → jax → membw →
+  flashattn; membw and flashattn best-of-3), with
+  ``flashattn_vs_matmul`` from the chain's own numbers;
+* ``membw_*`` — achieved HBM bandwidth (pallas DMA copy + XLA stream,
+  best-of-3), plus ``membw_cli_vs_inprocess`` agreement;
+* ``flashattn`` — the pallas kernel axis: tflops, tiling-independent
+  ``tflops_effective``, the ADJACENT-matmul ``vs_matmul`` ratio the
+  exit code gates on (``flashattn_gate_ok``, floor 0.57 — the measured
+  separator between healthy and degraded populations,
+  docs/flashattn-roofline.md), and the instrumented phase
+  ``breakdown``;
 * ``telemetry`` — the dcgm-slot chain driven END TO END with values
   measured on this very run: this process (the chip owner) plays the
   sampler and writes the side-file; the native C++ hostengine
   (``native/out/tpu_metricsd``) merges it and serves :port; the
   Prometheus exporter scrapes the hostengine; the rendered series must
   be non-zero or the bench exits 1;
-* ``convergence`` / ``convergence_fleet`` — operator time-to-Ready
-  (single node via the shipped dev loop; a 16-node pool over the kubesim
-  wire) — BASELINE's second headline metric;
+* ``convergence`` / ``convergence_fleet[_200|_1000]`` /
+  ``fleet_populated_20k_pods`` — operator time-to-Ready from the dev
+  loop and kubesim-wire fleets, with apiserver requests/reconcile and
+  peak RSS;
 * ``ici_cpu_mesh`` — the ring-collective probe on the virtual 8-device
   CPU mesh (one real chip has no ICI neighbors; the CPU number tracks
   probe regressions, not hardware).
 
-Prints exactly one JSON line.
+Prints exactly one JSON line; exits non-zero if ANY axis fails or the
+flash gate trips.
 """
 
 import json

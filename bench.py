@@ -70,6 +70,28 @@ _FA_BLOCK_Q = int(os.environ.get("BENCH_FLASHATTN_BLOCK_Q", "0")) or None
 _FA_BLOCK_K = int(os.environ.get("BENCH_FLASHATTN_BLOCK_K", "0")) or None
 
 
+# zero-copy read-path regression gate (ISSUE 1): the 1000-node fleet's
+# steady-state reconcile pass rode deep-copy-per-read at 389.7 ms
+# (BENCH_r05); the frozen-view + indexed + snapshot read path is the
+# new baseline, and the gate's GENEROUS ceiling (half the old number,
+# with headroom for CI machine variance) catches an O(nodes × states)
+# regression without flaking on a slow box
+FLEET_1000_PASS_MS_OLD_BASELINE = 389.7  # r05, deep-copy read path
+FLEET_1000_PASS_MS_CEILING = float(
+    os.environ.get("BENCH_FLEET_1000_PASS_MS_CEILING", "195")
+)
+
+
+def fleet_pass_gate_ok(pass_ms, ceiling: float = None) -> bool:
+    """The 1000-node steady-state reconcile pass must exist and stay
+    under the ceiling — a missing measurement is a failed axis, not a
+    pass. Factored out so the gate that decides the bench exit code is
+    unit-testable without running the fleet."""
+    if ceiling is None:
+        ceiling = FLEET_1000_PASS_MS_CEILING
+    return pass_ms is not None and pass_ms <= ceiling
+
+
 def flashattn_gate_ok(
     ratio, on_tpu: bool, floor: float = None
 ) -> bool:
@@ -783,6 +805,14 @@ def main() -> int:
     fa_gate_ok = flashattn_gate_ok(fa_ratio, on_tpu)
     out["flashattn"]["vs_matmul_floor"] = FLASHATTN_VS_MATMUL_FLOOR
     out["flashattn"]["gate_ok"] = fa_gate_ok
+    # the zero-copy read-path gate: steady-state reconcile pass at 1000
+    # nodes must hold the post-ISSUE-1 baseline
+    pass_gate_ok = fleet_pass_gate_ok(fleet_1000.get("reconcile_pass_ms"))
+    fleet_1000["reconcile_pass_ms_ceiling"] = FLEET_1000_PASS_MS_CEILING
+    fleet_1000["reconcile_pass_ms_old_baseline"] = (
+        FLEET_1000_PASS_MS_OLD_BASELINE
+    )
+    fleet_1000["pass_gate_ok"] = pass_gate_ok
     print(json.dumps(out))
     # a failed axis is a failed bench — zeros must never be recorded as
     # a successful run (same policy as the telemetry assertion)
@@ -793,6 +823,7 @@ def main() -> int:
         and fleet.get("ok")
         and fleet_200.get("ok")
         and fleet_1000.get("ok")
+        and pass_gate_ok
         and fleet_populated.get("ok")
         and validator_cli.get("ok")
         and fa.ok

@@ -104,3 +104,26 @@ def test_adjacent_ratio_stats_transform_hook():
     )
     med, lo, hi, ratios = stats["k"]
     assert ratios == [1.0, 1.0]
+
+
+def test_fleet_pass_gate_trips_on_regression_and_missing():
+    """ISSUE 1's read-path gate: the 1000-node steady reconcile pass must
+    exist and hold the post-zero-copy baseline; the old deep-copy number
+    (389.7 ms) trips it."""
+    bench = _load_bench()
+    ceiling = bench.FLEET_1000_PASS_MS_CEILING
+    assert ceiling == 195.0  # ~half the r05 deep-copy baseline
+    assert bench.FLEET_1000_PASS_MS_OLD_BASELINE == 389.7
+    assert bench.fleet_pass_gate_ok(141.6)  # measured post-change
+    assert bench.fleet_pass_gate_ok(ceiling)  # boundary
+    assert not bench.fleet_pass_gate_ok(bench.FLEET_1000_PASS_MS_OLD_BASELINE)
+    assert not bench.fleet_pass_gate_ok(ceiling + 1e-6)
+    # a missing measurement is a failed axis, not a pass
+    assert not bench.fleet_pass_gate_ok(None)
+
+
+def test_fleet_pass_gate_ceiling_env_override(monkeypatch):
+    monkeypatch.setenv("BENCH_FLEET_1000_PASS_MS_CEILING", "50")
+    bench = _load_bench()
+    assert not bench.fleet_pass_gate_ok(100.0)
+    assert bench.fleet_pass_gate_ok(40.0)

@@ -114,8 +114,10 @@ def test_write_through_is_immediately_visible(fake):
     client, cached = fake
     created = cached.create(cm("cm2", x="y"))
     assert created["metadata"]["resourceVersion"]
-    got = cached.get("v1", "ConfigMap", "cm2", NS)
-    assert got["data"] == {"x": "y"}
+    assert cached.get("v1", "ConfigMap", "cm2", NS)["data"] == {"x": "y"}
+    # read-modify-write: the explicit-copy path (default reads are
+    # shared frozen views)
+    got = cached.get("v1", "ConfigMap", "cm2", NS, copy=True)
     got["data"]["x"] = "z"
     cached.update(got)
     assert cached.get("v1", "ConfigMap", "cm2", NS)["data"]["x"] == "z"
@@ -170,7 +172,7 @@ def test_namespaced_informer_scoping(fake):
 
 def test_stale_watch_event_cannot_roll_back_write_through(fake):
     client, cached = fake
-    fresh = cached.get("v1", "Node", "n1")
+    fresh = cached.get("v1", "Node", "n1", copy=True)
     fresh["metadata"]["labels"]["a"] = "new"
     updated = cached.update(fresh)
     inf = cached._informers[("v1", "Node")]
@@ -789,3 +791,268 @@ def test_caller_stop_event_links_into_cache_stop():
         ), "caller stop event did not propagate to cache threads"
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# zero-copy frozen views + indexers (ISSUE 1)
+# ---------------------------------------------------------------------------
+
+
+def test_cached_reads_are_frozen_views(fake):
+    """The read-path contract: a default get/list hands back the SHARED
+    stored object; any mutation — top level or nested — raises instead
+    of corrupting cache state."""
+    from tpu_operator.kube.frozen import FrozenObjectError
+
+    client, cached = fake
+    n1 = cached.get("v1", "Node", "n1")
+    with pytest.raises(FrozenObjectError):
+        n1["metadata"]["labels"]["a"] = "mutated"
+    with pytest.raises(FrozenObjectError):
+        n1["status"] = {}
+    with pytest.raises(FrozenObjectError):
+        del n1["metadata"]
+    with pytest.raises(FrozenObjectError):
+        n1["metadata"].setdefault("annotations", {})  # inserting form
+    # the reading form of setdefault (a common steady-state idiom) works
+    assert n1["metadata"].setdefault("name") == "n1"
+    for obj in cached.list("v1", "Node"):
+        with pytest.raises(FrozenObjectError):
+            obj["metadata"]["labels"].update({"x": "y"})
+    # and the store itself was never touched
+    assert cached.get("v1", "Node", "n1")["metadata"]["labels"] == {"a": "1"}
+
+
+def test_copy_flag_yields_private_mutable(fake):
+    """``copy=True`` is the writers' opt-in: a plain, private structure
+    whose mutation never reaches the shared store."""
+    client, cached = fake
+    n1 = cached.get("v1", "Node", "n1", copy=True)
+    assert type(n1) is dict and type(n1["metadata"]) is dict
+    n1["metadata"]["labels"]["a"] = "private"
+    assert cached.get("v1", "Node", "n1")["metadata"]["labels"]["a"] == "1"
+    listed = cached.list("v1", "Node", copy=True)
+    for obj in listed:
+        obj["metadata"]["labels"]["scratch"] = "ok"
+    # deepcopy of a frozen view is the same intent as copy=True
+    import copy as _copy
+
+    view = cached.get("v1", "Node", "n2")
+    dup = _copy.deepcopy(view)
+    dup["metadata"]["labels"]["b"] = "2"
+    assert "b" not in cached.get("v1", "Node", "n2")["metadata"]["labels"]
+
+
+def test_frozen_views_support_read_idioms(fake):
+    """Frozen views must stay drop-in for every read-side idiom the
+    controllers use (isinstance walks, json, equality, sorting)."""
+    import json
+
+    client, cached = fake
+    nodes = cached.list("v1", "Node")
+    assert all(isinstance(n, dict) for n in nodes)
+    assert all(isinstance(n["metadata"], dict) for n in nodes)
+    json.dumps(nodes)  # must not explode on the subclass
+    assert sorted(n["metadata"]["name"] for n in nodes) == ["n1", "n2"]
+    assert nodes[0] == dict(nodes[0])
+
+
+def test_list_order_stable_under_incremental_maintenance():
+    """The order contract (satellite): ``list()`` returns (namespace,
+    name) order no matter the ingest order, across single events, bulk
+    replace, deletes, and resync — maintained incrementally, never by
+    re-sorting per call."""
+    import random
+
+    rng = random.Random(42)
+    inf = Informer("v1", "ConfigMap", "")
+    mk = lambda name, rv: {  # noqa: E731
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": NS, "resourceVersion": str(rv)},
+    }
+    names = [f"cm-{i:03d}" for i in range(60)]
+    shuffled = names[:]
+    rng.shuffle(shuffled)
+    inf.replace([mk(n, 1) for n in shuffled[:30]])
+    for i, n in enumerate(shuffled[30:]):
+        inf.on_event("ADDED", mk(n, 2 + i))
+    expect = sorted(names)
+    assert [o["metadata"]["name"] for o in inf.list()] == expect
+    # deletes keep the order dense
+    doomed = rng.sample(names, 20)
+    for i, n in enumerate(doomed):
+        inf.on_event("DELETED", mk(n, 100 + i))
+    expect = sorted(set(names) - set(doomed))
+    assert [o["metadata"]["name"] for o in inf.list()] == expect
+    # a resync repair (bulk path) lands sorted too
+    inf.resync([mk(n, 200) for n in expect + ["aaa-first"]], list_rv=300)
+    assert [o["metadata"]["name"] for o in inf.list()] == sorted(
+        expect + ["aaa-first"]
+    )
+
+
+def _index_health(inf):
+    """Every index bucket key must point at a live store object that
+    still carries the indexed label/field — no dead keys, no misses."""
+    with inf._lock:
+        for (k, v), keys in inf._label_index.items():
+            for key in keys:
+                obj = inf._store.get(key)
+                assert obj is not None, f"dead key {key} in label bucket {k}={v}"
+                labels = obj.get("metadata", {}).get("labels") or {}
+                assert str(labels.get(k)) == v
+        for (path, v), keys in inf._field_index.items():
+            for key in keys:
+                obj = inf._store.get(key)
+                assert obj is not None, f"dead key {key} in field bucket {path}={v}"
+        # and the reverse: every stored object is findable via its entries
+        for key, obj in inf._store.items():
+            lab, flds = inf._index_entries(obj)
+            for e in lab:
+                assert key in inf._label_index.get(e, set())
+            for e in flds:
+                assert key in inf._field_index.get(e, set())
+
+
+def test_indexed_lists_match_unindexed_scan_randomized():
+    """Property-style (seeded) contract: for randomized label sets and
+    randomized selectors, the indexed list answers EXACTLY what a brute
+    scan answers — and index maintenance survives ADDED/MODIFIED/DELETED
+    churn plus resync repairs without leaking dead keys."""
+    import random
+
+    from tpu_operator.kube.client import match_fields, match_labels
+
+    rng = random.Random(1337)
+    inf = Informer(
+        "v1",
+        "Pod",
+        "",
+        index_label_keys=("app",),
+        index_fields=("spec.nodeName",),
+    )
+    apps = ["web", "db", "cache", "batch", None]
+    nodes = [f"node-{i}" for i in range(5)] + [None]
+
+    def mk(i, rv):
+        labels = {}
+        app = rng.choice(apps)
+        if app:
+            labels["app"] = app
+        if rng.random() < 0.5:
+            labels["tier"] = rng.choice(["a", "b"])  # unindexed key
+        spec = {}
+        node = rng.choice(nodes)
+        if node:
+            spec["nodeName"] = node
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"p-{i:03d}",
+                "namespace": rng.choice([NS, "user-ns"]),
+                "resourceVersion": str(rv),
+                "labels": labels,
+            },
+            "spec": spec,
+        }
+
+    rv = 1
+    inf.replace([mk(i, rv) for i in range(80)])
+
+    def check_all():
+        _index_health(inf)
+        selectors = [
+            ({"app": "web"}, None),
+            ({"app": "db", "tier": "a"}, None),
+            ({"app": "missing-app"}, None),
+            ({"app": "web"}, {"spec.nodeName": "node-2"}),
+            (None, {"spec.nodeName": "node-0"}),
+            ({"app": "*"}, None),  # glob: not index-eligible
+            ({"!app": ""}, None),  # negation: not index-eligible
+            ({"app": ["web", "db"]}, None),  # in-list: not index-eligible
+        ]
+        for ns in ("", NS):
+            for lsel, fsel in selectors:
+                got = inf.list(ns, lsel, fsel)
+                with inf._lock:
+                    want = [
+                        obj
+                        for key, obj in sorted(inf._store.items())
+                        if (not ns or key[0] == ns)
+                        and match_labels(obj, lsel)
+                        and (not fsel or match_fields(obj, fsel))
+                    ]
+                assert got == want, (ns, lsel, fsel)
+
+    check_all()
+    # churn: interleaved adds, label/node rewrites, deletes
+    for round_ in range(3):
+        for _ in range(60):
+            rv += 1
+            op = rng.random()
+            i = rng.randrange(120)
+            if op < 0.5:
+                inf.on_event("ADDED", mk(i, rv))  # add or full rewrite
+            elif op < 0.8:
+                inf.on_event("MODIFIED", mk(i, rv))
+            else:
+                with inf._lock:
+                    existing = list(inf._store.values())
+                if existing:
+                    victim = rng.choice(existing)
+                    rv += 1
+                    dead = {
+                        "apiVersion": "v1",
+                        "kind": "Pod",
+                        "metadata": dict(
+                            victim["metadata"], resourceVersion=str(rv)
+                        ),
+                    }
+                    inf.on_event("DELETED", dead)
+        check_all()
+    # resync repair against a divergent snapshot must leave the index
+    # as healthy as event ingest does
+    rv += 1
+    snapshot = [mk(i, rv) for i in range(0, 120, 2)]
+    inf.resync(snapshot, list_rv=rv + 1)
+    check_all()
+
+
+def test_index_answers_misses_in_o1_and_counts(fake):
+    """An indexed miss (no object carries the value) is answered from
+    the empty bucket without scanning, and the read counters record the
+    indexed share for the metrics surface."""
+    client, cached = fake
+    inf = cached._informers[("v1", "Node")]
+    base = inf.read_stats()
+    # tpu.k8s.io/* is prefix-indexed on the Node informer
+    assert (
+        cached.list(
+            "v1", "Node", label_selector={consts.TPU_PRESENT_LABEL: "true"}
+        )
+        == []
+    )
+    stats = inf.read_stats()
+    assert stats["indexed_lists"] == base["indexed_lists"] + 1
+    assert stats["lists"] == base["lists"] + 1
+    assert stats["copied_reads"] == base["copied_reads"]
+    # aggregate surface: CachedClient.read_stats sums across informers
+    agg = cached.read_stats()
+    assert agg["lists"] >= stats["lists"]
+    assert agg["list_seconds"] >= 0.0
+
+
+def test_write_through_keeps_frozen_contract(fake):
+    """Objects written through the cache land back in the store frozen:
+    a subsequent default read of the same object is still guarded."""
+    from tpu_operator.kube.frozen import FrozenObjectError
+
+    client, cached = fake
+    created = cached.create(cm("wt-cm", x="1"))
+    # the write-through response itself stays mutable for the caller
+    created["data"]["x"] = "2"
+    got = cached.get("v1", "ConfigMap", "wt-cm", NS)
+    with pytest.raises(FrozenObjectError):
+        got["data"]["x"] = "3"

@@ -132,11 +132,13 @@ class _ScriptedClient:
         self.updates = 0
         self.obj = {"metadata": {"name": "n", "labels": {}}}
 
-    def get(self, av, kind, name, namespace=""):
+    def get(self, av, kind, name, namespace="", copy=False):
+        # ``copy`` accepted for Client-interface parity (a deep copy is
+        # returned either way, like every plain client)
         self.gets += 1
-        import copy
+        from copy import deepcopy
 
-        return copy.deepcopy(self.obj)
+        return deepcopy(self.obj)
 
     def update(self, obj):
         self.updates += 1

@@ -222,6 +222,22 @@ def test_probe_debug_endpoints():
         inf.drift_repairs = 3
         variables = json.loads(get("/debug/vars"))
         assert variables["informer_drift_repairs"] == 3
+        # zero-copy read-path counters ride along
+        cached.list("v1", "Node")
+        variables = json.loads(get("/debug/vars"))
+        assert variables["informer_reads"]["lists"] >= 1
+        assert variables["informer_reads"]["copied_reads"] == 0
+
+        # registered providers (build_manager wires the reconciler's
+        # snapshot stats this way); a broken one degrades to an error
+        # entry instead of taking down the surface
+        mgr.register_debug_vars("reconcile_snapshot", lambda: {"hits": 7})
+        mgr.register_debug_vars(
+            "broken", lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+        )
+        variables = json.loads(get("/debug/vars"))
+        assert variables["reconcile_snapshot"] == {"hits": 7}
+        assert variables["broken"] == {"error": "boom"}
     finally:
         srv.shutdown()
         mgr.stop()

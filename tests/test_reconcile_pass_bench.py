@@ -1,0 +1,75 @@
+"""Fleet-scale reconcile-pass micro-benchmark (slow-marked).
+
+Guards the zero-copy read path (ISSUE 1): one reconcile pass over a
+1000-node kubesim fleet walks all 18 states against the warm informer
+cache, and must stay under a GENEROUS wall-clock ceiling. The deep-copy
+read path measured ~390 ms/pass on the bench box (BENCH_r05); an
+O(nodes × states) regression (a state re-listing/copying the fleet)
+lands in the seconds, so the ceiling catches the regression class
+without flaking on a loaded CI machine. ``bench.py`` gates the precise
+number (``fleet_pass_gate_ok``); this test keeps the contract inside
+tier-1 reach (``pytest -m slow``).
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ASSETS = os.path.join(REPO, "assets")
+NS = "tpu-operator"
+
+# generous: ~4x the bench gate's 195 ms ceiling, ~2x the OLD deep-copy
+# baseline — trips on the O(nodes × states) class, not on CI noise
+PASS_MS_CEILING = float(os.environ.get("TEST_RECONCILE_PASS_MS", "800"))
+N_NODES = 1000
+
+
+@pytest.mark.slow
+def test_reconcile_pass_under_ceiling_at_1000_nodes(monkeypatch):
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        ClusterPolicyReconciler,
+    )
+    from tpu_operator.kube.cache import CachedClient
+    from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+    from tpu_operator.kube.testing import seed_cluster
+
+    monkeypatch.setenv("OPERATOR_NAMESPACE", NS)
+    server = KubeSimServer(KubeSim()).start()
+    stop = threading.Event()
+    try:
+        client = make_client(server.port)
+        client.GET_RETRY_BACKOFF_S = 0.05
+        seed_cluster(
+            client, NS, node_names=tuple(f"bench-{i}" for i in range(N_NODES))
+        )
+        cached = CachedClient(client, namespace=NS)
+        assert cached.start_informers(stop, timeout_s=120) is True
+
+        r = ClusterPolicyReconciler(cached, assets_dir=ASSETS)
+        # cold pass: labels all nodes, creates every operand (not timed —
+        # it is dominated by the 1000 label writes)
+        r.reconcile()
+
+        rounds = 5
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            r.reconcile()
+        pass_ms = (time.perf_counter() - t0) * 1000.0 / rounds
+        assert pass_ms <= PASS_MS_CEILING, (
+            f"steady reconcile pass {pass_ms:.1f} ms at {N_NODES} nodes "
+            f"(> {PASS_MS_CEILING:.0f} ms ceiling): the read path is "
+            f"scanning/copying the fleet again"
+        )
+        # the pass demonstrably rode the snapshot + zero-copy reads
+        assert r.ctrl.last_snapshot_stats["hits"] >= 1
+        reads = cached.read_stats()
+        assert reads["indexed_lists"] >= 1
+    finally:
+        stop.set()
+        server.stop()

@@ -188,3 +188,146 @@ def test_step_exception_records_failure_metric(env, monkeypatch):
     with _pytest.raises(RuntimeError, match="control exploded"):
         r.reconcile()
     assert recorded[-1] == -1
+
+
+# ---------------------------------------------------------------------------
+# zero-copy read path: frozen views, explicit-copy writers, the per-pass
+# snapshot, and the get_runtime falsy-list fix (ISSUE 1)
+# ---------------------------------------------------------------------------
+
+
+def _cached(client):
+    from tpu_operator.kube.cache import CachedClient
+
+    cached = CachedClient(client, namespace=NS)
+    assert cached.start_informers() is True
+    return cached
+
+
+def test_reconcile_converges_behind_frozen_cache(env):
+    """The full reconcile loop must run to Ready against the zero-copy
+    CachedClient — every mutator goes through the explicit-copy path, so
+    the always-on write guard stays silent (acceptance criterion: no
+    cached-view mutation escapes)."""
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            make_tpu_node("tpu-node-1"),
+        ]
+    )
+    client.create(load_cr())
+    cached = _cached(client)
+    r = ClusterPolicyReconciler(cached, assets_dir=ASSETS)
+    r.reconcile()
+    simulate_kubelet(client)
+    assert r.reconcile().ready
+    # labeling went through the copy path and CONVERGED on the apiserver
+    node = client.get("v1", "Node", "tpu-node-1")
+    assert node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] == "true"
+    # snapshot observability recorded a pass with shared reads
+    stats = r.ctrl.snapshot_stats()
+    assert stats["hits_total"] > 0
+    assert stats["last_pass"]["hit_rate"] > 0
+
+
+def test_label_tpu_nodes_thaws_only_dirty_nodes(env):
+    """label_tpu_nodes reads shared frozen views and pays a copy only
+    for nodes whose labels actually change: second pass (steady state)
+    writes nothing and copies nothing."""
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            make_tpu_node("tpu-node-1"),
+            make_tpu_node("tpu-node-2"),
+        ]
+    )
+    client.create(load_cr())
+    cached = _cached(client)
+    r = ClusterPolicyReconciler(cached, assets_dir=ASSETS)
+    r.reconcile()
+    writes = []
+    orig_update = cached.update
+
+    def counting_update(obj, **kw):
+        writes.append(obj["metadata"]["name"])
+        return orig_update(obj, **kw)
+
+    cached.update = counting_update
+    before = cached.read_stats()["copied_reads"]
+    r.reconcile()
+    node_writes = [w for w in writes if w.startswith("tpu-node")]
+    assert node_writes == [], f"steady state re-labeled: {node_writes}"
+    # the CR status read pays its explicit copies; the node labeling
+    # pass itself adds none (2 nodes scanned, 0 thawed)
+    assert cached.read_stats()["copied_reads"] - before <= 4
+
+
+def test_get_runtime_serves_listed_empty_cluster(env):
+    """The falsy-list bug: ``_nodes_cache == []`` means 'listed, zero
+    nodes' and must NOT fall back to a fresh list per call."""
+    client = FakeClient(
+        [{"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}]
+    )
+    client.create(load_cr())
+    r = ClusterPolicyReconciler(client, assets_dir=ASSETS)
+    r.reconcile()  # no TPU nodes: init ran, cache is a REAL empty list
+    assert r.ctrl._nodes_cache == []
+    calls = []
+    orig_list = client.list
+
+    def counting_list(av, kind, *a, **kw):
+        calls.append(kind)
+        return orig_list(av, kind, *a, **kw)
+
+    client.list = counting_list
+    assert r.ctrl.get_runtime() == "containerd"  # spec default, no list
+    assert r.ctrl.get_runtime() == "containerd"
+    assert "Node" not in calls, "listed-empty cluster re-listed per call"
+
+
+def test_snapshot_shares_node_scans_across_states(env):
+    """One pass, one node list: the 18 states' readiness checks share
+    the snapshot's memo instead of each listing the fleet."""
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            make_tpu_node("tpu-node-1"),
+        ]
+    )
+    client.create(load_cr())
+    cached = _cached(client)
+    r = ClusterPolicyReconciler(cached, assets_dir=ASSETS)
+    r.reconcile()
+    simulate_kubelet(client)
+    node_inf = cached._informers[("v1", "Node")]
+    before = node_inf.read_stats()["lists"]
+    assert r.reconcile().ready
+    node_lists = node_inf.read_stats()["lists"] - before
+    # init lists once; everything else hits the snapshot memo. Allow a
+    # small constant for non-state readers, but the pass must not scale
+    # list count with the 18 states.
+    assert node_lists <= 3, f"{node_lists} node lists in one pass"
+    # the memo demonstrably shared reads within the pass (how many
+    # depends on where the pass resumed in the 18-state walk)
+    assert r.ctrl.last_snapshot_stats["hits"] >= 1
+
+
+def test_snapshot_lifecycle_scoped_to_pass(env):
+    """begin_pass/end_pass bracket reconcile: outside a pass the
+    controller has no snapshot (direct step() callers see fallback
+    reads), and each pass gets a FRESH memo."""
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            make_tpu_node("tpu-node-1"),
+        ]
+    )
+    client.create(load_cr())
+    r = ClusterPolicyReconciler(client, assets_dir=ASSETS)
+    assert r.ctrl.snapshot is None
+    r.reconcile()
+    assert r.ctrl.snapshot is None, "snapshot leaked past end_pass"
+    first = r.ctrl.last_snapshot_stats
+    r.reconcile()
+    assert r.ctrl.snapshot is None
+    assert r.ctrl.snapshot_hits_total >= first["hits"]

@@ -4,8 +4,13 @@ every caller assumes: coordinate round-trips, exact tiling, allocation
 contracts (count, uniqueness, must-include, contiguity when possible),
 and maxUnavailable scaling bounds."""
 
-from hypothesis import given, settings
-from hypothesis import strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need hypothesis"
+)
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from tpu_operator.upgrade.upgrade_state import parse_max_unavailable
 from tpu_operator.workloads import topology as topo

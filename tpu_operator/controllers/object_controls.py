@@ -953,7 +953,10 @@ def transform_kata_manager(n, ds: Obj, generation: Optional[str] = None) -> None
 
 
 def _nodes_wanting(n, ds: Obj) -> int:
-    """How many nodes match the DaemonSet's nodeSelector."""
+    """How many nodes match the DaemonSet's nodeSelector. Served from
+    the per-pass snapshot when one is open — 18 states asking about the
+    same handful of deploy-label selectors share one node scan per
+    unique selector instead of each re-listing the fleet."""
     selector = (
         ds.get("spec", {})
         .get("template", {})
@@ -961,6 +964,9 @@ def _nodes_wanting(n, ds: Obj) -> int:
         .get("nodeSelector", {})
         or {}
     )
+    snap = getattr(n, "snapshot", None)
+    if snap is not None:
+        return snap.count_nodes_matching(selector)
     count = 0
     for node in n.client.list("v1", "Node"):
         labels = node.get("metadata", {}).get("labels", {}) or {}
@@ -990,9 +996,15 @@ def is_daemonset_ready(n, ds: Obj) -> bool:
             .get(consts.LAST_APPLIED_HASH_ANNOTATION)
         )
         app = ds["spec"]["selector"]["matchLabels"].get("app")
-        pods = n.client.list(
-            "v1", "Pod", n.namespace, label_selector={"app": app}
-        )
+        snap = getattr(n, "snapshot", None)
+        if snap is not None:
+            # one indexed pod read per app per pass, shared across the
+            # OnDelete readiness checks and sweeps of all 18 states
+            pods = snap.pods_by_app(app)
+        else:
+            pods = n.client.list(
+                "v1", "Pod", n.namespace, label_selector={"app": app}
+            )
         if len(pods) < desired:
             return False
         for p in pods:

@@ -131,6 +131,38 @@ class OperatorMetrics:
             "informer_drift_repairs_total",
             "Cache objects repaired by informer resync (missed watch events)",
         )
+        # zero-copy read path (client-go indexed-store analogue): reads
+        # served from the informer stores, cumulative list latency, how
+        # many lists the indexers answered in O(result), and how many
+        # reads paid a deep copy (explicit copy=True writers only)
+        self.cache_gets = g(
+            "informer_cache_gets_total", "Gets served from informer stores"
+        )
+        self.cache_lists = g(
+            "informer_cache_lists_total", "Lists served from informer stores"
+        )
+        self.cache_list_seconds = g(
+            "informer_cache_list_seconds_total",
+            "Cumulative wall time spent inside informer list()",
+        )
+        self.cache_indexed_lists = g(
+            "informer_cache_indexed_lists_total",
+            "Informer lists answered from an index bucket (O(result))",
+        )
+        self.cache_copied_reads = g(
+            "informer_cache_copied_reads_total",
+            "Cached objects deep-copied for explicit copy=True readers",
+        )
+        # per-pass reconcile snapshot (node scans + per-app pod lists
+        # shared across the 18 states): last pass's hit/miss profile
+        self.snapshot_hits = g(
+            "reconcile_snapshot_hits",
+            "Reads served by the per-pass cluster snapshot memo (last pass)",
+        )
+        self.snapshot_misses = g(
+            "reconcile_snapshot_misses",
+            "Reads the per-pass cluster snapshot had to compute (last pass)",
+        )
 
     # -- convenience ----------------------------------------------------
     def observe_reconcile(self, status_value: int) -> None:

@@ -128,9 +128,10 @@ def validator_ready_nodes(
     """Nodes whose operator-validator pod is Running (initContainer chain
     passed — reference semantics: validator Running == node validated)."""
     ready: Set[str] = set()
-    for pod in client.list("v1", "Pod", namespace):
-        if (pod["metadata"].get("labels", {}) or {}).get("app") != app:
-            continue
+    # selector pushed into the list: the informer's app-label index
+    # answers this in O(validator pods) instead of scanning (and then
+    # discarding most of) every namespace pod
+    for pod in client.list("v1", "Pod", namespace, label_selector={"app": app}):
         if pod.get("status", {}).get("phase") != "Running":
             continue
         statuses = pod.get("status", {}).get("containerStatuses")

@@ -28,6 +28,7 @@ from tpu_operator.api.v1.clusterpolicy_types import (
     clusterpolicy_from_obj,
 )
 from tpu_operator.controllers import object_controls
+from tpu_operator.controllers.cluster_snapshot import ClusterSnapshot
 from tpu_operator.controllers.resource_manager import (
     Resources,
     add_resources_controls,
@@ -39,6 +40,7 @@ from tpu_operator.kube.client import (
     Obj,
     mutate_with_retry,
 )
+from tpu_operator.kube.frozen import thaw
 
 log = logging.getLogger("tpu-operator.state")
 
@@ -123,6 +125,16 @@ def node_workload_config(node: Obj) -> str:
     return cfg
 
 
+def _apply_label_changes(node: Obj, changes: Dict[str, Optional[str]]) -> None:
+    """Apply a label delta (value ``None`` = delete) to a MUTABLE node."""
+    labels = node["metadata"].setdefault("labels", {})
+    for key, value in changes.items():
+        if value is None:
+            labels.pop(key, None)
+        else:
+            labels[key] = value
+
+
 class ClusterPolicyController:
     """reference ``ClusterPolicyController`` (``controllers/state_manager.go:133-156``)."""
 
@@ -143,12 +155,54 @@ class ClusterPolicyController:
         self.has_nfd_labels = False
         self.tpu_node_count = 0
         self.tpu_generations: Set[str] = set()
-        self._nodes_cache: List[Obj] = []
+        # the pass's node list: None = never listed (fall back to a
+        # fresh list), [] = listed and the cluster really has no nodes —
+        # the falsy-list confusion used to send zero-node clusters back
+        # to a live LIST per read
+        self._nodes_cache: Optional[List[Obj]] = None
         self.state_names: List[str] = []
         self.controls: Dict[str, List[Tuple[str, Obj]]] = {}
         self.resources: Dict[str, Resources] = {}
         self.idx = 0
         self.metrics = None  # wired by the reconciler
+        # per-pass read memo (begin_pass/end_pass); None outside a pass
+        # so direct init()/step() callers (tests) work without one
+        self.snapshot: Optional[ClusterSnapshot] = None
+        # cumulative snapshot counters across passes, for the debug
+        # surface + metrics
+        self.snapshot_hits_total = 0
+        self.snapshot_misses_total = 0
+        self.last_snapshot_stats: Dict[str, float] = {}
+
+    # ------------------------------------------------------------------
+    # pass lifecycle (controller-runtime gets this locality implicitly:
+    # one cache, one reconcile invocation; here the snapshot carries it)
+    # ------------------------------------------------------------------
+    def begin_pass(self) -> ClusterSnapshot:
+        self.snapshot = ClusterSnapshot(self.client, lambda: self.namespace)
+        return self.snapshot
+
+    def end_pass(self) -> Dict[str, float]:
+        snap, self.snapshot = self.snapshot, None
+        if snap is None:
+            return {}
+        self.last_snapshot_stats = snap.stats()
+        self.snapshot_hits_total += snap.hits
+        self.snapshot_misses_total += snap.misses
+        return self.last_snapshot_stats
+
+    def snapshot_stats(self) -> Dict[str, float]:
+        """Debug-surface payload: last pass's hit/miss profile plus the
+        process-lifetime totals."""
+        total = self.snapshot_hits_total + self.snapshot_misses_total
+        return {
+            "last_pass": self.last_snapshot_stats,
+            "hits_total": self.snapshot_hits_total,
+            "misses_total": self.snapshot_misses_total,
+            "hit_rate_total": (
+                round(self.snapshot_hits_total / total, 4) if total else 0.0
+            ),
+        }
 
     # ------------------------------------------------------------------
     # init (reference controllers/state_manager.go:743-887)
@@ -185,9 +239,16 @@ class ClusterPolicyController:
             sorted(self.tpu_generations),
         )
 
+    def _list_nodes(self) -> List[Obj]:
+        """The pass's node list — shared frozen views via the snapshot
+        when a pass is open, a direct (cached) list otherwise."""
+        if self.snapshot is not None:
+            return self.snapshot.nodes()
+        return self.client.list("v1", "Node")
+
     def _get_kubernetes_version(self) -> str:
         # no /version endpoint in the Client interface; derive from nodes
-        for node in self.client.list("v1", "Node"):
+        for node in self._list_nodes():
             v = node.get("status", {}).get("nodeInfo", {}).get("kubeletVersion")
             if v:
                 return v
@@ -213,9 +274,12 @@ class ClusterPolicyController:
         self.has_nfd_labels = False
         self.tpu_generations = set()
         self.tpu_node_count = 0
-        self._nodes_cache = self.client.list("v1", "Node")
-        for node in self._nodes_cache:
-            labels = node["metadata"].setdefault("labels", {})
+        # read SHARED frozen views; a node is thawed (copied) only when
+        # its labels actually need a write — the steady state labels
+        # nothing and copies nothing
+        final_nodes: List[Obj] = []
+        for node in self._list_nodes():
+            labels = node["metadata"].get("labels") or {}
             if any(k.startswith("feature.node.kubernetes.io/") for k in labels):
                 self.has_nfd_labels = True
             if has_tpu_labels(node):
@@ -224,7 +288,8 @@ class ClusterPolicyController:
                 gen = node_generation(node)
                 if gen:
                     self.tpu_generations.add(gen)
-            if self._apply_node_labels(node):
+            changes = self._node_label_changes(node)
+            if changes:
                 # Node labels are the shared bus: TFD, the slice manager,
                 # the maintenance handler and the upgrade FSM all write
                 # concurrently. Fast path writes the listed snapshot; a
@@ -233,11 +298,13 @@ class ClusterPolicyController:
                 # (every other Node writer already follows this
                 # discipline — kube/client.py mutate_with_retry).
                 name = node["metadata"]["name"]
+                mutable = thaw(node)
+                _apply_label_changes(mutable, changes)
                 try:
-                    self.client.update(node)
+                    node = self.client.update(mutable)
                 except ConflictError:
                     try:
-                        mutate_with_retry(
+                        node = mutate_with_retry(
                             self.client,
                             "v1",
                             "Node",
@@ -250,36 +317,54 @@ class ClusterPolicyController:
                             "requeue will converge it",
                             name,
                         )
+                        node = mutable
                     except NotFoundError:
                         # deleted between the 409 and the re-GET
                         log.info("node %s vanished during labeling", name)
+                        continue
                 except NotFoundError:
                     log.info("node %s vanished during labeling", name)
+                    continue
+            final_nodes.append(node)
+        self._nodes_cache = final_nodes
+        if self.snapshot is not None:
+            # later states re-read nodes through the snapshot; give them
+            # the post-label state, not the pass-start listing
+            self.snapshot.set_nodes(final_nodes)
 
     def _apply_node_labels(self, node: Obj) -> bool:
         """Mutate one Node's operator labels in place; returns whether
         anything changed (the ``mutate_with_retry`` contract)."""
-        labels = node["metadata"].setdefault("labels", {})
-        changed = False
+        changes = self._node_label_changes(node)
+        if not changes:
+            return False
+        _apply_label_changes(node, changes)
+        return True
+
+    def _node_label_changes(self, node: Obj) -> Dict[str, Optional[str]]:
+        """Desired operator-label delta for one node as ``{key: value}``
+        (``None`` = delete) — a PURE computation over a (possibly
+        frozen) node view; {} in the labeled steady state."""
+        labels = node["metadata"].get("labels") or {}
+        changes: Dict[str, Optional[str]] = {}
         if has_tpu_labels(node):
             gen = node_generation(node)
             if gen and labels.get(f"{consts.GROUP}/tpu.generation") != gen:
-                labels[f"{consts.GROUP}/tpu.generation"] = gen
-                changed = True
+                changes[f"{consts.GROUP}/tpu.generation"] = gen
             if labels.get(consts.TPU_PRESENT_LABEL) != "true":
-                labels[consts.TPU_PRESENT_LABEL] = "true"
-                changed = True
-            changed |= self._update_state_labels(node)
+                changes[consts.TPU_PRESENT_LABEL] = "true"
+            changes.update(self._state_label_changes(node, labels))
         elif labels.get(consts.TPU_PRESENT_LABEL):
             # TPU removed from node: strip all operator labels
             # (reference removeAllGPUStateLabels)
-            for key in list(labels):
+            for key in labels:
                 if key.startswith(f"{consts.GROUP}/"):
-                    del labels[key]
-                    changed = True
-        return changed
+                    changes[key] = None
+        return changes
 
-    def _update_state_labels(self, node: Obj) -> bool:
+    def _state_label_changes(
+        self, node: Obj, labels: Dict[str, str]
+    ) -> Dict[str, Optional[str]]:
         """Per-workload-config deploy labels (reference
         ``gpuWorkloadConfiguration.updateGPUStateLabels``, ``:354-414``)."""
         cfg = node_workload_config(node)
@@ -289,8 +374,7 @@ class ClusterPolicyController:
         else:
             enable = consts.CONTAINER_WORKLOAD_COMPONENTS
             disable = consts.VM_WORKLOAD_COMPONENTS
-        labels = node["metadata"]["labels"]
-        changed = False
+        changes: Dict[str, Optional[str]] = {}
         for comp in enable:
             key = consts.DEPLOY_LABEL_PREFIX + comp
             # don't fight a human override of "false"/"paused-*"
@@ -300,14 +384,12 @@ class ClusterPolicyController:
             ).startswith("paused-"):
                 continue
             if labels.get(key) != "true":
-                labels[key] = "true"
-                changed = True
+                changes[key] = "true"
         for comp in disable:
             key = consts.DEPLOY_LABEL_PREFIX + comp
             if key in labels:
-                del labels[key]
-                changed = True
-        return changed
+                changes[key] = None
+        return changes
 
     # ------------------------------------------------------------------
     # PSA labeling (reference setPodSecurityLabelsForNamespace, :590-638)
@@ -370,7 +452,15 @@ class ClusterPolicyController:
     # ------------------------------------------------------------------
     def get_runtime(self) -> str:
         runtime = self.cp.spec.operator.default_runtime or "containerd"
-        for node in self._nodes_cache or self.client.list("v1", "Node"):
+        # `is not None`, NOT truthiness: a listed-but-empty cluster
+        # ([] is falsy) must serve the empty pass result, not issue a
+        # fresh LIST per call
+        nodes = (
+            self._nodes_cache
+            if self._nodes_cache is not None
+            else self._list_nodes()
+        )
+        for node in nodes:
             if not has_tpu_labels(node):
                 continue
             info = (

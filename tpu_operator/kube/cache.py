@@ -39,7 +39,9 @@ from __future__ import annotations
 import copy
 import logging
 import threading
-from typing import Callable, Dict, List, Optional, Tuple
+from bisect import bisect_left, insort
+from time import perf_counter
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 from tpu_operator.kube.client import (
     Client,
@@ -49,6 +51,11 @@ from tpu_operator.kube.client import (
     match_fields,
     match_labels,
     obj_key,
+)
+from tpu_operator.kube.frozen import (  # noqa: F401  (re-exported API)
+    FrozenObjectError,
+    freeze,
+    thaw,
 )
 
 log = logging.getLogger("tpu-operator.cache")
@@ -87,6 +94,26 @@ def default_cache_specs(
     ]
 
 
+def default_index_spec(kind: str) -> Dict[str, Tuple[str, ...]]:
+    """Per-kind indexer wiring (client-go registers field/label indexers
+    per informer the same way). The hot selector lists of one reconcile
+    pass are: operand pods by ``app`` (OnDelete readiness, upgrade FSM,
+    validator sweeps), pods by ``spec.nodeName`` (drain/maintenance
+    sweeps), and nodes by operator labels (DaemonSet nodeSelector match
+    counts, deploy-label bus queries). The operator-label PREFIX entry
+    makes the node index authoritative for every ``tpu.k8s.io/...`` key."""
+    from tpu_operator import consts
+
+    if kind == "Pod":
+        return {
+            "index_label_keys": ("app",),
+            "index_fields": ("spec.nodeName",),
+        }
+    if kind == "Node":
+        return {"index_label_prefixes": (consts.GROUP + "/",)}
+    return {}
+
+
 def pod_scope_filter(namespace: str) -> Callable[[Obj], bool]:
     """Scope predicate for the cluster-wide Pod informer: keep operand
     pods (the operator's namespace) and TPU-requesting workload pods
@@ -109,15 +136,20 @@ def pod_scope_filter(namespace: str) -> Callable[[Obj], bool]:
 
 
 def _slim(obj: Obj) -> Obj:
-    """Deep-copy for the store minus ``metadata.managedFields`` — on a
-    real apiserver that block often outweighs the object itself, nothing
-    in the operator reads it, and controller-runtime's cache strips it
-    for the same reason (DefaultTransform)."""
-    out = copy.deepcopy(obj)
-    meta = out.get("metadata")
-    if isinstance(meta, dict):
-        meta.pop("managedFields", None)
-    return out
+    """Frozen store form: a private READ-ONLY copy minus
+    ``metadata.managedFields`` — on a real apiserver that block often
+    outweighs the object itself, nothing in the operator reads it, and
+    controller-runtime's cache strips it for the same reason
+    (DefaultTransform). Frozen because reads now hand out the stored
+    object itself (zero-copy, like client-go's shared cache); mutation
+    of a view raises ``FrozenObjectError``."""
+    meta = obj.get("metadata")
+    if isinstance(meta, dict) and "managedFields" in meta:
+        obj = dict(obj)
+        obj["metadata"] = {
+            k: v for k, v in meta.items() if k != "managedFields"
+        }
+    return freeze(obj)
 
 
 def _monotonic() -> float:
@@ -170,6 +202,9 @@ class Informer:
         kind: str,
         namespace: str,
         keep: Optional[Callable[[Obj], bool]] = None,
+        index_label_keys: Iterable[str] = (),
+        index_label_prefixes: Iterable[str] = (),
+        index_fields: Iterable[str] = (),
     ):
         self.api_version = api_version
         self.kind = kind
@@ -187,6 +222,27 @@ class Informer:
         self.drift_repairs = 0
         self._lock = threading.Lock()
         self._store: Dict[Tuple[str, str], Obj] = {}  # (ns, name) -> obj
+        # client-go-style indexers: exact-value selector terms over the
+        # configured label keys/prefixes and field paths are answered
+        # from these buckets in O(result) instead of O(store). A prefix
+        # entry makes the index AUTHORITATIVE for every label key under
+        # it (an empty bucket then correctly means "no object matches").
+        self._idx_label_keys: Set[str] = set(index_label_keys)
+        self._idx_label_prefixes: Tuple[str, ...] = tuple(index_label_prefixes)
+        self._idx_fields: Tuple[str, ...] = tuple(index_fields)
+        self._label_index: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        self._field_index: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        # store keys in sorted order, maintained incrementally (bisect on
+        # single-event ingest, one rebuild after a bulk replace/resync)
+        # so list() never re-sorts the whole store per call
+        self._sorted_keys: List[Tuple[str, str]] = []
+        self._sorted_ok = True
+        # read-path counters (exported via CachedClient.read_stats)
+        self.gets = 0
+        self.lists = 0
+        self.list_seconds = 0.0
+        self.indexed_lists = 0
+        self.copied_reads = 0
         # deletions observed before the initial seed lands: a concurrent
         # DELETED between list() and replace() must not be resurrected by
         # the older snapshot
@@ -199,6 +255,76 @@ class Informer:
         # outlive one resync pass.
         self._graveyard: Dict[Tuple[str, str], Tuple[Optional[int], float]] = {}
         self._graveyard_next_prune = 0.0
+
+    # -- store bookkeeping (caller holds ``_lock``) ----------------------
+    def _covers_label(self, key: str) -> bool:
+        return key in self._idx_label_keys or key.startswith(
+            self._idx_label_prefixes
+        )
+
+    def _index_entries(
+        self, obj: Obj
+    ) -> Tuple[List[Tuple[str, str]], List[Tuple[str, str]]]:
+        labels = obj.get("metadata", {}).get("labels") or {}
+        lab = [
+            (k, str(v)) for k, v in labels.items() if self._covers_label(k)
+        ]
+        flds = []
+        for path in self._idx_fields:
+            cur: object = obj
+            for part in path.split("."):
+                if not isinstance(cur, dict) or part not in cur:
+                    cur = None
+                    break
+                cur = cur[part]
+            if cur is not None and not isinstance(cur, (dict, list)):
+                flds.append((path, str(cur)))
+        return lab, flds
+
+    def _unindex_locked(self, key: Tuple[str, str], obj: Obj) -> None:
+        lab, flds = self._index_entries(obj)
+        for index, entries in (
+            (self._label_index, lab),
+            (self._field_index, flds),
+        ):
+            for e in entries:
+                bucket = index.get(e)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del index[e]
+
+    def _set_locked(self, key: Tuple[str, str], frozen: Obj) -> None:
+        have = self._store.get(key)
+        if have is not None:
+            self._unindex_locked(key, have)
+        elif self._sorted_ok:
+            insort(self._sorted_keys, key)
+        self._store[key] = frozen
+        lab, flds = self._index_entries(frozen)
+        for index, entries in (
+            (self._label_index, lab),
+            (self._field_index, flds),
+        ):
+            for e in entries:
+                index.setdefault(e, set()).add(key)
+
+    def _del_locked(self, key: Tuple[str, str]) -> Optional[Obj]:
+        have = self._store.pop(key, None)
+        if have is None:
+            return None
+        self._unindex_locked(key, have)
+        if self._sorted_ok:
+            i = bisect_left(self._sorted_keys, key)
+            if i < len(self._sorted_keys) and self._sorted_keys[i] == key:
+                del self._sorted_keys[i]
+        return have
+
+    def _sorted_keys_locked(self) -> List[Tuple[str, str]]:
+        if not self._sorted_ok:
+            self._sorted_keys = sorted(self._store)
+            self._sorted_ok = True
+        return self._sorted_keys
 
     def _prune_graveyard_locked(self, now: float) -> None:
         """TTL-expire graveyard entries; caller holds ``_lock``."""
@@ -235,7 +361,7 @@ class Informer:
                 if old_rv is not None and new_rv is not None and new_rv < old_rv:
                     return
             if etype == "DELETED":
-                self._store.pop(key, None)
+                self._del_locked(key)
                 now = _monotonic()
                 if now >= self._graveyard_next_prune:
                     self._graveyard_next_prune = now + GRAVEYARD_PRUNE_EVERY_S
@@ -244,7 +370,7 @@ class Informer:
                 if not self.synced.is_set():
                     self._tombstones[key] = _rv_int(obj) or 0
             elif etype in ("ADDED", "MODIFIED"):
-                self._store[key] = _slim(obj)
+                self._set_locked(key, _slim(obj))
 
     def replace(self, objs: List[Obj]) -> None:
         """Guarded seed from an initial list. Events may already have
@@ -254,6 +380,7 @@ class Informer:
         if self.keep is not None:
             objs = [o for o in objs if self.keep(o)]
         with self._lock:
+            self._sorted_ok = False  # bulk seed: one rebuild at the end
             for o in objs:
                 meta = o.get("metadata", {})
                 key = (meta.get("namespace", ""), meta.get("name", ""))
@@ -266,8 +393,9 @@ class Informer:
                     old_rv = _rv_int(have)
                     if old_rv is not None and rv is not None and rv < old_rv:
                         continue  # a live event already delivered newer state
-                self._store[key] = _slim(o)
+                self._set_locked(key, _slim(o))
             self._tombstones.clear()
+            self._sorted_keys_locked()
         self.synced.set()
 
     def resync(
@@ -301,6 +429,7 @@ class Informer:
                 if key[1]:
                     fresh[key] = o
             self._prune_graveyard_locked(_monotonic())
+            self._sorted_ok = False  # bulk repair: one rebuild at the end
             for key, o in fresh.items():
                 have = self._store.get(key)
                 if have is None:
@@ -317,17 +446,17 @@ class Informer:
                             # watch already buried (no further event
                             # would ever remove it again)
                             continue
-                    self._store[key] = _slim(o)
+                    self._set_locked(key, _slim(o))
                     repairs.append(("ADDED", o))
                     continue
                 old_rv, new_rv = _rv_int(have), _rv_int(o)
                 if old_rv is not None and new_rv is not None:
                     if new_rv > old_rv:
-                        self._store[key] = _slim(o)
+                        self._set_locked(key, _slim(o))
                         repairs.append(("MODIFIED", o))
                 elif have != _slim(o):
                     # opaque rvs: can't order, repair on inequality
-                    self._store[key] = _slim(o)
+                    self._set_locked(key, _slim(o))
                     repairs.append(("MODIFIED", o))
             for key in [k for k in self._store if k not in fresh]:
                 have = self._store[key]
@@ -338,38 +467,112 @@ class Informer:
                     and have_rv > list_rv
                 ):
                     continue  # created after the snapshot; watch will tell
-                del self._store[key]
+                self._del_locked(key)
                 repairs.append(("DELETED", have))
             self.drift_repairs += len(repairs)
+            self._sorted_keys_locked()
         return repairs
 
     # -- reads -----------------------------------------------------------
-    def get(self, name: str, namespace: str = "") -> Obj:
+    def get(self, name: str, namespace: str = "", copy: bool = False) -> Obj:
+        """Read one object. Default is a SHARED read-only view of the
+        stored object (zero-copy; mutation raises ``FrozenObjectError``);
+        ``copy=True`` returns a private mutable copy for
+        read-modify-write callers."""
         with self._lock:
             obj = self._store.get((namespace or "", name))
             if obj is None:
                 raise NotFoundError(
                     f"{self.kind} {namespace}/{name} not found (cache)"
                 )
-            return copy.deepcopy(obj)
+            self.gets += 1
+            if copy:
+                self.copied_reads += 1
+                return thaw(obj)
+            return obj
+
+    def _candidate_keys_locked(
+        self, label_selector, field_selector
+    ) -> Optional[Set[Tuple[str, str]]]:
+        """Smallest index-bucket intersection answering the selector, or
+        None when no indexed term applies (full scan). Only exact-value
+        terms are index-eligible; the caller still runs the full match on
+        the candidates, so a partial index narrowing stays correct."""
+        buckets: List[Set[Tuple[str, str]]] = []
+        if isinstance(label_selector, dict):
+            for k, v in label_selector.items():
+                if k.startswith("!") or not self._covers_label(k):
+                    continue
+                if v is None or isinstance(v, (list, tuple)):
+                    continue
+                v = str(v)
+                if not v or "*" in v:
+                    continue
+                buckets.append(self._label_index.get((k, v), set()))
+        if isinstance(field_selector, dict):
+            for path, v in field_selector.items():
+                if path in self._idx_fields:
+                    buckets.append(
+                        self._field_index.get((path, str(v)), set())
+                    )
+        if not buckets:
+            return None
+        buckets.sort(key=len)
+        out = buckets[0]
+        for b in buckets[1:]:
+            out = out & b
+            if not out:
+                break
+        return out
 
     def list(
         self,
         namespace: str = "",
         label_selector=None,
         field_selector=None,
+        copy: bool = False,
     ) -> List[Obj]:
+        """List in stable (namespace, name) order. Default returns SHARED
+        read-only views (zero-copy); ``copy=True`` thaws each result.
+        Exact-value selector terms over indexed label keys/field paths
+        are served from index buckets in O(result)."""
+        t0 = perf_counter()
         with self._lock:
+            candidates = self._candidate_keys_locked(
+                label_selector, field_selector
+            )
+            if candidates is None:
+                keys: Iterable[Tuple[str, str]] = self._sorted_keys_locked()
+            else:
+                self.indexed_lists += 1
+                keys = sorted(candidates)
             out = []
-            for (ns, _), obj in sorted(self._store.items()):
-                if namespace and ns != namespace:
+            for key in keys:
+                obj = self._store.get(key)
+                if obj is None:
+                    continue  # raced by a test poking _store directly
+                if namespace and key[0] != namespace:
                     continue
                 if not match_labels(obj, label_selector):
                     continue
                 if field_selector and not match_fields(obj, field_selector):
                     continue
-                out.append(copy.deepcopy(obj))
+                out.append(thaw(obj) if copy else obj)
+            self.lists += 1
+            if copy:
+                self.copied_reads += len(out)
+            self.list_seconds += perf_counter() - t0
             return out
+
+    def read_stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "gets": self.gets,
+                "lists": self.lists,
+                "list_seconds": round(self.list_seconds, 6),
+                "indexed_lists": self.indexed_lists,
+                "copied_reads": self.copied_reads,
+            }
 
     def __len__(self):
         with self._lock:
@@ -412,6 +615,7 @@ class CachedClient(Client):
                     if kind == "Pod" and not ns and namespace
                     else None
                 ),
+                **default_index_spec(kind),
             )
             for av, kind, ns in specs
         }
@@ -663,13 +867,32 @@ class CachedClient(Client):
             for (_, kind), inf in self._informers.items()
         }
 
+    def read_stats(self) -> Dict[str, float]:
+        """Aggregated zero-copy read-path counters across every informer
+        (the observability half of the zero-copy contract): total
+        gets/lists served from cache, cumulative list latency, how many
+        lists the indexers answered, and how many reads paid a copy
+        (the explicit ``copy=True`` writers)."""
+        totals = {
+            "gets": 0,
+            "lists": 0,
+            "list_seconds": 0.0,
+            "indexed_lists": 0,
+            "copied_reads": 0,
+        }
+        for inf in self._informers.values():
+            for k, v in inf.read_stats().items():
+                totals[k] += v
+        totals["list_seconds"] = round(totals["list_seconds"], 6)
+        return totals
+
     # -- reads -----------------------------------------------------------
-    def get(self, api_version, kind, name, namespace=""):
+    def get(self, api_version, kind, name, namespace="", copy=False):
         inf = self._informer_for(api_version, kind, namespace)
         if inf is None:
             return self.live.get(api_version, kind, name, namespace)
         try:
-            return inf.get(name, namespace)
+            return inf.get(name, namespace, copy=copy)
         except NotFoundError:
             if inf.keep is not None and namespace != self.namespace:
                 # a scoped informer cannot prove absence outside its
@@ -702,6 +925,7 @@ class CachedClient(Client):
         namespace="",
         label_selector=None,
         field_selector=None,
+        copy=False,
     ):
         inf = self._informer_for(api_version, kind, namespace)
         if inf is None:
@@ -717,7 +941,7 @@ class CachedClient(Client):
             return self.live.list(
                 api_version, kind, namespace, label_selector, field_selector
             )
-        return inf.list(namespace, label_selector, field_selector)
+        return inf.list(namespace, label_selector, field_selector, copy=copy)
 
     def list_scoped(
         self,
@@ -726,6 +950,7 @@ class CachedClient(Client):
         namespace="",
         label_selector=None,
         field_selector=None,
+        copy=False,
     ):
         """Served from the informer even when scope-filtered — the
         caller asserts its filter ⊆ the scope (see Client.list_scoped)."""
@@ -734,7 +959,7 @@ class CachedClient(Client):
             return self.live.list(
                 api_version, kind, namespace, label_selector, field_selector
             )
-        return inf.list(namespace, label_selector, field_selector)
+        return inf.list(namespace, label_selector, field_selector, copy=copy)
 
     # -- writes (pass through + write-through the response) --------------
     def _write_through(self, obj: Obj) -> None:

@@ -20,6 +20,7 @@ from __future__ import annotations
 import copy
 import fnmatch
 import threading
+from copy import deepcopy
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 Obj = Dict[str, Any]
@@ -64,7 +65,9 @@ def mutate_with_retry(
         if attempt:
             time.sleep(backoff_s * attempt)
         if attempt == 0:
-            obj = client.get(api_version, kind, name, namespace)
+            # copy=True: the informer-backed client otherwise hands back
+            # a SHARED frozen view, and mutate() is about to mutate
+            obj = client.get(api_version, kind, name, namespace, copy=True)
         else:
             # after a 409 the read MUST be live: a CachedClient's store
             # may not have ingested the conflicting write yet, and
@@ -144,11 +147,25 @@ def match_labels(obj: Obj, selector) -> bool:
 
 class Client:
     """Interface all controllers use. Mirrors the subset of
-    controller-runtime's client the reference exercises."""
+    controller-runtime's client the reference exercises.
+
+    Read contract (``copy``): with ``copy=False`` (the default) the
+    result MAY be a shared read-only view — the informer-backed
+    ``CachedClient`` serves zero-copy frozen views, and mutating one
+    raises ``FrozenObjectError``. A caller that intends to mutate the
+    result (read-modify-write) MUST pass ``copy=True``, which guarantees
+    a private mutable object. Plain clients (FakeClient, RestClient)
+    always return private objects and simply ignore the flag, so passing
+    ``copy=True`` is portable across every implementation."""
 
     # -- reads ----------------------------------------------------------
     def get(
-        self, api_version: str, kind: str, name: str, namespace: str = ""
+        self,
+        api_version: str,
+        kind: str,
+        name: str,
+        namespace: str = "",
+        copy: bool = False,
     ) -> Obj:
         raise NotImplementedError
 
@@ -159,6 +176,7 @@ class Client:
         namespace: str = "",
         label_selector: Optional[Dict[str, str]] = None,
         field_selector: Optional[Dict[str, str]] = None,
+        copy: bool = False,
     ) -> List[Obj]:
         raise NotImplementedError
 
@@ -227,6 +245,7 @@ class Client:
         namespace: str = "",
         label_selector=None,
         field_selector=None,
+        copy: bool = False,
     ) -> List[Obj]:
         """List that MAY be served from a scope-filtered cache. By
         calling this the caller asserts its own filter is a subset of
@@ -235,14 +254,20 @@ class Client:
         user selectors does not (use ``list_live``). On plain clients
         this IS ``list``."""
         return self.list(
-            api_version, kind, namespace, label_selector, field_selector
+            api_version, kind, namespace, label_selector, field_selector,
+            copy=copy,
         )
 
     def get_or_none(
-        self, api_version: str, kind: str, name: str, namespace: str = ""
+        self,
+        api_version: str,
+        kind: str,
+        name: str,
+        namespace: str = "",
+        copy: bool = False,
     ) -> Optional[Obj]:
         try:
-            return self.get(api_version, kind, name, namespace)
+            return self.get(api_version, kind, name, namespace, copy=copy)
         except NotFoundError:
             return None
 
@@ -300,12 +325,14 @@ class FakeClient(Client):
             fn(event, copy.deepcopy(obj))
 
     # -- reads ----------------------------------------------------------
-    def get(self, api_version, kind, name, namespace=""):
+    def get(self, api_version, kind, name, namespace="", copy=False):
+        # ``copy`` accepted for Client-interface parity; FakeClient
+        # always returns a private deep copy, so the flag is a no-op
         with self._lock:
             key = (api_version, kind, namespace or "", name)
             if key not in self._store:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
-            return copy.deepcopy(self._store[key])
+            return deepcopy(self._store[key])
 
     def list(
         self,
@@ -314,6 +341,7 @@ class FakeClient(Client):
         namespace="",
         label_selector=None,
         field_selector=None,
+        copy=False,
     ):
         with self._lock:
             out = []
@@ -326,7 +354,7 @@ class FakeClient(Client):
                     continue
                 if field_selector and not self._match_fields(obj, field_selector):
                     continue
-                out.append(copy.deepcopy(obj))
+                out.append(deepcopy(obj))
             return out
 
     def list_with_rv(self, api_version, kind, namespace=""):

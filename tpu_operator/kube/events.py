@@ -69,7 +69,9 @@ def record_event(
         ).hexdigest()[:12]
         name = f"{meta.get('name', 'unknown')}.{key}"
         now = _now()
-        existing = client.get_or_none("v1", "Event", name, namespace)
+        # copy=True: the bump path mutates the Event in place, and the
+        # Event informer would otherwise hand back a shared frozen view
+        existing = client.get_or_none("v1", "Event", name, namespace, copy=True)
         if existing is not None:
             existing["count"] = int(existing.get("count", 1)) + 1
             existing["lastTimestamp"] = now

@@ -194,7 +194,9 @@ class RestClient(Client):
             conn.close()
 
     # -- Client interface -------------------------------------------------
-    def get(self, api_version, kind, name, namespace=""):
+    def get(self, api_version, kind, name, namespace="", copy=False):
+        # ``copy`` accepted for Client-interface parity; every REST read
+        # is freshly parsed JSON, so the result is always private
         return self._request(
             "GET", _resource_path(api_version, kind, namespace, name)
         )
@@ -206,6 +208,7 @@ class RestClient(Client):
         namespace="",
         label_selector=None,
         field_selector=None,
+        copy=False,
     ) -> List[Obj]:
         path = _resource_path(api_version, kind, namespace)
         params = {}

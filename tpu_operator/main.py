@@ -132,6 +132,11 @@ def build_manager(
     )
     reconciler = ClusterPolicyReconciler(client, assets_dir=assets_dir)
     mgr.add_reconciler(CP_KEY, lambda _key: reconciler.reconcile())
+    # /debug/vars: the per-pass snapshot's hit/miss profile sits next to
+    # cache_info so one curl answers "is the read path actually shared?"
+    mgr.register_debug_vars(
+        "reconcile_snapshot", reconciler.ctrl.snapshot_stats
+    )
     upgrade = UpgradeReconciler(client, namespace)
     mgr.add_reconciler(UPGRADE_KEY, lambda _key: upgrade.reconcile())
     return mgr, reconciler, upgrade

@@ -219,6 +219,21 @@ class _HealthHandler(BaseHTTPRequestHandler):
                 payload["informer_drift_repairs"] = (
                     m.client.drift_repairs_total()
                 )
+            if hasattr(m.client, "read_stats"):
+                # zero-copy read path counters: cache gets/lists served,
+                # cumulative list latency, indexed-list share, and how
+                # many reads paid an explicit copy
+                payload["informer_reads"] = m.client.read_stats()
+            for var_name, fn in (m._debug_vars if m else {}).items():
+                # registered providers (e.g. the reconciler's per-pass
+                # snapshot hit rates); a broken provider must not take
+                # down the whole debug surface
+                try:
+                    value = fn()
+                    json.dumps(value)  # unserializable == broken provider
+                    payload[var_name] = value
+                except Exception as e:  # noqa: BLE001
+                    payload[var_name] = {"error": str(e)}
             body = json.dumps(payload)
             self._respond(200, body, "application/json")
             return
@@ -275,10 +290,18 @@ class Manager:
         self._stop = threading.Event()
         self._last_reconcile_ok = True
         self._threads = []
+        # extra /debug/vars payload fragments: name -> zero-arg callable
+        # returning a JSON-serializable value (e.g. the reconciler's
+        # per-pass snapshot hit rates)
+        self._debug_vars = {}
 
     def add_reconciler(self, key: str, fn: Callable[[str], object]) -> None:
         """``fn(name) -> Result`` (with optional ``requeue_after``)."""
         self._reconcilers[key] = fn
+
+    def register_debug_vars(self, name: str, fn: Callable[[], object]) -> None:
+        """Attach a provider to the /debug/vars payload."""
+        self._debug_vars[name] = fn
 
     def enqueue(self, key: str, delay: float = 0.0) -> None:
         self.queue.add(key, delay)

@@ -352,13 +352,16 @@ class PodManager:
             pass  # metrics are never load-bearing for the drain itself
 
     def operand_pods_on_node(self, node_name: str, app: str) -> List[Obj]:
-        return [
-            p
-            for p in self.client.list(
-                "v1", "Pod", self.namespace, label_selector={"app": app}
-            )
-            if p.get("spec", {}).get("nodeName") == node_name
-        ]
+        # both terms are indexed on the Pod informer (app label +
+        # spec.nodeName field): the informer answers this from a bucket
+        # intersection in O(result)
+        return self.client.list(
+            "v1",
+            "Pod",
+            self.namespace,
+            label_selector={"app": app},
+            field_selector={"spec.nodeName": node_name},
+        )
 
 
 class DrainManager:
@@ -399,11 +402,15 @@ class ValidationManager:
         self.namespace = namespace
 
     def validate(self, node_name: str) -> bool:
+        # app + spec.nodeName are both informer-indexed: one bucket
+        # intersection instead of scanning the namespace pods per node
         for pod in self.client.list(
-            "v1", "Pod", self.namespace, label_selector={"app": self.APP}
+            "v1",
+            "Pod",
+            self.namespace,
+            label_selector={"app": self.APP},
+            field_selector={"spec.nodeName": node_name},
         ):
-            if pod.get("spec", {}).get("nodeName") != node_name:
-                continue
             return pod.get("status", {}).get("phase") == "Running"
         return False
 
@@ -544,10 +551,20 @@ class ClusterUpgradeStateManager:
         # but still quadratic CPU at fleet scale
         pods_by_node = self._driver_pods_by_node()
         managed_nodes: List[Obj] = []
-        for node in self.client.list("v1", "Node"):
+        # the libtpu-managed filter rides the Node informer's
+        # tpu.k8s.io/ prefix index (O(managed), not O(fleet)), and
+        # copy=True pays the private-copy tax only for those nodes —
+        # FSM steps mutate the in-hand objects (set_annotation keeps
+        # them coherent mid-pass)
+        for node in self.client.list(
+            "v1",
+            "Node",
+            label_selector={
+                consts.DEPLOY_LABEL_PREFIX + consts.COMPONENT_LIBTPU: "true"
+            },
+            copy=True,
+        ):
             labels = node.get("metadata", {}).get("labels", {}) or {}
-            if labels.get(consts.DEPLOY_LABEL_PREFIX + consts.COMPONENT_LIBTPU) != "true":
-                continue
             # slice membership spans nodes the FSM skips (skip-labeled,
             # entry-deferred): their validators still gate slice-scoped
             # validation

@@ -27,8 +27,8 @@ DOCKER_PUSH_TARGETS = $(patsubst %,docker-push-%,$(IMAGES))
 # declared AFTER the target lists exist: a .PHONY on an undefined
 # variable expands to nothing and silently un-phonies the fan-out
 .PHONY: all native test crd bundle release-bundle validate lint clean \
-	dev-run dev-run-kubesim soak bench builder docker-build docker-push \
-	$(DOCKER_BUILD_TARGETS) $(DOCKER_PUSH_TARGETS)
+	dev-run dev-run-kubesim soak bench bench-gate builder docker-build \
+	docker-push $(DOCKER_BUILD_TARGETS) $(DOCKER_PUSH_TARGETS)
 
 all: native crd bundle
 
@@ -80,6 +80,11 @@ docker-push: $(DOCKER_PUSH_TARGETS)
 
 bench:
 	python bench.py
+
+# CI perf gate without the chip: the slow-marked 1000-node steady-state
+# reconcile pass (read path + render cache) must hold its ceiling
+bench-gate:
+	python -m pytest tests/test_reconcile_pass_bench.py -q -m slow -p no:cacheprovider
 
 # run the operator against the in-memory cluster and converge to Ready
 dev-run:

@@ -70,15 +70,20 @@ _FA_BLOCK_Q = int(os.environ.get("BENCH_FLASHATTN_BLOCK_Q", "0")) or None
 _FA_BLOCK_K = int(os.environ.get("BENCH_FLASHATTN_BLOCK_K", "0")) or None
 
 
-# zero-copy read-path regression gate (ISSUE 1): the 1000-node fleet's
-# steady-state reconcile pass rode deep-copy-per-read at 389.7 ms
-# (BENCH_r05); the frozen-view + indexed + snapshot read path is the
-# new baseline, and the gate's GENEROUS ceiling (half the old number,
-# with headroom for CI machine variance) catches an O(nodes × states)
-# regression without flaking on a slow box
+# steady-state reconcile-pass regression gate (ISSUE 1 + ISSUE 2): the
+# 1000-node fleet's pass rode deep-copy-per-read at 389.7 ms (BENCH_r05),
+# dropped to ~100.7 ms with the zero-copy read path (PR 1), and to
+# ~15-24 ms with the memoized render pipeline + world-unchanged label/
+# slice short-circuits (ISSUE 2 same-box A/B: mean 22.0-23.9, min
+# 14.6-16.8 vs PR 1's mean 90.6-182.7, min 67.5-73.5 on a noisy box).
+# The GENEROUS 50 ms ceiling is ~2x the measured mean — a render-per-pass
+# or O(nodes × states) regression lands far above it; the gate prefers
+# the min-of-rounds measurement (nothing deflates a min; a scheduler
+# hiccup inflates a mean)
 FLEET_1000_PASS_MS_OLD_BASELINE = 389.7  # r05, deep-copy read path
+FLEET_1000_PASS_MS_PR1_BASELINE = 100.7  # PR 1, render-per-pass
 FLEET_1000_PASS_MS_CEILING = float(
-    os.environ.get("BENCH_FLEET_1000_PASS_MS_CEILING", "195")
+    os.environ.get("BENCH_FLEET_1000_PASS_MS_CEILING", "50")
 )
 
 
@@ -805,12 +810,20 @@ def main() -> int:
     fa_gate_ok = flashattn_gate_ok(fa_ratio, on_tpu)
     out["flashattn"]["vs_matmul_floor"] = FLASHATTN_VS_MATMUL_FLOOR
     out["flashattn"]["gate_ok"] = fa_gate_ok
-    # the zero-copy read-path gate: steady-state reconcile pass at 1000
-    # nodes must hold the post-ISSUE-1 baseline
-    pass_gate_ok = fleet_pass_gate_ok(fleet_1000.get("reconcile_pass_ms"))
+    # the hot-loop gate: steady-state reconcile pass at 1000 nodes must
+    # hold the post-ISSUE-2 baseline (zero-copy reads + memoized renders).
+    # Gated on the min-of-rounds when the harness reports it — the
+    # noise-robust statistic — falling back to the mean
+    gated_pass_ms = fleet_1000.get("reconcile_pass_ms_min")
+    if gated_pass_ms is None:
+        gated_pass_ms = fleet_1000.get("reconcile_pass_ms")
+    pass_gate_ok = fleet_pass_gate_ok(gated_pass_ms)
     fleet_1000["reconcile_pass_ms_ceiling"] = FLEET_1000_PASS_MS_CEILING
     fleet_1000["reconcile_pass_ms_old_baseline"] = (
         FLEET_1000_PASS_MS_OLD_BASELINE
+    )
+    fleet_1000["reconcile_pass_ms_pr1_baseline"] = (
+        FLEET_1000_PASS_MS_PR1_BASELINE
     )
     fleet_1000["pass_gate_ok"] = pass_gate_ok
     print(json.dumps(out))

@@ -107,16 +107,21 @@ def test_adjacent_ratio_stats_transform_hook():
 
 
 def test_fleet_pass_gate_trips_on_regression_and_missing():
-    """ISSUE 1's read-path gate: the 1000-node steady reconcile pass must
-    exist and hold the post-zero-copy baseline; the old deep-copy number
-    (389.7 ms) trips it."""
+    """The hot-loop gate (ISSUE 1 reads + ISSUE 2 renders): the
+    1000-node steady reconcile pass must exist and hold the
+    post-render-cache baseline; both the deep-copy number (389.7 ms)
+    and the render-per-pass number (100.7 ms) trip it."""
     bench = _load_bench()
     ceiling = bench.FLEET_1000_PASS_MS_CEILING
-    assert ceiling == 195.0  # ~half the r05 deep-copy baseline
+    assert ceiling == 50.0  # ~2x the ISSUE-2 measured mean (22.0-23.9)
     assert bench.FLEET_1000_PASS_MS_OLD_BASELINE == 389.7
-    assert bench.fleet_pass_gate_ok(141.6)  # measured post-change
+    assert bench.FLEET_1000_PASS_MS_PR1_BASELINE == 100.7
+    assert bench.fleet_pass_gate_ok(23.9)  # measured post-change mean
+    assert bench.fleet_pass_gate_ok(14.6)  # measured post-change min
     assert bench.fleet_pass_gate_ok(ceiling)  # boundary
+    # a regression back to EITHER old world trips the gate
     assert not bench.fleet_pass_gate_ok(bench.FLEET_1000_PASS_MS_OLD_BASELINE)
+    assert not bench.fleet_pass_gate_ok(bench.FLEET_1000_PASS_MS_PR1_BASELINE)
     assert not bench.fleet_pass_gate_ok(ceiling + 1e-6)
     # a missing measurement is a failed axis, not a pass
     assert not bench.fleet_pass_gate_ok(None)

@@ -20,6 +20,24 @@ def test_workqueue_dedup_and_delay():
     assert q.get(timeout=0.05) is None
 
 
+def test_workqueue_get_zero_timeout_is_nonblocking_poll():
+    """Regression: ``timeout if timeout else None`` treated the falsy
+    ``timeout=0`` as "no deadline" — get(timeout=0) blocked forever on an
+    empty queue instead of polling."""
+    q = WorkQueue()
+    t0 = time.monotonic()
+    assert q.get(timeout=0) is None
+    assert time.monotonic() - t0 < 0.5
+    # a due item is still returned by the poll
+    q.add("a")
+    assert q.get(timeout=0) == "a"
+    # an item that is not yet due is NOT returned early
+    q.add("b", delay=5.0)
+    t0 = time.monotonic()
+    assert q.get(timeout=0) is None
+    assert time.monotonic() - t0 < 0.5
+
+
 def test_rate_limiter_backoff_and_forget():
     rl = RateLimiter(base=0.1, cap=3.0)
     assert rl.when("x") == 0.1
@@ -238,6 +256,19 @@ def test_probe_debug_endpoints():
         variables = json.loads(get("/debug/vars"))
         assert variables["reconcile_snapshot"] == {"hits": 7}
         assert variables["broken"] == {"error": "boom"}
+
+        # the render cache rides the same provider hook (build_manager
+        # wires reconciler.ctrl.render_cache.stats as "render_cache"):
+        # fingerprint + hit profile must serialize onto the surface
+        from tpu_operator.controllers.render_cache import RenderCache
+
+        rc = RenderCache()
+        rc.begin_pass("base-fp", {"v5e"})
+        mgr.register_debug_vars("render_cache", rc.stats)
+        variables = json.loads(get("/debug/vars"))
+        assert variables["render_cache"]["fingerprint"]
+        assert variables["render_cache"]["entries"] == 0
+        assert variables["render_cache"]["last_pass"]["hit_rate"] == 0.0
     finally:
         srv.shutdown()
         mgr.stop()

@@ -1,0 +1,336 @@
+"""Labels-only merge patch (ISSUE 2 satellite): the node-labeling bus
+writes a label DELTA instead of PUTting the whole Node — no
+resourceVersion rides along, so there is no 409 window against the
+other label writers, and the payload shrinks to the changed keys.
+
+Covered here: FakeClient's native merge, the generic read-modify-write
+fallback on the base ``Client``, the real HTTP PATCH wire against
+kubesim, and the ``CachedClient`` write-through."""
+
+import threading
+
+import pytest
+
+from tpu_operator.kube import FakeClient
+from tpu_operator.kube.cache import CachedClient
+from tpu_operator.kube.client import Client, NotFoundError
+from tpu_operator.kube.testing import make_tpu_node
+
+NS = "tpu-operator"
+
+
+def node(name, labels=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": dict(labels or {})},
+    }
+
+
+# ---------------------------------------------------------------------------
+# FakeClient (native in-store merge)
+# ---------------------------------------------------------------------------
+
+
+def test_fake_client_patch_labels_sets_and_deletes():
+    client = FakeClient([node("n1", {"keep": "x", "drop": "y"})])
+    updated = client.patch_labels(
+        "v1", "Node", "n1", labels={"added": "1", "drop": None}
+    )
+    labels = updated["metadata"]["labels"]
+    assert labels == {"keep": "x", "added": "1"}
+    assert client.get("v1", "Node", "n1")["metadata"]["labels"] == labels
+
+
+def test_fake_client_patch_labels_noop_does_not_bump_rv():
+    client = FakeClient([node("n1", {"a": "1"})])
+    rv = client.get("v1", "Node", "n1")["metadata"]["resourceVersion"]
+    out = client.patch_labels("v1", "Node", "n1", labels={"a": "1"})
+    assert out["metadata"]["resourceVersion"] == rv
+
+
+def test_fake_client_unconditional_patch_is_last_writer_wins():
+    """Without a resourceVersion the patch applies to whatever revision
+    is current (apiserver merge-patch semantics) — valid only for keys
+    no other actor writes."""
+    client = FakeClient([node("n1", {"a": "1"})])
+    other = client.get("v1", "Node", "n1")
+    other["metadata"]["labels"]["other-writer"] = "yes"
+    client.update(other)  # rv moved under us
+    updated = client.patch_labels("v1", "Node", "n1", labels={"mine": "too"})
+    assert updated["metadata"]["labels"] == {
+        "a": "1",
+        "other-writer": "yes",
+        "mine": "too",
+    }
+
+
+def test_fake_client_conditional_patch_conflicts_on_stale_rv():
+    """With the observed resourceVersion attached, a concurrent write
+    409s instead of being silently overwritten — the caller recomputes
+    its delta from the fresh object."""
+    from tpu_operator.kube.client import ConflictError
+
+    client = FakeClient([node("n1", {"a": "1"})])
+    seen = client.get("v1", "Node", "n1")
+    other = client.get("v1", "Node", "n1")
+    other["metadata"]["labels"]["other-writer"] = "yes"
+    client.update(other)
+    with pytest.raises(ConflictError):
+        client.patch_labels(
+            "v1",
+            "Node",
+            "n1",
+            labels={"mine": "too"},
+            resource_version=seen["metadata"]["resourceVersion"],
+        )
+    # at the fresh rv the same patch lands
+    fresh = client.get("v1", "Node", "n1")
+    updated = client.patch_labels(
+        "v1",
+        "Node",
+        "n1",
+        labels={"mine": "too"},
+        resource_version=fresh["metadata"]["resourceVersion"],
+    )
+    assert updated["metadata"]["labels"]["mine"] == "too"
+    assert updated["metadata"]["labels"]["other-writer"] == "yes"
+
+
+def test_fake_client_patch_labels_not_found():
+    client = FakeClient()
+    with pytest.raises(NotFoundError):
+        client.patch_labels("v1", "Node", "ghost", labels={"a": "1"})
+
+
+# ---------------------------------------------------------------------------
+# generic base-Client fallback (read-modify-write with conflict retry)
+# ---------------------------------------------------------------------------
+
+
+class MinimalClient(Client):
+    """A Client WITHOUT native PATCH — only get/update — so
+    ``Client.patch_labels``'s generic fallback is what runs."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def get(self, api_version, kind, name, namespace="", copy=False):
+        return self._inner.get(api_version, kind, name, namespace, copy=copy)
+
+    def update(self, obj):
+        return self._inner.update(obj)
+
+
+def test_base_client_fallback_applies_delta():
+    inner = FakeClient([node("n1", {"keep": "x", "drop": "y"})])
+    client = MinimalClient(inner)
+    updated = client.patch_labels(
+        "v1", "Node", "n1", labels={"added": "1", "drop": None}
+    )
+    assert updated["metadata"]["labels"] == {"keep": "x", "added": "1"}
+    assert inner.get("v1", "Node", "n1")["metadata"]["labels"] == {
+        "keep": "x",
+        "added": "1",
+    }
+
+
+def test_base_client_fallback_noop_short_circuits():
+    inner = FakeClient([node("n1", {"a": "1"})])
+    client = MinimalClient(inner)
+    rv = inner.get("v1", "Node", "n1")["metadata"]["resourceVersion"]
+    client.patch_labels("v1", "Node", "n1", labels={"a": "1"})
+    assert inner.get("v1", "Node", "n1")["metadata"]["resourceVersion"] == rv
+
+
+# ---------------------------------------------------------------------------
+# kubesim wire (real HTTP PATCH, application/merge-patch+json)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def kubesim_client():
+    from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+
+    server = KubeSimServer(KubeSim()).start()
+    try:
+        yield make_client(server.port), server
+    finally:
+        server.stop()
+
+
+def test_kubesim_patch_labels_wire(kubesim_client):
+    client, server = kubesim_client
+    client.create(make_tpu_node("n1"))
+    before_rv = client.get("v1", "Node", "n1")["metadata"]["resourceVersion"]
+
+    updated = client.patch_labels(
+        "v1",
+        "Node",
+        "n1",
+        labels={"tpu.k8s.io/tpu.present": "true", "kubernetes.io/hostname": None},
+    )
+    labels = updated["metadata"]["labels"]
+    assert labels["tpu.k8s.io/tpu.present"] == "true"
+    assert "kubernetes.io/hostname" not in labels
+    # only labels changed; the rest of the node survived the merge
+    assert updated["status"]["nodeInfo"]["containerRuntimeVersion"].startswith(
+        "containerd"
+    )
+    assert updated["metadata"]["resourceVersion"] != before_rv
+    # PATCH is counted as a (non-watch) apiserver request
+    assert server.sim.requests_total() > 0
+
+
+def test_kubesim_unconditional_patch_is_last_writer_wins(kubesim_client):
+    client, _ = kubesim_client
+    client.create(make_tpu_node("n1"))
+    # another writer bumps the rv between our read and our patch
+    other = client.get("v1", "Node", "n1")
+    other["metadata"]["labels"]["other"] = "writer"
+    client.update(other)
+    updated = client.patch_labels("v1", "Node", "n1", labels={"mine": "too"})
+    assert updated["metadata"]["labels"]["other"] == "writer"
+    assert updated["metadata"]["labels"]["mine"] == "too"
+
+
+def test_kubesim_conditional_patch_conflicts_on_stale_rv(kubesim_client):
+    from tpu_operator.kube.client import ConflictError
+
+    client, _ = kubesim_client
+    client.create(make_tpu_node("n1"))
+    seen = client.get("v1", "Node", "n1")
+    other = client.get("v1", "Node", "n1")
+    other["metadata"]["labels"]["other"] = "writer"
+    client.update(other)
+    with pytest.raises(ConflictError):
+        client.patch_labels(
+            "v1",
+            "Node",
+            "n1",
+            labels={"mine": "too"},
+            resource_version=seen["metadata"]["resourceVersion"],
+        )
+
+
+def test_kubesim_patch_missing_object_404(kubesim_client):
+    client, _ = kubesim_client
+    with pytest.raises(NotFoundError):
+        client.patch_labels("v1", "Node", "ghost", labels={"a": "1"})
+
+
+def test_kubesim_patch_emits_modified_watch_event(kubesim_client):
+    client, _ = kubesim_client
+    client.create(make_tpu_node("n1"))
+    got = []
+    stop = threading.Event()
+    t = threading.Thread(
+        target=client.watch,
+        args=("v1", "Node", lambda e, o: got.append((e, o["metadata"]["name"]))),
+        kwargs={"stop_event": stop},
+        daemon=True,
+    )
+    t.start()
+    try:
+        from tests.conftest import wait_until
+
+        assert wait_until(lambda: ("ADDED", "n1") in got, 10)
+        client.patch_labels("v1", "Node", "n1", labels={"patched": "true"})
+        assert wait_until(lambda: ("MODIFIED", "n1") in got, 10)
+    finally:
+        stop.set()
+
+
+# ---------------------------------------------------------------------------
+# the race the conditional patch exists for
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_pause_override_survives_label_race(monkeypatch):
+    """A human sets a deploy label to "false" (the documented pause
+    override) between the operator's informer read and its label write.
+    The rv-conditioned patch 409s and the retry RECOMPUTES the delta
+    from the fresh node — the pause must never be reverted by the
+    operator's stale "true" decision."""
+    import os
+
+    import yaml
+
+    from tpu_operator import consts
+    from tpu_operator.controllers.state_manager import ClusterPolicyController
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+    paused_key = consts.DEPLOY_LABEL_PREFIX + "device-plugin"
+
+    class RacingClient:
+        """Forwards everything; the FIRST label patch loses a race: an
+        admin writes the pause right before it, so its observed rv is
+        stale."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.raced = False
+
+        def patch_labels(
+            self, av, kind, name, namespace="", labels=None,
+            resource_version=None,
+        ):
+            if not self.raced and labels and paused_key in labels:
+                self.raced = True
+                self._inner.patch_labels(
+                    av, kind, name, namespace, labels={paused_key: "false"}
+                )
+            return self._inner.patch_labels(
+                av, kind, name, namespace, labels=labels,
+                resource_version=resource_version,
+            )
+
+        def __getattr__(self, attr):
+            return getattr(self._inner, attr)
+
+    inner = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            make_tpu_node("race-node"),
+        ]
+    )
+    with open(
+        os.path.join(repo, "config", "samples", "v1_clusterpolicy.yaml")
+    ) as f:
+        cr = yaml.safe_load(f)
+    cr["metadata"]["uid"] = "race-uid"
+    inner.create(cr)
+
+    client = RacingClient(inner)
+    c = ClusterPolicyController(client, assets_dir=os.path.join(repo, "assets"))
+    c.init(inner.get("tpu.k8s.io/v1", "ClusterPolicy", "cluster-policy"))
+
+    assert client.raced, "the race injection never fired"
+    labels = inner.get("v1", "Node", "race-node")["metadata"]["labels"]
+    assert labels[paused_key] == "false", "stale delta reverted the pause"
+    # the rest of the operator's labels still converged on the retry
+    assert labels[consts.TPU_PRESENT_LABEL] == "true"
+    assert labels[consts.DEPLOY_LABEL_PREFIX + "libtpu"] == "true"
+
+
+# ---------------------------------------------------------------------------
+# CachedClient write-through
+# ---------------------------------------------------------------------------
+
+
+def test_cached_client_patch_labels_writes_through():
+    client = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            node("n1", {"a": "1"}),
+        ]
+    )
+    cached = CachedClient(client, namespace=NS)
+    assert cached.start_informers() is True
+    updated = cached.patch_labels(
+        "v1", "Node", "n1", labels={"b": "2", "a": None}
+    )
+    assert updated["metadata"]["labels"] == {"b": "2"}
+    # immediately visible through the informer store (no watch latency)
+    assert cached.get("v1", "Node", "n1")["metadata"]["labels"] == {"b": "2"}

@@ -1,0 +1,409 @@
+"""Memoized manifest render pipeline (ISSUE 2).
+
+The render cache must make a steady-state reconcile pass render NOTHING
+(every control serves its frozen pre-hashed manifest), invalidate on
+exactly the inputs the desired-state fingerprint covers (spec edit,
+runtime change, CR recreate) at exactly the right granularity (a new
+TPU generation renders one DaemonSet, not the world), and hand out
+manifests that loudly reject mutation."""
+
+import logging
+import os
+
+import pytest
+import yaml
+
+from tests.conftest import make_cpu_node, make_tpu_node
+from tpu_operator import consts
+from tpu_operator.controllers.state_manager import ClusterPolicyController
+from tpu_operator.kube import FakeClient
+from tpu_operator.kube.frozen import FrozenObjectError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ASSETS = os.path.join(REPO, "assets")
+SAMPLE_CR = os.path.join(REPO, "config", "samples", "v1_clusterpolicy.yaml")
+NS = "tpu-operator"
+CPV = "tpu.k8s.io/v1"
+
+
+def load_sample_cr():
+    with open(SAMPLE_CR) as f:
+        obj = yaml.safe_load(f)
+    obj["metadata"]["uid"] = "render-cache-uid-1"
+    return obj
+
+
+def make_ctrl(monkeypatch, nodes=None, cr_edit=None):
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+    if nodes is None:
+        nodes = [
+            make_tpu_node("tpu-node-1"),
+            make_tpu_node(
+                "tpu-node-2", accelerator="tpu-v5p-slice", topology="2x2x1"
+            ),
+            make_cpu_node("cpu-node-1"),
+        ]
+    client = FakeClient(
+        [{"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}]
+        + nodes
+    )
+    cr = load_sample_cr()
+    if cr_edit:
+        cr_edit(cr)
+    client.create(cr)
+    c = ClusterPolicyController(client, assets_dir=ASSETS)
+    c.init(client.get(CPV, "ClusterPolicy", "cluster-policy"))
+    return c
+
+
+def run_states(c):
+    c.idx = 0
+    statuses = {}
+    while not c.last():
+        name = c.state_names[c.idx]
+        statuses[name] = c.step()
+    return statuses
+
+
+def reinit(c):
+    c.init(c.client.get(CPV, "ClusterPolicy", "cluster-policy"))
+
+
+# ---------------------------------------------------------------------------
+# steady state: zero renders
+# ---------------------------------------------------------------------------
+
+
+def test_steady_state_pass_renders_nothing(monkeypatch):
+    c = make_ctrl(monkeypatch)
+    run_states(c)
+    first = c.render_cache.renders_total
+    assert first > 0  # the cold pass rendered the world
+    assert c.render_cache.fingerprint
+
+    # second reconcile: same spec, same cluster facts -> pure cache
+    reinit(c)
+    run_states(c)
+    stats = c.render_cache.stats()
+    assert c.render_cache.renders_total == first, "steady pass re-rendered"
+    assert stats["last_pass"]["misses"] == 0
+    assert stats["last_pass"]["hits"] >= len(c.state_names)
+    assert stats["last_pass"]["hit_rate"] == 1.0
+    assert stats["invalidations"] == 0
+    # fingerprint is stable across identical passes
+    assert stats["fingerprint"] == c.render_cache.fingerprint
+    # the amortized cost is visible per state
+    assert stats["render_ms_by_state"], "render cost not attributed"
+
+
+def test_steady_state_still_idempotent_and_converged(monkeypatch):
+    """The cached path must apply the SAME hashes the rendered path did:
+    no object churns when the render step is skipped."""
+    c = make_ctrl(monkeypatch)
+    run_states(c)
+    before = {
+        (o["kind"], o["metadata"].get("namespace", ""), o["metadata"]["name"]):
+            o["metadata"]["resourceVersion"]
+        for o in c.client.all_objects()
+    }
+    reinit(c)
+    run_states(c)
+    after = {
+        (o["kind"], o["metadata"].get("namespace", ""), o["metadata"]["name"]):
+            o["metadata"]["resourceVersion"]
+        for o in c.client.all_objects()
+    }
+    churned = {
+        k: (before[k], after[k])
+        for k in before
+        if k in after and before[k] != after[k]
+    }
+    assert not churned, f"cached reconcile churned objects: {churned}"
+
+
+def test_cache_hit_still_repairs_external_drift(monkeypatch):
+    """The short-circuit skips the RENDER, never the apply gate: an
+    externally mutated operand must still be repaired from the cached
+    manifest on the next pass."""
+    c = make_ctrl(monkeypatch)
+    run_states(c)
+    ds = c.client.get("apps/v1", "DaemonSet", "tpu-device-plugin-daemonset", NS)
+    ds["metadata"]["annotations"][consts.LAST_APPLIED_HASH_ANNOTATION] = "tampered"
+    ds["spec"]["template"]["spec"]["containers"][0]["image"] = "evil:latest"
+    c.client.update(ds)
+    renders_before = c.render_cache.renders_total
+    reinit(c)
+    run_states(c)
+    assert c.render_cache.renders_total == renders_before  # no re-render
+    repaired = c.client.get(
+        "apps/v1", "DaemonSet", "tpu-device-plugin-daemonset", NS
+    )
+    assert (
+        repaired["spec"]["template"]["spec"]["containers"][0]["image"]
+        == "gcr.io/tpu-operator/tpu-device-plugin:0.9.0"
+    )
+
+
+# ---------------------------------------------------------------------------
+# invalidation granularity
+# ---------------------------------------------------------------------------
+
+
+def test_spec_edit_invalidates_and_rerenders(monkeypatch):
+    c = make_ctrl(monkeypatch)
+    run_states(c)
+    first = c.render_cache.renders_total
+    fp_before = c.render_cache.fingerprint
+
+    cr = c.client.get(CPV, "ClusterPolicy", "cluster-policy")
+    cr["spec"]["devicePlugin"]["env"] = [
+        {"name": "RENDER_CACHE_TEST", "value": "1"}
+    ]
+    c.client.update(cr)
+    reinit(c)
+    assert c.render_cache.fingerprint != fp_before
+    run_states(c)
+    stats = c.render_cache.stats()
+    assert stats["invalidations"] == 1
+    assert c.render_cache.renders_total > first  # world re-rendered
+    # and the re-render actually carried the edit onto the cluster
+    ds = c.client.get("apps/v1", "DaemonSet", "tpu-device-plugin-daemonset", NS)
+    env = {
+        e["name"]: e.get("value")
+        for e in ds["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["RENDER_CACHE_TEST"] == "1"
+
+
+def test_new_generation_renders_exactly_one_entry(monkeypatch):
+    def enable_fanout(cr):
+        cr["spec"]["libtpu"]["generationConfigs"] = {
+            "v5e": "2025.1.0-v5e",
+            "v5p": "2025.1.0-v5p",
+        }
+
+    c = make_ctrl(monkeypatch, cr_edit=enable_fanout)
+    run_states(c)
+    first = c.render_cache.renders_total
+    entries_before = len(c.render_cache)
+    fp_before = c.render_cache.fingerprint
+
+    # a v4 pool appears: ONLY the new generation's libtpu DS renders
+    c.client.create(make_tpu_node("tpu-node-3", accelerator="tpu-v4-podslice"))
+    reinit(c)
+    assert c.tpu_generations == {"v4", "v5e", "v5p"}
+    assert c.render_cache.fingerprint != fp_before  # generations are in it
+    run_states(c)
+    stats = c.render_cache.stats()
+    assert c.render_cache.renders_total == first + 1, (
+        "a new generation must render exactly its own DaemonSet, "
+        f"not {c.render_cache.renders_total - first} manifests"
+    )
+    assert stats["invalidations"] == 0  # base fingerprint held
+    assert len(c.render_cache) == entries_before + 1
+    assert c.client.get("apps/v1", "DaemonSet", "tpu-libtpu-daemonset-v4", NS)
+
+
+def test_removed_generation_drops_entry_without_rerender(monkeypatch):
+    def enable_fanout(cr):
+        cr["spec"]["libtpu"]["generationConfigs"] = {"v5e": "2025.1.0-v5e"}
+
+    c = make_ctrl(monkeypatch, cr_edit=enable_fanout)
+    run_states(c)
+    first = c.render_cache.renders_total
+    entries_before = len(c.render_cache)
+
+    c.client.delete("v1", "Node", "tpu-node-2")  # the v5p pool drains away
+    reinit(c)
+    run_states(c)
+    assert c.render_cache.renders_total == first  # nothing re-rendered
+    assert len(c.render_cache) == entries_before - 1
+    # the stale generation DS is GC'd by the fan-out sweep
+    assert (
+        c.client.get_or_none("apps/v1", "DaemonSet", "tpu-libtpu-daemonset-v5p", NS)
+        is None
+    )
+
+
+def test_runtime_change_invalidates(monkeypatch):
+    c = make_ctrl(monkeypatch)
+    run_states(c)
+    first = c.render_cache.renders_total
+    assert c.runtime == "containerd"
+
+    for name in ("tpu-node-1", "tpu-node-2"):
+        node = c.client.get("v1", "Node", name)
+        node["status"]["nodeInfo"]["containerRuntimeVersion"] = "cri-o://1.28"
+        c.client.update_status(node)
+    reinit(c)
+    assert c.runtime == "crio"
+    run_states(c)
+    assert c.render_cache.stats()["invalidations"] == 1
+    assert c.render_cache.renders_total > first
+    ds = c.client.get("apps/v1", "DaemonSet", "tpu-runtime-daemonset", NS)
+    env = {
+        e["name"]: e.get("value")
+        for e in ds["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["CONTAINER_RUNTIME"] == "crio"
+
+
+def test_cr_recreate_invalidates_via_uid(monkeypatch):
+    """Same spec, new CR uid: the cached manifests carry ownerReferences
+    to the DEAD uid and must not be served."""
+    c = make_ctrl(monkeypatch)
+    run_states(c)
+    first = c.render_cache.renders_total
+
+    c.client.delete(CPV, "ClusterPolicy", "cluster-policy")
+    cr = load_sample_cr()
+    cr["metadata"]["uid"] = "render-cache-uid-2"
+    c.client.create(cr)
+    reinit(c)
+    run_states(c)
+    assert c.render_cache.stats()["invalidations"] == 1
+    assert c.render_cache.renders_total > first
+    ds = c.client.get("apps/v1", "DaemonSet", "tpu-device-plugin-daemonset", NS)
+    assert ds["metadata"]["ownerReferences"][0]["uid"] == "render-cache-uid-2"
+
+
+# ---------------------------------------------------------------------------
+# frozen contract
+# ---------------------------------------------------------------------------
+
+
+def test_cached_manifests_reject_mutation(monkeypatch):
+    c = make_ctrl(monkeypatch)
+    run_states(c)
+    cached = c.render_cache.lookup(
+        ("state-device-plugin", "DaemonSet", "tpu-device-plugin-daemonset", "")
+    )
+    assert cached is not None
+    manifest, content_hash = cached
+    assert content_hash
+    with pytest.raises(FrozenObjectError):
+        manifest["metadata"]["labels"] = {}
+    with pytest.raises(FrozenObjectError):
+        manifest["spec"]["template"]["spec"]["containers"].append({})
+    with pytest.raises(FrozenObjectError):
+        del manifest["spec"]["template"]["metadata"]["annotations"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: the no-TPU skip logs once per transition, not per pass
+# ---------------------------------------------------------------------------
+
+
+def test_no_tpu_skip_logs_once_per_transition(monkeypatch, caplog):
+    c = make_ctrl(monkeypatch, nodes=[make_cpu_node("cpu-only")])
+    assert not c.has_tpu_nodes
+    with caplog.at_level(logging.INFO, logger="tpu-operator.controls"):
+        run_states(c)
+        reinit(c)
+        run_states(c)  # the pass that used to repeat the spam
+    skips = [
+        r.getMessage()
+        for r in caplog.records
+        if r.levelno == logging.INFO and "no TPU nodes; skipping" in r.getMessage()
+    ]
+    assert skips, "first transition must still be visible at INFO"
+    assert len(skips) == len(set(skips)), f"skip logspam repeated: {skips}"
+
+    # TPU arrives, then drains away again: a NEW transition logs again
+    caplog.clear()
+    c.client.create(make_tpu_node("tpu-node-1"))
+    reinit(c)
+    run_states(c)
+    c.client.delete("v1", "Node", "tpu-node-1")
+    reinit(c)
+    with caplog.at_level(logging.INFO, logger="tpu-operator.controls"):
+        run_states(c)
+    skips = [
+        r
+        for r in caplog.records
+        if r.levelno == logging.INFO and "no TPU nodes; skipping" in r.getMessage()
+    ]
+    assert skips, "a fresh no-TPU transition must log again"
+
+
+# ---------------------------------------------------------------------------
+# world-unchanged memos: the slice memo must key on the version of the
+# node list it CONSUMES, not on a version read later
+# ---------------------------------------------------------------------------
+
+
+def test_slice_memo_key_invalid_when_node_world_moved(monkeypatch):
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        ClusterPolicyReconciler,
+    )
+    from tpu_operator.kube.cache import CachedClient
+
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+    inner = FakeClient(
+        [
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
+            make_tpu_node("tpu-node-1"),
+        ]
+    )
+    inner.create(load_sample_cr())
+    cached = CachedClient(inner, namespace=NS)
+    assert cached.start_informers() is True
+    r = ClusterPolicyReconciler(cached, assets_dir=ASSETS)
+    r.reconcile()  # cold pass labels the node (writes move the store)
+    r.reconcile()  # settled pass: no writes
+    # settled: the key is valid (the consumed node list IS current)
+    assert r._store_versions() is not None
+
+    # a node event lands AFTER the pass's label scan captured its list:
+    # the key must go invalid — memoizing a summary computed over the
+    # pre-event list under the post-event version would mask the event
+    node = inner.get("v1", "Node", "tpu-node-1")
+    node["metadata"]["labels"]["tpu.k8s.io/chip.failed"] = "true"
+    inner.update(node)
+    assert r._store_versions() is None
+
+    # the next pass relists, restoring a valid key at the new version
+    r.reconcile()
+    assert r._store_versions() is not None
+
+
+# ---------------------------------------------------------------------------
+# satellite: the DaemonSet GC sweep shares the pass's one DS list
+# ---------------------------------------------------------------------------
+
+
+def test_delete_daemonsets_like_served_from_snapshot(monkeypatch):
+    c = make_ctrl(monkeypatch)
+    run_states(c)
+
+    class CountingClient:
+        """Counts DaemonSet LISTs, forwards everything else."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.ds_lists = 0
+
+        def list(self, api_version, kind, namespace="", *a, **kw):
+            if kind == "DaemonSet":
+                self.ds_lists += 1
+            return self._inner.list(api_version, kind, namespace, *a, **kw)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    counting = CountingClient(c.client)
+    c.client = counting
+    c.begin_pass()
+    # many disabled-state sweeps in one pass: one LIST total
+    from tpu_operator.controllers.object_controls import _delete_daemonsets_like
+
+    for base in (
+        "tpu-vm-manager-daemonset",
+        "tpu-vfio-manager-daemonset",
+        "tpu-kata-manager-daemonset",
+        "tpu-sandbox-device-plugin-daemonset",
+    ):
+        _delete_daemonsets_like(c, base)
+    stats = c.end_pass()
+    assert counting.ds_lists == 1
+    assert stats["daemonsets_memoized"] == 1

@@ -55,6 +55,13 @@ class ClusterSnapshot:
         self._nodes: Optional[List[Obj]] = None
         self._selector_counts: Dict[Tuple[Tuple[str, str], ...], int] = {}
         self._pods_by_app: Dict[str, List[Obj]] = {}
+        self._daemonsets: Optional[List[Obj]] = None
+        #: Node informer store version captured immediately BEFORE the
+        #: memoized node list was taken (None on unversioned clients).
+        #: Memos derived from the list (label scan, slice aggregation)
+        #: must key on THIS — a version read any later can be newer than
+        #: the list and would pin stale derived state under it
+        self.nodes_version: Optional[int] = None
         self.hits = 0
         self.misses = 0
 
@@ -69,6 +76,11 @@ class ClusterSnapshot:
         internal consumers (selector counting) record their own outcome,
         so one consumer read never counts twice."""
         if self._nodes is None:
+            fn = getattr(self._client, "store_version", None)
+            # read BEFORE listing: an event landing in between makes the
+            # list newer than the version, which only ever forces a
+            # spurious recompute, never masks the event
+            self.nodes_version = fn("v1", "Node") if fn is not None else None
             # shallow FrozenList wrap: the memo is shared pass-wide, so
             # outer-list mutation (sort/append) must fail loudly like
             # any other shared cached view
@@ -87,7 +99,11 @@ class ClusterSnapshot:
         """Refresh the memoized node list after a writer changed node
         state it (or a later state) re-reads this pass — init's labeling
         pass calls this with the post-write objects. Selector counts
-        derive from the node list, so they reset with it."""
+        derive from the node list, so they reset with it.
+        ``nodes_version`` deliberately keeps the ORIGINAL listing's
+        version: the writes that motivated the refresh moved the store
+        past it, so version-keyed memos correctly refuse to form this
+        pass."""
         self._nodes = FrozenList(nodes)
         self._selector_counts.clear()
 
@@ -127,6 +143,24 @@ class ClusterSnapshot:
         self._pods_by_app[app] = pods
         return pods
 
+    # -- daemonsets ------------------------------------------------------
+    def daemonsets(self) -> List[Obj]:
+        """The operator namespace's DaemonSets (shared frozen views) —
+        one informer read per pass, shared by every disabled state's GC
+        sweep and the libtpu generation fan-out's stale-DaemonSet GC
+        (``object_controls._delete_daemonsets_like``). Deliberately not
+        refreshed after in-pass creates/deletes: the sweeps carry their
+        own ``keep`` sets, and ``delete_if_exists`` probes the cache, so
+        a pass-start view stays correct."""
+        if self._daemonsets is None:
+            self.misses += 1
+            self._daemonsets = FrozenList(
+                self._client.list("apps/v1", "DaemonSet", self._namespace)
+            )
+        else:
+            self.hits += 1
+        return self._daemonsets
+
     # -- observability ---------------------------------------------------
     def stats(self) -> Dict[str, float]:
         total = self.hits + self.misses
@@ -136,4 +170,5 @@ class ClusterSnapshot:
             "hit_rate": round(self.hits / total, 4) if total else 0.0,
             "selectors_memoized": len(self._selector_counts),
             "apps_memoized": len(self._pods_by_app),
+            "daemonsets_memoized": 1 if self._daemonsets is not None else 0,
         }

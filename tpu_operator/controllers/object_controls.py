@@ -28,11 +28,13 @@ import copy
 import hashlib
 import json
 import logging
-from typing import Any, Dict, List, Optional
+from time import perf_counter
+from typing import Any, Callable, Dict, List, Optional
 
 from tpu_operator import consts
 from tpu_operator.api.v1.clusterpolicy_types import State
 from tpu_operator.kube.client import ConflictError
+from tpu_operator.kube.frozen import freeze
 
 log = logging.getLogger("tpu-operator.controls")
 
@@ -112,12 +114,21 @@ def _fill_namespace(n, obj: Obj) -> None:
                 subject["namespace"] = n.namespace
 
 
-def apply_with_hash(n, obj: Obj) -> str:
-    """Create-or-update gated on the content hash; returns the hash."""
-    h = compute_hash(obj)
-    obj.setdefault("metadata", {}).setdefault("annotations", {})[
-        consts.LAST_APPLIED_HASH_ANNOTATION
-    ] = h
+def apply_with_hash(n, obj: Obj, precomputed_hash: Optional[str] = None) -> str:
+    """Create-or-update gated on the content hash; returns the hash.
+
+    With ``precomputed_hash`` (the render-cache path) ``obj`` is a
+    pre-annotated — and possibly FROZEN — rendered manifest: the hash is
+    not recomputed and the object is never mutated here. The drift
+    branch deep-copies before touching resourceVersion, which thaws a
+    frozen view into a private mutable object."""
+    if precomputed_hash is None:
+        h = compute_hash(obj)
+        obj.setdefault("metadata", {}).setdefault("annotations", {})[
+            consts.LAST_APPLIED_HASH_ANNOTATION
+        ] = h
+    else:
+        h = precomputed_hash
     av, kind = obj["apiVersion"], obj["kind"]
     meta = obj["metadata"]
     existing = n.client.get_or_none(av, kind, meta["name"], meta.get("namespace", ""))
@@ -150,11 +161,61 @@ def apply_with_hash(n, obj: Obj) -> str:
     return h
 
 
-def _generic_apply(n, state_name: str, obj: Obj) -> str:
+def _render_memo(
+    n,
+    state_name: str,
+    obj: Obj,
+    render: Callable[[Obj], Obj],
+    generation: Optional[str] = None,
+):
+    """Memoized render-transform-hash. Returns ``(rendered, hash)``
+    where ``rendered`` MAY be a shared frozen view (read-only; see
+    ``render_cache.py``).
+
+    On a fingerprint-valid cache hit the deep copy, the transform chain
+    and ``compute_hash`` are all skipped. On a miss, ``render`` runs,
+    the content hash is computed and annotated once, and the frozen
+    result is stored for every later pass. Controllers without a
+    ``render_cache`` (unit tests driving a control directly) render
+    every time, exactly as before."""
+    cache = getattr(n, "render_cache", None)
+    key = (
+        state_name,
+        obj.get("kind", ""),
+        obj.get("metadata", {}).get("name", ""),
+        generation or "",
+    )
+    if cache is not None:
+        hit = cache.lookup(key)
+        if hit is not None:
+            return hit
+    t0 = perf_counter()
+    rendered = render(obj)
+    h = compute_hash(rendered)
+    rendered.setdefault("metadata", {}).setdefault("annotations", {})[
+        consts.LAST_APPLIED_HASH_ANNOTATION
+    ] = h
+    if cache is not None:
+        rendered = freeze(rendered)
+        cache.store(
+            key, rendered, h, state_name, perf_counter() - t0,
+            generation=generation,
+        )
+    return rendered, h
+
+
+def _render_generic(n, obj: Obj) -> Obj:
     obj = copy.deepcopy(obj)
     _fill_namespace(n, obj)
     set_owner_reference(n, obj)
-    apply_with_hash(n, obj)
+    return obj
+
+
+def _generic_apply(n, state_name: str, obj: Obj) -> str:
+    rendered, h = _render_memo(
+        n, state_name, obj, lambda o: _render_generic(n, o)
+    )
+    apply_with_hash(n, rendered, precomputed_hash=h)
     return State.READY
 
 
@@ -237,12 +298,17 @@ def prometheus_rule(n, state_name: str, obj: Obj) -> str:
 def runtime_class(n, state_name: str, obj: Obj) -> str:
     """RuntimeClasses; the default one is renamed per
     ``spec.operator.runtime_class`` (reference ``TransformRuntimeClass``)."""
-    obj = copy.deepcopy(obj)
-    if obj["metadata"]["name"] == "tpu":
-        obj["metadata"]["name"] = n.cp.spec.operator.runtime_class
-    _fill_namespace(n, obj)
-    set_owner_reference(n, obj)
-    apply_with_hash(n, obj)
+
+    def render(o: Obj) -> Obj:
+        o = copy.deepcopy(o)
+        if o["metadata"]["name"] == "tpu":
+            o["metadata"]["name"] = n.cp.spec.operator.runtime_class
+        _fill_namespace(n, o)
+        set_owner_reference(n, o)
+        return o
+
+    rendered, h = _render_memo(n, state_name, obj, render)
+    apply_with_hash(n, rendered, precomputed_hash=h)
     return State.READY
 
 
@@ -272,12 +338,15 @@ def pod(n, state_name: str, obj: Obj) -> str:
 
 
 def deployment(n, state_name: str, obj: Obj) -> str:
-    obj = copy.deepcopy(obj)
-    _fill_namespace(n, obj)
-    set_owner_reference(n, obj)
-    apply_with_hash(n, obj)
+    rendered, h = _render_memo(
+        n, state_name, obj, lambda o: _render_generic(n, o)
+    )
+    apply_with_hash(n, rendered, precomputed_hash=h)
     live = n.client.get_or_none(
-        obj["apiVersion"], "Deployment", obj["metadata"]["name"], n.namespace
+        rendered["apiVersion"],
+        "Deployment",
+        rendered["metadata"]["name"],
+        n.namespace,
     )
     return (
         State.READY if live and is_deployment_ready(live) else State.NOT_READY
@@ -313,53 +382,85 @@ def daemonset(n, state_name: str, obj: Obj) -> str:
 
     # 2. no TPU nodes -> nothing to do (reference :3763-3770)
     if not n.has_tpu_nodes:
-        log.info("no TPU nodes; skipping DaemonSet %s", name)
+        _log_no_tpu_skip(n, name)
         return State.READY
 
     # 3. libtpu generation fan-out (reference precompiled fan-out :3405-3441)
     if name == "tpu-libtpu-daemonset" and n.cp.spec.libtpu.generation_configs:
         return _libtpu_generation_daemonsets(n, state_name, obj)
 
-    ds = copy.deepcopy(obj)
-    _pre_process_daemonset(n, ds)
-    set_owner_reference(n, ds)
-    apply_with_hash(n, ds)
+    ds, h = _render_memo(n, state_name, obj, lambda o: _render_daemonset(n, o))
+    apply_with_hash(n, ds, precomputed_hash=h)
     live = n.client.get_or_none("apps/v1", "DaemonSet", ds["metadata"]["name"], n.namespace)
     if live is None:
         return State.NOT_READY
     return State.READY if is_daemonset_ready(n, live) else State.NOT_READY
 
 
+def _log_no_tpu_skip(n, name: str) -> None:
+    """A TPU-less cluster re-reconciles every 45 s forever; the skip is
+    logged at INFO once per DaemonSet per no-TPU transition (the set is
+    cleared when TPU nodes appear), DEBUG thereafter."""
+    logged = getattr(n, "no_tpu_skip_logged", None)
+    if logged is None or name not in logged:
+        if logged is not None:
+            logged.add(name)
+        log.info("no TPU nodes; skipping DaemonSet %s", name)
+    else:
+        log.debug("no TPU nodes; skipping DaemonSet %s", name)
+
+
+def _render_daemonset(n, obj: Obj) -> Obj:
+    ds = copy.deepcopy(obj)
+    _pre_process_daemonset(n, ds)
+    set_owner_reference(n, ds)
+    return ds
+
+
+def _render_generation_daemonset(n, obj: Obj, gen: str) -> Obj:
+    base_name = obj["metadata"]["name"]
+    base_app = obj["metadata"]["labels"].get("app", base_name)
+    ds = copy.deepcopy(obj)
+    ds["metadata"]["name"] = f"{base_name}-{gen}"
+    labels = ds["metadata"].setdefault("labels", {})
+    labels[f"{consts.GROUP}/tpu.generation"] = gen
+    # each generation DS needs its own selector/app identity — identical
+    # selectors across DaemonSets are invalid, and OnDelete readiness
+    # must only see this generation's pods
+    gen_app = f"{base_app}-{gen}"
+    labels["app"] = gen_app
+    ds["spec"]["selector"]["matchLabels"]["app"] = gen_app
+    tmpl = ds["spec"]["template"]
+    tmpl["metadata"].setdefault("labels", {})["app"] = gen_app
+    # pods select nodes of this generation
+    tmpl["spec"].setdefault("nodeSelector", {})[
+        f"{consts.GROUP}/tpu.generation"
+    ] = gen
+    _pre_process_daemonset(n, ds, generation=gen, transform_key=base_app)
+    set_owner_reference(n, ds)
+    return ds
+
+
 def _libtpu_generation_daemonsets(n, state_name: str, obj: Obj) -> str:
     """One libtpu DaemonSet per TPU generation present in the cluster, with
     stale-generation garbage collection (reference
     ``precompiledDriverDaemonsets``/``cleanupUnusedDriverDaemonSets``,
-    ``controllers/object_controls.go:3405-3441,3587-3744``)."""
+    ``controllers/object_controls.go:3405-3441,3587-3744``). Each
+    generation's render is memoized independently: a new generation
+    appearing renders exactly one new DaemonSet while the others stay
+    cached."""
     base_name = obj["metadata"]["name"]
-    base_app = obj["metadata"]["labels"].get("app", base_name)
     wanted = {}
     overall = State.READY
     for gen in sorted(n.tpu_generations):
-        ds = copy.deepcopy(obj)
-        gen_name = f"{base_name}-{gen}"
-        ds["metadata"]["name"] = gen_name
-        labels = ds["metadata"].setdefault("labels", {})
-        labels[f"{consts.GROUP}/tpu.generation"] = gen
-        # each generation DS needs its own selector/app identity — identical
-        # selectors across DaemonSets are invalid, and OnDelete readiness
-        # must only see this generation's pods
-        gen_app = f"{base_app}-{gen}"
-        labels["app"] = gen_app
-        ds["spec"]["selector"]["matchLabels"]["app"] = gen_app
-        tmpl = ds["spec"]["template"]
-        tmpl["metadata"].setdefault("labels", {})["app"] = gen_app
-        # pods select nodes of this generation
-        tmpl["spec"].setdefault("nodeSelector", {})[
-            f"{consts.GROUP}/tpu.generation"
-        ] = gen
-        _pre_process_daemonset(n, ds, generation=gen, transform_key=base_app)
-        set_owner_reference(n, ds)
-        apply_with_hash(n, ds)
+        ds, h = _render_memo(
+            n,
+            state_name,
+            obj,
+            lambda o, g=gen: _render_generation_daemonset(n, o, g),
+            generation=gen,
+        )
+        apply_with_hash(n, ds, precomputed_hash=h)
         wanted[ds["metadata"]["name"]] = True
         live = n.client.get_or_none(
             "apps/v1", "DaemonSet", ds["metadata"]["name"], n.namespace
@@ -372,8 +473,20 @@ def _libtpu_generation_daemonsets(n, state_name: str, obj: Obj) -> str:
 
 
 def _delete_daemonsets_like(n, base_name: str, keep: Optional[set] = None) -> None:
+    """Sweep DaemonSets named ``base_name`` or ``base_name-*``. The
+    namespace DaemonSet list is served from the per-pass snapshot when
+    one is open — every disabled state and the generation fan-out GC
+    used to each issue their own LIST per pass; now they share one
+    informer read. ``delete_if_exists`` probes the cache first, so a
+    pass-start list that is stale about an already-deleted object
+    costs nothing."""
     keep = keep or set()
-    for ds in n.client.list("apps/v1", "DaemonSet", n.namespace):
+    snap = getattr(n, "snapshot", None)
+    if snap is not None:
+        daemonsets = snap.daemonsets()
+    else:
+        daemonsets = n.client.list("apps/v1", "DaemonSet", n.namespace)
+    for ds in daemonsets:
         name = ds["metadata"]["name"]
         if name == base_name or name.startswith(base_name + "-"):
             if name not in keep:

@@ -163,6 +163,35 @@ class OperatorMetrics:
             "reconcile_snapshot_misses",
             "Reads the per-pass cluster snapshot had to compute (last pass)",
         )
+        # memoized manifest render pipeline (desired-state fingerprint
+        # short-circuit): a steady-state pass renders nothing — misses
+        # staying 0 and the hit gauge at ~the control count is the tell
+        self.render_cache_hits = g(
+            "render_cache_hits",
+            "Manifest renders served from the render cache (last pass)",
+        )
+        self.render_cache_misses = g(
+            "render_cache_misses",
+            "Manifests the render cache had to render (last pass)",
+        )
+        self.render_cache_entries = g(
+            "render_cache_entries",
+            "Rendered manifests currently memoized under the desired-state "
+            "fingerprint",
+        )
+        # a gauge fed by .set() from the cache's own counter — no _total
+        # suffix, which Prometheus conventions reserve for true Counters
+        self.render_cache_invalidations = g(
+            "render_cache_invalidations",
+            "Full render-cache invalidations (desired-state fingerprint "
+            "changes: spec edit, runtime flip, CR recreate)",
+        )
+        self.state_render_ms = g(
+            "state_render_ms",
+            "Cumulative manifest render wall time per state since the last "
+            "fingerprint invalidation (ms)",
+            ("state",),
+        )
 
     # -- convenience ----------------------------------------------------
     def observe_reconcile(self, status_value: int) -> None:

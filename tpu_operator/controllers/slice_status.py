@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass, field
+from functools import lru_cache
 from typing import Dict, List, Optional, Set
 
 from tpu_operator import consts
@@ -101,19 +102,30 @@ def slice_id_for_node(node: Obj) -> str:
 
 def _expected_hosts(node: Obj) -> int:
     labels = node.get("metadata", {}).get("labels", {}) or {}
-    raw = labels.get(consts.TFD_SLICE_HOSTS_LABEL, "")
+    return _hosts_from_labels(
+        labels.get(consts.TFD_SLICE_HOSTS_LABEL, ""),
+        labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, ""),
+        labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, ""),
+        labels.get(consts.TFD_CHIP_TYPE_LABEL, ""),
+    )
+
+
+@lru_cache(maxsize=256)
+def _hosts_from_labels(raw: str, topology: str, acc: str, gen: str) -> int:
+    """Expected host count from the slice labels. Memoized: a 1000-node
+    fleet carries a handful of distinct (hosts, topology, accelerator)
+    label shapes, and this runs twice per TPU node per reconcile pass
+    (slice identity + slice sizing) — the topology parse was a
+    measurable slice of the steady-state pass."""
     try:
         return int(raw)
     except (TypeError, ValueError):
         pass
     # derive from the GKE topology label when TFD hasn't run yet
-    topology = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, "")
-    gen = labels.get(consts.TFD_CHIP_TYPE_LABEL, "")
     if topology:
         try:
             from tpu_operator.workloads import topology as topo
 
-            acc = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, "")
             gen = gen or consts.GKE_ACCELERATOR_TO_GENERATION.get(acc, "")
             if gen:
                 return topo.host_count(topology, gen)

@@ -29,6 +29,10 @@ from tpu_operator.api.v1.clusterpolicy_types import (
 )
 from tpu_operator.controllers import object_controls
 from tpu_operator.controllers.cluster_snapshot import ClusterSnapshot
+from tpu_operator.controllers.render_cache import (
+    RenderCache,
+    render_fingerprint,
+)
 from tpu_operator.controllers.resource_manager import (
     Resources,
     add_resources_controls,
@@ -38,6 +42,7 @@ from tpu_operator.kube.client import (
     ConflictError,
     NotFoundError,
     Obj,
+    apply_label_delta,
     mutate_with_retry,
 )
 from tpu_operator.kube.frozen import thaw
@@ -80,6 +85,17 @@ SANDBOX_STATES: Set[str] = {
     "state-vfio-manager",
     "state-sandbox-device-plugin",
     "state-kata-manager",
+}
+
+# component -> deploy-label key, built once: the per-node label delta
+# runs over every node every pass, and re-concatenating ~14 label keys
+# per node was a measurable slice of the fleet steady state
+_DEPLOY_KEYS: Dict[str, str] = {
+    comp: consts.DEPLOY_LABEL_PREFIX + comp
+    for comp in (
+        *consts.CONTAINER_WORKLOAD_COMPONENTS,
+        *consts.VM_WORKLOAD_COMPONENTS,
+    )
 }
 
 
@@ -126,13 +142,9 @@ def node_workload_config(node: Obj) -> str:
 
 
 def _apply_label_changes(node: Obj, changes: Dict[str, Optional[str]]) -> None:
-    """Apply a label delta (value ``None`` = delete) to a MUTABLE node."""
-    labels = node["metadata"].setdefault("labels", {})
-    for key, value in changes.items():
-        if value is None:
-            labels.pop(key, None)
-        else:
-            labels[key] = value
+    """Apply a label delta (value ``None`` = delete) to a MUTABLE node —
+    same merge semantics as every ``patch_labels`` implementation."""
+    apply_label_delta(node["metadata"].setdefault("labels", {}), changes)
 
 
 class ClusterPolicyController:
@@ -168,6 +180,25 @@ class ClusterPolicyController:
         # per-pass read memo (begin_pass/end_pass); None outside a pass
         # so direct init()/step() callers (tests) work without one
         self.snapshot: Optional[ClusterSnapshot] = None
+        # process-lifetime memo of rendered manifests, fingerprint-gated
+        # by init(); at steady state every control serves its frozen
+        # pre-hashed render from here instead of re-rendering
+        self.render_cache = RenderCache()
+        # DaemonSets whose no-TPU skip was already logged this no-TPU
+        # stretch (cleared when TPU nodes appear) — the skip used to
+        # logspam every pass on TPU-less clusters
+        self.no_tpu_skip_logged: Set[str] = set()
+        # (node store version, sandbox flag) of the last clean labeling
+        # pass — while it matches, the O(nodes) label scan is skipped
+        self._label_world: Optional[Tuple[int, bool]] = None
+        # the Node store version _nodes_cache was listed at — consumers
+        # memoizing work derived from that list (slice aggregation) must
+        # key on THIS, not on a version read later: a mid-pass node
+        # event would otherwise pin stale derived state under the new
+        # version
+        self._nodes_cache_version: Optional[int] = None
+        # version bound to the most recent _list_nodes() result
+        self._nodes_listed_at: Optional[int] = None
         # cumulative snapshot counters across passes, for the debug
         # surface + metrics
         self.snapshot_hits_total = 0
@@ -231,6 +262,15 @@ class ClusterPolicyController:
         self.label_tpu_nodes()
         self.apply_upgrade_auto_annotation()
         self.runtime = self.get_runtime()
+        # every render input is now known: gate the render cache on the
+        # desired-state fingerprint — a spec/runtime/uid change clears
+        # it, a generation-set change drops only the fan-out entries
+        self.render_cache.begin_pass(
+            render_fingerprint(
+                self.cp_obj, self.namespace, self.runtime, self.openshift
+            ),
+            self.tpu_generations,
+        )
         log.info(
             "cluster init: k8s=%s runtime=%s tpuNodes=%s generations=%s",
             self.k8s_version,
@@ -241,9 +281,15 @@ class ClusterPolicyController:
 
     def _list_nodes(self) -> List[Obj]:
         """The pass's node list — shared frozen views via the snapshot
-        when a pass is open, a direct (cached) list otherwise."""
+        when a pass is open, a direct (cached) list otherwise. Stamps
+        ``_nodes_listed_at`` with the store version BOUND TO THE LIST
+        (captured before whichever listing actually produced it), which
+        is what every list-derived memo must key on."""
         if self.snapshot is not None:
-            return self.snapshot.nodes()
+            nodes = self.snapshot.nodes()
+            self._nodes_listed_at = self.snapshot.nodes_version
+            return nodes
+        self._nodes_listed_at = self._node_store_version()
         return self.client.list("v1", "Node")
 
     def _get_kubernetes_version(self) -> str:
@@ -269,7 +315,38 @@ class ClusterPolicyController:
     # ------------------------------------------------------------------
     # node labeling (reference labelGPUNodes, :473-572)
     # ------------------------------------------------------------------
+    def _node_store_version(self) -> Optional[int]:
+        fn = getattr(self.client, "store_version", None)
+        return fn("v1", "Node") if fn is not None else None
+
     def label_tpu_nodes(self) -> None:
+        # world-unchanged short-circuit: the label delta is a pure
+        # function of (node labels, sandbox gating). When the Node store
+        # version BOUND TO THIS PASS'S LIST matches the last pass that
+        # wrote nothing, every label is still converged and every
+        # cluster fact (has_tpu_nodes, generations, counts) still holds
+        # — skip the O(nodes) scan entirely. Any node event, any label
+        # write (ours or another actor's) moves the store past the
+        # listed-at version and forces a full rescan; clients without a
+        # versioned store always rescan. The version must come from the
+        # LISTING moment, not a fresh read: an event landing between an
+        # earlier consumer's list (e.g. _get_kubernetes_version) and
+        # this method would otherwise pin the stale list under the newer
+        # version and mask the event for every later pass.
+        nodes = self._list_nodes()
+        version = self._nodes_listed_at
+        world = (
+            (version, self.cp.spec.sandbox_enabled())
+            if version is not None
+            else None
+        )
+        if world is not None and world == self._label_world:
+            self._nodes_cache = nodes
+            self._nodes_cache_version = version
+            return
+        self._label_world = None
+        self._nodes_cache_version = version
+        wrote = False
         self.has_tpu_nodes = False
         self.has_nfd_labels = False
         self.tpu_generations = set()
@@ -278,7 +355,7 @@ class ClusterPolicyController:
         # its labels actually need a write — the steady state labels
         # nothing and copies nothing
         final_nodes: List[Obj] = []
-        for node in self._list_nodes():
+        for node in nodes:
             labels = node["metadata"].get("labels") or {}
             if any(k.startswith("feature.node.kubernetes.io/") for k in labels):
                 self.has_nfd_labels = True
@@ -291,55 +368,93 @@ class ClusterPolicyController:
             changes = self._node_label_changes(node)
             if changes:
                 # Node labels are the shared bus: TFD, the slice manager,
-                # the maintenance handler and the upgrade FSM all write
-                # concurrently. Fast path writes the listed snapshot; a
-                # 409 re-GETs and re-applies instead of aborting init()
-                # and failing the whole reconcile to the rate limiter
-                # (every other Node writer already follows this
-                # discipline — kube/client.py mutate_with_retry).
+                # the maintenance handler, the upgrade FSM — and humans
+                # pausing components — all write concurrently. The write
+                # is a labels-only merge patch (delta payload, not the
+                # whole Node with its kubelet status + image list),
+                # CONDITIONED on the rv this delta was computed from: a
+                # concurrent write 409s, and the retry recomputes the
+                # delta from the fresh node instead of blindly
+                # re-applying a stale decision (an rv-less patch would
+                # silently revert a human's just-written "paused-*"
+                # override).
                 name = node["metadata"]["name"]
-                mutable = thaw(node)
-                _apply_label_changes(mutable, changes)
+                wrote = True
                 try:
-                    node = self.client.update(mutable)
+                    node = self.client.patch_labels(
+                        "v1",
+                        "Node",
+                        name,
+                        labels=changes,
+                        resource_version=node["metadata"].get(
+                            "resourceVersion"
+                        ),
+                    )
                 except ConflictError:
-                    try:
-                        node = mutate_with_retry(
-                            self.client,
-                            "v1",
-                            "Node",
-                            name,
-                            mutate=self._apply_node_labels,
-                        )
-                    except ConflictError:
-                        log.warning(
-                            "node %s label write kept conflicting; the "
-                            "requeue will converge it",
-                            name,
-                        )
-                        node = mutable
-                    except NotFoundError:
-                        # deleted between the 409 and the re-GET
-                        log.info("node %s vanished during labeling", name)
+                    node = self._relabel_fresh(name, node, changes)
+                    if node is None:
                         continue
                 except NotFoundError:
                     log.info("node %s vanished during labeling", name)
                     continue
             final_nodes.append(node)
         self._nodes_cache = final_nodes
+        if self.has_tpu_nodes:
+            # next no-TPU stretch (nodes drained away) logs the skips
+            # again — once per transition, not once per process
+            self.no_tpu_skip_logged.clear()
         if self.snapshot is not None:
             # later states re-read nodes through the snapshot; give them
             # the post-label state, not the pass-start listing
             self.snapshot.set_nodes(final_nodes)
+        if world is not None and not wrote:
+            # a clean pass (nothing needed writing): its outcome stays
+            # valid until the node store moves again. A pass that wrote
+            # is never memoized — its own write-throughs moved the store
+            self._label_world = world
 
-    def _apply_node_labels(self, node: Obj) -> bool:
-        """Mutate one Node's operator labels in place; returns whether
-        anything changed (the ``mutate_with_retry`` contract)."""
-        changes = self._node_label_changes(node)
-        if not changes:
-            return False
-        _apply_label_changes(node, changes)
-        return True
+    def _relabel_fresh(
+        self,
+        name: str,
+        stale_node: Obj,
+        stale_changes: Dict[str, Optional[str]],
+    ) -> Optional[Obj]:
+        """Conflict path of the conditional label patch: re-read the
+        node LIVE, RECOMPUTE the delta against what the other writer
+        actually wrote, and re-patch at the fresh rv (bounded retries).
+        Returns the node to carry forward, or None when it vanished."""
+        for _ in range(3):
+            try:
+                fresh = getattr(self.client, "get_live", self.client.get)(
+                    "v1", "Node", name
+                )
+            except NotFoundError:
+                log.info("node %s vanished during labeling", name)
+                return None
+            changes = self._node_label_changes(fresh)
+            if not changes:
+                return fresh  # the other writer's state needs nothing
+            try:
+                return self.client.patch_labels(
+                    "v1",
+                    "Node",
+                    name,
+                    labels=changes,
+                    resource_version=fresh["metadata"].get("resourceVersion"),
+                )
+            except ConflictError:
+                continue
+            except NotFoundError:
+                log.info("node %s vanished during labeling", name)
+                return None
+        log.warning(
+            "node %s label write kept conflicting; the requeue will "
+            "converge it",
+            name,
+        )
+        mutable = thaw(stale_node)
+        _apply_label_changes(mutable, stale_changes)
+        return mutable
 
     def _node_label_changes(self, node: Obj) -> Dict[str, Optional[str]]:
         """Desired operator-label delta for one node as ``{key: value}``
@@ -376,17 +491,19 @@ class ClusterPolicyController:
             disable = consts.VM_WORKLOAD_COMPONENTS
         changes: Dict[str, Optional[str]] = {}
         for comp in enable:
-            key = consts.DEPLOY_LABEL_PREFIX + comp
+            key = _DEPLOY_KEYS[comp]
+            value = labels.get(key)
+            if value == "true":
+                continue
             # don't fight a human override of "false"/"paused-*"
             # (reference keeps existing explicit disables)
-            if labels.get(key) in ("false",) or str(
-                labels.get(key, "")
-            ).startswith("paused-"):
+            if value == "false" or (
+                isinstance(value, str) and value.startswith("paused-")
+            ):
                 continue
-            if labels.get(key) != "true":
-                changes[key] = "true"
+            changes[key] = "true"
         for comp in disable:
-            key = consts.DEPLOY_LABEL_PREFIX + comp
+            key = _DEPLOY_KEYS[comp]
             if key in labels:
                 changes[key] = None
         return changes

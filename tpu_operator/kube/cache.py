@@ -243,6 +243,11 @@ class Informer:
         self.list_seconds = 0.0
         self.indexed_lists = 0
         self.copied_reads = 0
+        # monotonic store mutation counter: bumped whenever the mirrored
+        # state changes (event ingest, seed, resync repair, write-through).
+        # Pass-scoped memos key on it to skip pure recomputation over an
+        # unchanged world (state_manager's label scan, slice aggregation)
+        self.store_version = 0
         # deletions observed before the initial seed lands: a concurrent
         # DELETED between list() and replace() must not be resurrected by
         # the older snapshot
@@ -295,6 +300,7 @@ class Informer:
                         del index[e]
 
     def _set_locked(self, key: Tuple[str, str], frozen: Obj) -> None:
+        self.store_version += 1
         have = self._store.get(key)
         if have is not None:
             self._unindex_locked(key, have)
@@ -313,6 +319,7 @@ class Informer:
         have = self._store.pop(key, None)
         if have is None:
             return None
+        self.store_version += 1
         self._unindex_locked(key, have)
         if self._sorted_ok:
             i = bisect_left(self._sorted_keys, key)
@@ -858,6 +865,15 @@ class CachedClient(Client):
             return None  # caller wants all namespaces; we hold one
         return inf
 
+    def store_version(self, api_version: str, kind: str) -> Optional[int]:
+        """The kind's informer store mutation counter, or ``None`` when
+        the kind has no synced informer (a memo keyed on it must then
+        recompute — the safe default)."""
+        inf = self._informers.get((api_version, kind))
+        if inf is None or not inf.synced.is_set():
+            return None
+        return inf.store_version
+
     def cache_info(self) -> Dict[str, Optional[int]]:
         """Per-kind store sizes for the debug surface; an UNSYNCED kind
         reports ``None`` (reads fall through live) — distinguishable from
@@ -981,6 +997,18 @@ class CachedClient(Client):
 
     def update_status(self, obj):
         updated = self.live.update_status(obj)
+        if isinstance(updated, dict):
+            self._write_through(updated)
+        return updated
+
+    def patch_labels(
+        self, api_version, kind, name, namespace="", labels=None,
+        resource_version=None,
+    ):
+        updated = self.live.patch_labels(
+            api_version, kind, name, namespace, labels=labels,
+            resource_version=resource_version,
+        )
         if isinstance(updated, dict):
             self._write_through(updated)
         return updated

@@ -85,6 +85,25 @@ def mutate_with_retry(
     raise last  # type: ignore[misc]
 
 
+def apply_label_delta(
+    labels: Dict[str, str], delta: Dict[str, Optional[str]]
+) -> bool:
+    """Apply a labels-only merge delta in place (value ``None`` deletes
+    the key); returns whether anything changed. The single definition of
+    the ``patch_labels`` merge semantics — every implementation (generic
+    fallback, FakeClient, kubesim via RFC 7386) must match it."""
+    changed = False
+    for k, v in (delta or {}).items():
+        if v is None:
+            if k in labels:
+                del labels[k]
+                changed = True
+        elif labels.get(k) != v:
+            labels[k] = v
+            changed = True
+    return changed
+
+
 def obj_key(obj: Obj) -> Tuple[str, str, str, str]:
     meta = obj.get("metadata", {})
     return (
@@ -189,6 +208,57 @@ class Client:
 
     def update_status(self, obj: Obj) -> Obj:
         raise NotImplementedError
+
+    def patch_labels(
+        self,
+        api_version: str,
+        kind: str,
+        name: str,
+        namespace: str = "",
+        labels: Optional[Dict[str, Optional[str]]] = None,
+        resource_version: Optional[str] = None,
+    ) -> Obj:
+        """Labels-only merge patch; value ``None`` deletes the key.
+        Returns the updated object.
+
+        The write payload is the label delta instead of the whole object
+        (a fleet Node carries kubelet status and an image list).
+        ``resource_version`` makes the patch CONDITIONAL (apiserver
+        merge-patch semantics: an rv in the body is an optimistic-
+        concurrency precondition, 409 on mismatch) — a caller whose
+        delta was computed from a possibly-stale view passes the rv it
+        observed and recomputes on conflict; omitting it is last-writer-
+        wins, safe only for keys no other actor writes.
+
+        This generic fallback is a read-modify-write for clients without
+        native PATCH; with ``resource_version`` it is single-shot (the
+        caller owns conflict recomputation — blindly re-applying a stale
+        delta is exactly the race the rv guards against)."""
+        delta = labels or {}
+
+        def mutate(obj: Obj) -> bool:
+            if resource_version is not None and str(
+                obj.get("metadata", {}).get("resourceVersion")
+            ) != str(resource_version):
+                raise ConflictError(
+                    f"{kind} {namespace}/{name}: resourceVersion "
+                    f"{resource_version} is stale"
+                )
+            meta = obj.setdefault("metadata", {})
+            current = meta.get("labels")
+            if not isinstance(current, dict):
+                current = meta["labels"] = {}
+            return apply_label_delta(current, delta)
+
+        return mutate_with_retry(
+            self,
+            api_version,
+            kind,
+            name,
+            namespace,
+            mutate=mutate,
+            attempts=1 if resource_version is not None else 5,
+        )
 
     def delete(
         self, api_version: str, kind: str, name: str, namespace: str = ""
@@ -434,6 +504,33 @@ class FakeClient(Client):
             self._store[key] = existing
             self._notify("MODIFIED", existing)
             return copy.deepcopy(existing)
+
+    def patch_labels(
+        self, api_version, kind, name, namespace="", labels=None,
+        resource_version=None,
+    ):
+        """Native merge-patch: the delta lands on the CURRENT stored
+        object under the store lock. Unconditional by default; with
+        ``resource_version`` it is an optimistic-concurrency
+        precondition (409 on mismatch), like the apiserver."""
+        with self._lock:
+            key = (api_version, kind, namespace or "", name)
+            stored = self._store.get(key)
+            if stored is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            if resource_version is not None and str(
+                stored["metadata"].get("resourceVersion")
+            ) != str(resource_version):
+                raise ConflictError(
+                    f"resourceVersion conflict on {key}: "
+                    f"{resource_version} != "
+                    f"{stored['metadata'].get('resourceVersion')}"
+                )
+            current = stored.setdefault("metadata", {}).setdefault("labels", {})
+            if apply_label_delta(current, labels or {}):
+                self._stamp(stored)
+                self._notify("MODIFIED", stored)
+            return copy.deepcopy(stored)
 
     def delete(self, api_version, kind, name, namespace=""):
         with self._lock:

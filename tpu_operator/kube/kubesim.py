@@ -274,37 +274,87 @@ class KubeSim:
                 merged["status"] = new.get("status", {})
                 merged["metadata"]["resourceVersion"] = self._bump()
                 self._objs[key] = merged
-            else:
-                if kind in STATUS_SUBRESOURCE_KINDS:
-                    # a main-resource PUT cannot change status
-                    if "status" in stored:
-                        new["status"] = copy.deepcopy(stored["status"])
-                    else:
-                        new.pop("status", None)
-                elif "status" not in new and "status" in stored:
-                    # real apiserver semantics for every kind: a
-                    # status-less main PUT (the operator re-applying a
-                    # rendered manifest) must not wipe status the kubelet
-                    # wrote — otherwise each reconcile would bounce
-                    # DaemonSet readiness through NotReady
+                if plural == "events":
+                    self._event_touch[key] = time.monotonic()
+                self._emit("MODIFIED", key, self._objs[key])
+                return 200, copy.deepcopy(self._objs[key])
+            if kind in STATUS_SUBRESOURCE_KINDS:
+                # a main-resource PUT cannot change status
+                if "status" in stored:
                     new["status"] = copy.deepcopy(stored["status"])
-                rejects = self._admit(kind, new)
-                if rejects:
-                    return 422, _status(422, "Invalid", "; ".join(rejects))
-                old_spec = stored.get("spec")
-                meta["generation"] = stored["metadata"].get("generation", 1) + (
-                    1 if new.get("spec") != old_spec else 0
+                else:
+                    new.pop("status", None)
+            elif "status" not in new and "status" in stored:
+                # real apiserver semantics for every kind: a
+                # status-less main PUT (the operator re-applying a
+                # rendered manifest) must not wipe status the kubelet
+                # wrote — otherwise each reconcile would bounce
+                # DaemonSet readiness through NotReady
+                new["status"] = copy.deepcopy(stored["status"])
+            return self._commit_main_locked(key, plural, kind, stored, new)
+
+    def _commit_main_locked(self, key, plural, kind, stored, new):
+        """Shared commit tail for main-resource PUT and PATCH (caller
+        holds the lock and has already resolved subresource + immutable
+        fields): admission, conditional generation bump, rv stamp,
+        store, CRD/event hooks, MODIFIED emit. One definition so the two
+        write verbs cannot drift apart."""
+        rejects = self._admit(kind, new)
+        if rejects:
+            return 422, _status(422, "Invalid", "; ".join(rejects))
+        meta = new["metadata"]
+        meta["generation"] = stored["metadata"].get("generation", 1) + (
+            1 if new.get("spec") != stored.get("spec") else 0
+        )
+        meta["resourceVersion"] = self._bump()
+        self._objs[key] = new
+        if plural == "customresourcedefinitions":
+            # an updated CRD schema takes effect immediately, as on a
+            # real apiserver
+            self._register_crd(self._objs[key])
+        if plural == "events":
+            self._event_touch[key] = time.monotonic()
+        self._emit("MODIFIED", key, self._objs[key])
+        return 200, copy.deepcopy(self._objs[key])
+
+    def patch(self, group, version, plural, namespace, name, body: dict):
+        """RFC 7386 JSON merge patch against the CURRENT revision: a
+        patch body without ``metadata.resourceVersion`` has no conflict
+        window (apiserver PATCH semantics — the operator's labels-only
+        node writes ride this). A body that does carry an rv gets the
+        same stale-rv 409 a PUT would."""
+        kind, _ = PLURAL_TABLE[plural]
+        with self._lock:
+            key = self._key(group, version, plural, namespace, name)
+            stored = self._objs.get(key)
+            if stored is None:
+                return 404, _status(404, "NotFound", f"{plural} {name} not found")
+            body_rv = (body.get("metadata") or {}).get("resourceVersion")
+            if body_rv is not None and str(body_rv) != stored["metadata"]["resourceVersion"]:
+                return 409, _status(
+                    409,
+                    "Conflict",
+                    f"{plural} {name}: resourceVersion {body_rv} is stale "
+                    f"(current {stored['metadata']['resourceVersion']})",
                 )
-                meta["resourceVersion"] = self._bump()
-                self._objs[key] = new
-                if plural == "customresourcedefinitions":
-                    # an updated CRD schema takes effect immediately, as
-                    # on a real apiserver
-                    self._register_crd(self._objs[key])
-            if plural == "events":
-                self._event_touch[key] = time.monotonic()
-            self._emit("MODIFIED", key, self._objs[key])
-            return 200, copy.deepcopy(self._objs[key])
+            new = copy.deepcopy(stored)
+            patch = copy.deepcopy(body)
+            if kind in STATUS_SUBRESOURCE_KINDS:
+                # a main-resource PATCH cannot change a subresource status
+                patch.pop("status", None)
+            _json_merge_patch(new, patch)
+            meta = new.setdefault("metadata", {})
+            # immutable fields come from the store (a merge patch could
+            # otherwise overwrite or null them)
+            meta["uid"] = stored["metadata"]["uid"]
+            if stored["metadata"].get("creationTimestamp") is not None:
+                meta["creationTimestamp"] = stored["metadata"][
+                    "creationTimestamp"
+                ]
+            meta["name"] = stored["metadata"]["name"]
+            if stored["metadata"].get("namespace"):
+                meta["namespace"] = stored["metadata"]["namespace"]
+            return self._commit_main_locked(key, plural, kind, stored, new)
 
     def delete(self, group, version, plural, namespace, name):
         with self._lock:
@@ -482,6 +532,21 @@ class KubeSim:
                 yield "BOOKMARK", {"metadata": {"resourceVersion": str(cursor)}}
 
 
+def _json_merge_patch(target: dict, patch: dict) -> None:
+    """RFC 7386 merge patch, in place: dicts merge recursively, ``null``
+    deletes, everything else replaces."""
+    for k, v in patch.items():
+        if v is None:
+            target.pop(k, None)
+        elif isinstance(v, dict):
+            current = target.get(k)
+            if not isinstance(current, dict):
+                current = target[k] = {}
+            _json_merge_patch(current, v)
+        else:
+            target[k] = v
+
+
 def _status(code: int, reason: str, message: str) -> dict:
     return {
         "apiVersion": "v1",
@@ -647,6 +712,28 @@ class _Handler(BaseHTTPRequestHandler):
         code, obj = self.sim.update(
             group, version, plural, namespace, name, self._body(),
             status_only=(sub == "status"),
+        )
+        return self._json(code, obj)
+
+    def do_PATCH(self):
+        route = self._route()
+        if route is None:
+            return self._json(404, _status(404, "NotFound", self.path))
+        self.sim.count_request("PATCH")
+        group, version, plural, namespace, name, sub = route
+        if sub:
+            # subresource PATCH is not simulated: refusing loudly beats
+            # silently merging a /status patch into the main resource
+            return self._json(
+                405,
+                _status(
+                    405,
+                    "MethodNotAllowed",
+                    f"PATCH on subresource {sub!r} is not supported by kubesim",
+                ),
+            )
+        code, obj = self.sim.patch(
+            group, version, plural, namespace, name, self._body()
         )
         return self._json(code, obj)
 

@@ -140,14 +140,20 @@ class RestClient(Client):
     GET_RETRIES = 3  # idempotent reads only; mutations are retried by the
     GET_RETRY_BACKOFF_S = 0.5  # reconcile loop's rate-limited requeue
 
-    def _request(self, method: str, path: str, body: Optional[Obj] = None) -> Obj:
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Obj] = None,
+        content_type: str = "application/json",
+    ) -> Obj:
         attempts = self.GET_RETRIES if method == "GET" else 1
         last_err: Optional[Exception] = None
         for attempt in range(attempts):
             if attempt:
                 time.sleep(self.GET_RETRY_BACKOFF_S * (2 ** (attempt - 1)))
             try:
-                return self._request_once(method, path, body)
+                return self._request_once(method, path, body, content_type)
             except (NotFoundError, ConflictError):
                 raise  # semantic statuses, not transient
             except (OSError, TransientAPIError) as e:
@@ -159,11 +165,17 @@ class RestClient(Client):
                 raise  # other 4xx: retrying cannot help
         raise last_err  # type: ignore[misc]
 
-    def _request_once(self, method: str, path: str, body: Optional[Obj]) -> Obj:
+    def _request_once(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Obj],
+        content_type: str = "application/json",
+    ) -> Obj:
         conn = self._make_conn()
         headers = {
             "Accept": "application/json",
-            "Content-Type": "application/json",
+            "Content-Type": content_type,
         }
         token = self._token()
         if token:
@@ -290,6 +302,25 @@ class RestClient(Client):
         meta = obj.get("metadata", {})
         path = _resource_path(av, kind, meta.get("namespace", ""), meta["name"])
         return self._request("PUT", path + "/status", obj)
+
+    def patch_labels(
+        self, api_version, kind, name, namespace="", labels=None,
+        resource_version=None,
+    ):
+        """HTTP merge patch (RFC 7386): the body is just the label
+        delta (``None`` → JSON null → delete). With ``resource_version``
+        the rv rides in the body as an optimistic-concurrency
+        precondition (apiserver PATCH semantics: 409 on mismatch);
+        without it the patch applies to whatever revision is current."""
+        meta: Obj = {"labels": dict(labels or {})}
+        if resource_version is not None:
+            meta["resourceVersion"] = str(resource_version)
+        return self._request(
+            "PATCH",
+            _resource_path(api_version, kind, namespace, name),
+            {"metadata": meta},
+            content_type="application/merge-patch+json",
+        )
 
     def delete(self, api_version, kind, name, namespace=""):
         self._request(

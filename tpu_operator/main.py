@@ -137,6 +137,11 @@ def build_manager(
     mgr.register_debug_vars(
         "reconcile_snapshot", reconciler.ctrl.snapshot_stats
     )
+    # the render half of the hot loop: current desired-state fingerprint,
+    # hit/miss profile, and per-state render cost
+    mgr.register_debug_vars(
+        "render_cache", reconciler.ctrl.render_cache.stats
+    )
     upgrade = UpgradeReconciler(client, namespace)
     mgr.add_reconciler(UPGRADE_KEY, lambda _key: upgrade.reconcile())
     return mgr, reconciler, upgrade

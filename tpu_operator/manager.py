@@ -76,7 +76,10 @@ class WorkQueue:
             self._cond.notify()
 
     def get(self, timeout: Optional[float] = None):
-        deadline = time.monotonic() + timeout if timeout else None
+        # `is not None`, NOT truthiness: get(timeout=0) is a non-blocking
+        # poll ("return a due item or None now") — treating the falsy 0.0
+        # as "no deadline" turned it into a block-forever
+        deadline = time.monotonic() + timeout if timeout is not None else None
         with self._cond:
             while True:
                 now = time.monotonic()

@@ -27,7 +27,7 @@ DOCKER_PUSH_TARGETS = $(patsubst %,docker-push-%,$(IMAGES))
 # declared AFTER the target lists exist: a .PHONY on an undefined
 # variable expands to nothing and silently un-phonies the fan-out
 .PHONY: all native test crd bundle release-bundle validate lint clean \
-	dev-run dev-run-kubesim soak bench bench-gate builder docker-build \
+	dev-run dev-run-kubesim soak bench bench-gate chaos-fast builder docker-build \
 	docker-push $(DOCKER_BUILD_TARGETS) $(DOCKER_PUSH_TARGETS)
 
 all: native crd bundle
@@ -85,6 +85,12 @@ bench:
 # reconcile pass (read path + render cache) must hold its ceiling
 bench-gate:
 	python -m pytest tests/test_reconcile_pass_bench.py -q -m slow -p no:cacheprovider
+
+# CI fault gate: the deterministic fault matrix (injected 429/500/503/
+# latency on every write verb, a full partition window, a raising state)
+# must converge — fast enough for every PR, unlike the randomized soak
+chaos-fast:
+	python -m pytest tests/test_fault_matrix.py -q -p no:cacheprovider
 
 # run the operator against the in-memory cluster and converge to Ready
 dev-run:

@@ -316,6 +316,114 @@ def test_main_once_mode(monkeypatch):
     assert main(["--fake", "--once"]) == 2
 
 
+def test_leader_election_accepts_rfc3339_without_fraction():
+    """Regression: a lease whose renewTime has NO fractional seconds
+    (legal RFC3339, written by other client stacks) used to fail the
+    single-format strptime, read as 'expired', and let a second replica
+    STEAL a live peer's lease (fail-open). Both forms must parse."""
+    from datetime import datetime, timezone
+
+    client = FakeClient()
+    a = LeaderElector(client, NS, identity="pod-a", lease_seconds=30)
+    assert a.try_acquire()
+    lease = client.get("coordination.k8s.io/v1", "Lease", a.name, NS)
+    # a FRESH renewTime without fractional seconds, held by pod-a
+    lease["spec"]["renewTime"] = datetime.now(timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+    client.update(lease)
+    b = LeaderElector(client, NS, identity="pod-b")
+    assert not b.try_acquire(), "fresh fraction-less lease was stolen"
+    lease = client.get("coordination.k8s.io/v1", "Lease", a.name, NS)
+    assert lease["spec"]["holderIdentity"] == "pod-a"
+    # a numeric-offset form (also legal RFC3339) must parse too
+    lease = client.get("coordination.k8s.io/v1", "Lease", a.name, NS)
+    lease["spec"]["renewTime"] = (
+        datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%S") + "+00:00"
+    )
+    client.update(lease)
+    assert not b.try_acquire(), "fresh offset-form lease was stolen"
+    # an EXPIRED fraction-less lease is still taken over normally
+    lease = client.get("coordination.k8s.io/v1", "Lease", a.name, NS)
+    lease["spec"]["renewTime"] = "2020-01-01T00:00:00Z"
+    client.update(lease)
+    assert b.try_acquire()
+
+
+def test_watchdog_flips_healthz_on_wedged_pass():
+    """A reconcile that hangs past the pass deadline must flip healthy()
+    (and therefore /healthz) to unhealthy while it is wedged, and recover
+    once the worker makes progress again — today's wedge-forever keeps
+    probes green and the pod never restarts."""
+    import threading
+
+    release = threading.Event()
+    entered = threading.Event()
+
+    def wedge(_key):
+        entered.set()
+        release.wait(10)
+
+    mgr = Manager(
+        FakeClient(), NS, metrics_port=0, probe_port=0, pass_deadline_s=0.2
+    )
+    mgr.add_reconciler("k", wedge)
+    mgr.start()
+    try:
+        assert mgr.healthy()  # idle: no in-flight pass, no stall
+        mgr.enqueue("k")
+        assert entered.wait(5), "reconcile never started"
+        # within one watchdog interval (the deadline) the probe flips
+        deadline = time.monotonic() + 5
+        while mgr.healthy() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not mgr.healthy(), "wedged pass never flipped the probe"
+        assert mgr.watchdog_stats()["stalled"] is True
+        assert mgr.watchdog_stats()["inflight"] == "k"
+        # the pass completes -> healthy again
+        release.set()
+        deadline = time.monotonic() + 5
+        while not mgr.healthy() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert mgr.healthy(), "probe never recovered after the stall"
+        assert mgr.watchdog_stats()["stalled"] is False
+    finally:
+        release.set()
+        mgr.stop()
+
+
+def test_debug_vars_watchdog_and_fault_tolerance():
+    """/debug/vars carries the watchdog disposition and the client's
+    retry/breaker counters (the fault-tolerance observability half)."""
+    import json
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    from tpu_operator.manager import _HealthHandler
+
+    mgr = Manager(
+        FakeClient(), NS, metrics_port=0, probe_port=0, debug_endpoints=True
+    )
+    handler = type("H", (_HealthHandler,), {"manager": mgr})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    import threading as _t
+
+    _t.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.server_port}/debug/vars", timeout=5
+        ) as r:
+            variables = json.loads(r.read().decode())
+        assert variables["watchdog"]["stalled"] is False
+        assert variables["watchdog"]["pass_deadline_s"] == mgr.pass_deadline_s
+        # FakeClient carries the same policy surface as RestClient
+        assert variables["fault_tolerance"]["retry"]["retries_total"] == 0
+        assert variables["fault_tolerance"]["breaker"]["state"] == "closed"
+    finally:
+        srv.shutdown()
+        mgr.stop()
+
+
 def test_leader_identity_from_pod_env(monkeypatch):
     """Leader identity must be pod-name + pod-UID (downward API) so two
     process incarnations on one host never share an identity within a

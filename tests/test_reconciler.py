@@ -162,10 +162,14 @@ def test_node_event_predicates():
     assert node_event_needs_reconcile("MODIFIED", tpu, new2)
 
 
-def test_step_exception_records_failure_metric(env, monkeypatch):
-    """An exception inside a state step propagates (the manager requeues
-    with backoff) but first lands in the reconcile metrics as a failed run
-    (reference reconciliation_status=-1 semantics)."""
+def test_step_exception_is_isolated_and_records_failure_metric(
+    env, monkeypatch
+):
+    """An exception inside a state step no longer aborts the pass: the
+    state is isolated (recorded under status.erroredStates + a Degraded
+    condition), the remaining states still run, and the run lands in the
+    reconcile metrics as failed (reference reconciliation_status=-1
+    semantics) with a requeue instead of a raise."""
     client = FakeClient(
         [
             {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}},
@@ -179,15 +183,47 @@ def test_step_exception_records_failure_metric(env, monkeypatch):
         r.metrics, "observe_reconcile", lambda v: recorded.append(v)
     )
 
+    real_step = r.ctrl.step
+
     def boom():
-        raise RuntimeError("control exploded")
+        if r.ctrl.state_names[r.ctrl.idx] == "state-metricsd":
+            raise RuntimeError("control exploded")
+        return real_step()
 
     monkeypatch.setattr(r.ctrl, "step", boom)
-    import pytest as _pytest
-
-    with _pytest.raises(RuntimeError, match="control exploded"):
-        r.reconcile()
+    res = r.reconcile()  # must NOT raise
+    assert res.requeue_after is not None
     assert recorded[-1] == -1
+    cr = client.get(consts.API_VERSION, "ClusterPolicy", "cluster-policy")
+    errored = cr["status"]["erroredStates"]
+    assert errored == [
+        {"state": "state-metricsd", "error": "RuntimeError: control exploded"}
+    ]
+    degraded = next(
+        c for c in cr["status"]["conditions"] if c["type"] == "Degraded"
+    )
+    assert degraded["status"] == "True"
+    assert degraded["reason"] == "StatesErrored"
+    assert "state-metricsd" in degraded.get("message", "")
+    # the pass CONTINUED: states after the errored one still deployed
+    # their operands (tpu-feature-discovery comes after state-metricsd)
+    assert client.get_or_none(
+        "apps/v1", "DaemonSet", "tpu-feature-discovery", NS
+    ) is not None
+    # a warning Event names the degradation
+    reasons = {e["reason"] for e in client.list("v1", "Event", NS)}
+    assert "StatesDegraded" in reasons
+
+    # the fault cleared: the next pass drops the Degraded condition and
+    # the erroredStates block
+    monkeypatch.setattr(r.ctrl, "step", real_step)
+    r.reconcile()
+    cr = client.get(consts.API_VERSION, "ClusterPolicy", "cluster-policy")
+    assert "erroredStates" not in cr["status"]
+    degraded = next(
+        c for c in cr["status"]["conditions"] if c["type"] == "Degraded"
+    )
+    assert degraded["status"] == "False"
 
 
 # ---------------------------------------------------------------------------

@@ -56,13 +56,19 @@ class _Handler(BaseHTTPRequestHandler):
         type(self).requests.append(
             (self.command, self.path, self.headers.get("Authorization", ""))
         )
+        headers = {}
         if type(self).script:
-            status, body = type(self).script.pop(0)
+            entry = type(self).script.pop(0)
+            status, body = entry[0], entry[1]
+            if len(entry) > 2:
+                headers = entry[2]
         else:
             status, body = 200, b"{}"
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in headers.items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -141,12 +147,90 @@ def test_get_retries_exhausted(stub):
     assert len(_Handler.requests) == stub.GET_RETRIES
 
 
-def test_mutations_do_not_retry_transient(stub):
-    _Handler.script = [(500, b"boom")]
+def test_writes_retry_transient_then_succeed(stub):
+    """Writes ride the same fault-tolerance policy as reads now: a 5xx
+    hiccup on create/update/delete is retried with jittered backoff
+    instead of failing the whole reconcile pass through."""
+    _Handler.script = [(500, b"boom"), (503, b"still booting")]
+    stub.create({"apiVersion": "v1", "kind": "Pod",
+                 "metadata": {"name": "p", "namespace": "ns1"}})
+    assert len(_Handler.requests) == 3
+    assert stub.retry_policy.stats()["retries_by_verb"]["POST"] == 2
+
+
+def test_writes_retry_exhausted(stub):
+    _Handler.script = [(500, b"boom")] * 10
     with pytest.raises(TransientAPIError):
-        stub.create({"apiVersion": "v1", "kind": "Pod",
-                     "metadata": {"name": "p", "namespace": "ns1"}})
-    assert len(_Handler.requests) == 1
+        stub.update({"apiVersion": "v1", "kind": "Node",
+                     "metadata": {"name": "n"}})
+    assert len(_Handler.requests) == stub.retry_policy.write_attempts
+
+
+def test_429_honors_retry_after(stub):
+    import time
+
+    _Handler.script = [
+        (429, b"slow down", {"Retry-After": "0.2"}),
+        (200, b"{}"),
+    ]
+    t0 = time.monotonic()
+    stub.update({"apiVersion": "v1", "kind": "Node",
+                 "metadata": {"name": "n"}})
+    # the server-provided delay wins over the (much smaller) base backoff
+    assert time.monotonic() - t0 >= 0.2
+    assert len(_Handler.requests) == 2
+    assert stub.retry_policy.stats()["retry_after_honored"] == 1
+
+
+def test_retry_budget_gives_up(stub):
+    """A hostile Retry-After cannot park the worker past the per-call
+    budget: the call surfaces the last error instead of sleeping."""
+    stub.retry_policy.budget_s = 0.1
+    _Handler.script = [(429, b"slow down", {"Retry-After": "60"})] * 5
+    with pytest.raises(TransientAPIError):
+        stub.update({"apiVersion": "v1", "kind": "Node",
+                     "metadata": {"name": "n"}})
+    assert len(_Handler.requests) == 1  # budget said no to the 60s sleep
+    assert stub.retry_policy.stats()["giveups_total"] == 1
+
+
+def test_circuit_breaker_trips_and_fast_fails(stub):
+    """Consecutive transport-level failures open the global breaker;
+    while open, new calls fail fast without touching the wire, and a
+    semantic 4xx (server alive) resets it back closed."""
+    from tpu_operator.kube.rest import CircuitOpenError
+
+    stub.retry_policy.read_attempts = 1
+    stub.retry_policy.write_attempts = 1
+    stub.breaker.threshold = 3
+    stub.breaker.cooldown_base_s = 30.0  # stays open for the assertion
+    _Handler.script = [(500, b"boom")] * 3
+    for _ in range(3):
+        with pytest.raises(TransientAPIError):
+            stub.get("v1", "Node", "n1")
+    assert stub.breaker.stats()["state"] == "open"
+    wire_calls = len(_Handler.requests)
+    with pytest.raises(CircuitOpenError):
+        stub.get("v1", "Node", "n1")
+    assert len(_Handler.requests) == wire_calls  # fast fail, no wire
+    assert stub.breaker.stats()["fast_fails_total"] == 1
+    # half-open after cooldown: a success closes it again
+    stub.breaker._open_until = 0.0  # force the cooldown to lapse
+    assert stub.get("v1", "Node", "n1") == {}
+    assert stub.breaker.stats()["state"] == "closed"
+
+
+def test_429_never_trips_breaker(stub):
+    """Load shedding means the apiserver is ALIVE: however many 429s in
+    a row, the breaker stays closed (only transport/5xx failures - a
+    dead server - may open it)."""
+    stub.retry_policy.write_attempts = 2
+    stub.breaker.threshold = 2
+    _Handler.script = [(429, b"slow", {"Retry-After": "0.01"})] * 4
+    with pytest.raises(TransientAPIError):
+        stub.update({"apiVersion": "v1", "kind": "Node",
+                     "metadata": {"name": "n"}})
+    assert stub.breaker.stats()["state"] == "closed"
 
 
 def test_crud_paths(stub):

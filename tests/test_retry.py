@@ -1,0 +1,148 @@
+"""Unit tests for the fault-tolerance policy objects (kube/retry.py):
+backoff shape, Retry-After handling, breaker trip/cooldown/reset, and
+the watch reconnect backoff — the pure halves of what the wire tests in
+test_rest_client.py / test_fault_matrix.py exercise end to end."""
+
+import random
+
+from tpu_operator.kube.retry import CircuitBreaker, RetryPolicy, WatchBackoff
+
+
+def test_retry_policy_per_verb_attempts():
+    p = RetryPolicy(read_attempts=3, write_attempts=4)
+    assert p.attempts_for("GET") == 3
+    for verb in ("POST", "PUT", "PATCH", "DELETE"):
+        assert p.attempts_for(verb) == 4
+
+
+def test_backoff_is_jittered_exponential_with_cap():
+    p = RetryPolicy(backoff_s=1.0, cap_s=4.0, rng=random.Random(7))
+    # attempt n draws from [d/2, d], d = min(cap, base * 2**(n-1))
+    for attempt, d in ((1, 1.0), (2, 2.0), (3, 4.0), (4, 4.0), (10, 4.0)):
+        for _ in range(20):
+            delay = p.backoff(attempt)
+            assert d / 2 <= delay <= d
+    # jitter actually varies (not a fixed point)
+    assert len({round(p.backoff(2), 6) for _ in range(10)}) > 1
+
+
+def test_backoff_honors_retry_after_capped():
+    p = RetryPolicy(backoff_s=0.01, cap_s=2.0)
+    assert p.backoff(1, retry_after=0.5) == 0.5
+    # a hostile/huge header is capped, a negative one floored
+    assert p.backoff(1, retry_after=3600) == 2.0
+    assert p.backoff(1, retry_after=-5) == 0.0
+    # backoff() is pure computation: honors count only when the caller
+    # commits to the retry (count_retry), never on a budget give-up
+    assert p.stats()["retry_after_honored"] == 0
+
+
+def test_retry_counters():
+    p = RetryPolicy()
+    p.count_retry("POST")
+    p.count_retry("POST", honored_retry_after=True)
+    p.count_retry("GET")
+    p.count_giveup()
+    s = p.stats()
+    assert s["retries_total"] == 3
+    assert s["retries_by_verb"] == {"POST": 2, "GET": 1}
+    assert s["giveups_total"] == 1
+    assert s["retry_after_honored"] == 1
+
+
+def test_breaker_trips_after_threshold_and_cools_down():
+    b = CircuitBreaker(threshold=3, cooldown_base_s=30.0)
+    for _ in range(2):
+        b.record_failure()
+    assert b.allow()
+    assert b.stats()["state"] == "half-open"  # failures seen, not open
+    b.record_failure()  # third consecutive: trip
+    assert b.stats()["state"] == "open"
+    assert not b.allow()
+    assert b.stats()["fast_fails_total"] == 1
+    assert b.stats()["trips_total"] == 1
+    # success (e.g. a request already in flight) closes it fully
+    b.record_success()
+    assert b.allow()
+    assert b.stats()["state"] == "closed"
+
+
+def test_breaker_success_resets_streak():
+    b = CircuitBreaker(threshold=3)
+    b.record_failure()
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    b.record_failure()
+    assert b.stats()["state"] != "open"  # never hit 3 consecutive
+
+
+def test_breaker_half_open_single_probe_failure_retrips():
+    """After a trip, ONE failure past the cooldown re-trips immediately
+    (doubled window) — a dead server must not earn a fresh full
+    threshold of stacked timeouts per cooldown window."""
+    b = CircuitBreaker(threshold=3, cooldown_base_s=1.0, cooldown_cap_s=8.0)
+    for _ in range(3):
+        b.record_failure()
+    assert b.stats()["state"] == "open"
+    b._open_until = 0.0  # lapse the cooldown -> half-open probe
+    b.record_failure()  # single probe failure
+    assert b.stats()["state"] == "open"
+    assert b.stats()["trips_total"] == 2
+    # a success during half-open closes fully; the streak is forgotten
+    b._open_until = 0.0
+    b.record_success()
+    b.record_failure()
+    b.record_failure()
+    assert b.stats()["state"] != "open"  # back to needing the threshold
+
+
+def test_breaker_cooldown_doubles_per_consecutive_trip():
+    b = CircuitBreaker(threshold=1, cooldown_base_s=1.0, cooldown_cap_s=8.0)
+    b.record_failure()  # trip 1: 1s window
+    first = b.stats()["open_for_s"]
+    b._open_until = 0.0  # lapse the window (half-open)
+    b.record_failure()  # trip 2: doubled window
+    second = b.stats()["open_for_s"]
+    assert second > first
+    assert second <= 8.0
+
+
+def test_breaker_closed_fast_path_is_lock_free_compare():
+    b = CircuitBreaker()
+    # closed state: allow() must not count anything or take the lock path
+    for _ in range(1000):
+        assert b.allow()
+    assert b.stats()["fast_fails_total"] == 0
+
+
+def test_watch_backoff_grows_jittered_and_resets():
+    wb = WatchBackoff(base_s=1.0, cap_s=8.0, rng=random.Random(3))
+    d1 = wb.next_delay()
+    d2 = wb.next_delay()
+    d3 = wb.next_delay()
+    assert 0.5 <= d1 <= 1.0
+    assert 1.0 <= d2 <= 2.0
+    assert 2.0 <= d3 <= 4.0
+    for _ in range(10):
+        assert wb.next_delay() <= 8.0  # capped
+    wb.reset()
+    assert 0.5 <= wb.next_delay() <= 1.0
+
+
+def test_clients_share_the_policy_surface():
+    """Every Client implementation carries retry_policy/breaker and
+    fault_stats() — one tuning/observability surface regardless of
+    backend (RestClient consults them; FakeClient holds them;
+    CachedClient delegates to its wrapped live client)."""
+    from tpu_operator.kube import FakeClient
+    from tpu_operator.kube.cache import CachedClient
+
+    fake = FakeClient()
+    assert fake.fault_stats()["breaker"]["state"] == "closed"
+    assert fake.fault_stats()["retry"]["retries_total"] == 0
+
+    cached = CachedClient(fake, namespace="ns")
+    assert cached.retry_policy is fake.retry_policy
+    assert cached.breaker is fake.breaker
+    assert cached.fault_stats() == fake.fault_stats()

@@ -1,0 +1,122 @@
+"""Direct coverage for WorkQueue delay semantics and RateLimiter
+cap/forget behavior — previously exercised only through the manager
+e2es, where a timing bug hides behind the reconcile loop's own retries."""
+
+import threading
+import time
+
+from tpu_operator.manager import RateLimiter, WorkQueue
+
+
+# ---------------------------------------------------------------------------
+# WorkQueue
+# ---------------------------------------------------------------------------
+
+
+def test_add_supersedes_later_addafter():
+    """client-go semantics: an immediate Add on a pending delayed item
+    pulls the due time FORWARD — a watch event must not wait out a long
+    requeue timer."""
+    q = WorkQueue()
+    q.add("a", delay=30.0)
+    q.add("a")  # now
+    t0 = time.monotonic()
+    assert q.get(timeout=1.0) == "a"
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_later_addafter_does_not_delay_pending_item():
+    """The reverse direction: a LATER AddAfter on a pending item must
+    not push an already-due (or sooner-due) execution back."""
+    q = WorkQueue()
+    q.add("a")
+    q.add("a", delay=30.0)  # must not supersede the immediate one
+    t0 = time.monotonic()
+    assert q.get(timeout=1.0) == "a"
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_pending_items_coalesce_to_one_execution():
+    q = WorkQueue()
+    for _ in range(5):
+        q.add("a")
+    assert len(q) == 1
+    assert q.get(timeout=0.5) == "a"
+    assert q.get(timeout=0) is None
+
+
+def test_get_zero_timeout_polls_without_blocking():
+    q = WorkQueue()
+    t0 = time.monotonic()
+    assert q.get(timeout=0) is None
+    assert time.monotonic() - t0 < 0.5
+    q.add("due")
+    q.add("future", delay=30.0)
+    assert q.get(timeout=0) == "due"
+    assert q.get(timeout=0) is None  # the future item is not served early
+
+
+def test_earliest_due_item_first():
+    q = WorkQueue()
+    q.add("late", delay=0.2)
+    q.add("early", delay=0.05)
+    assert q.get(timeout=1.0) == "early"
+    assert q.get(timeout=1.0) == "late"
+
+
+def test_delayed_item_becomes_due_while_waiting():
+    """A blocking get must wake for an item whose delay expires during
+    the wait (not only for notify)."""
+    q = WorkQueue()
+    q.add("a", delay=0.15)
+    t0 = time.monotonic()
+    assert q.get(timeout=2.0) == "a"
+    waited = time.monotonic() - t0
+    assert 0.1 <= waited < 1.0
+
+
+def test_add_wakes_blocked_getter():
+    q = WorkQueue()
+    got = []
+
+    def getter():
+        got.append(q.get(timeout=5.0))
+
+    t = threading.Thread(target=getter)
+    t.start()
+    time.sleep(0.05)
+    q.add("a")
+    t.join(timeout=2.0)
+    assert got == ["a"]
+
+
+# ---------------------------------------------------------------------------
+# RateLimiter
+# ---------------------------------------------------------------------------
+
+
+def test_rate_limiter_items_are_independent():
+    rl = RateLimiter(base=0.1, cap=3.0)
+    for _ in range(10):
+        rl.when("noisy")
+    assert rl.when("noisy") == 3.0
+    assert rl.when("quiet") == 0.1  # unaffected by the noisy neighbor
+
+
+def test_rate_limiter_forget_only_named_item():
+    rl = RateLimiter(base=0.1, cap=3.0)
+    rl.when("a")
+    rl.when("a")
+    rl.when("b")
+    rl.forget("a")
+    assert rl.when("a") == 0.1  # reset
+    assert rl.when("b") == 0.2  # untouched
+
+
+def test_rate_limiter_caps_and_never_overflows():
+    rl = RateLimiter(base=0.1, cap=3.0)
+    delays = [rl.when("x") for _ in range(2000)]
+    assert max(delays) == 3.0
+    assert delays[-1] == 3.0
+    rl.forget("x")
+    assert rl.when("x") == 0.1

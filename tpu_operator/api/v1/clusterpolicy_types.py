@@ -784,6 +784,10 @@ class ClusterPolicyStatus(SpecBase):
     # slice-scoped readiness aggregate (no reference analogue; SURVEY.md §7
     # multi-host hard part): {"total": N, "ready": M, "degraded": [ids]}
     slices: Dict[str, Any] = field(default_factory=dict)
+    # per-state error isolation: states whose step() raised this pass,
+    # [{"state": name, "error": "Type: message"}]; the pass continues to
+    # independent states and a Degraded condition summarizes this block
+    errored_states: List[Dict[str, Any]] = field(default_factory=list)
 
 
 @dataclass

@@ -114,24 +114,39 @@ class ClusterPolicyReconciler:
 
         overall = State.READY
         not_ready_states = []
+        errored_states = []  # (state, "ExcType: message") — this pass
         self.ctrl.idx = 0
-        try:
-            while not self.ctrl.last():
-                state_name = self.ctrl.state_names[self.ctrl.idx]
+        while not self.ctrl.last():
+            state_name = self.ctrl.state_names[self.ctrl.idx]
+            try:
                 status = self.ctrl.step()
-                self.metrics.set_state(
-                    state_name,
-                    {State.READY: 1, State.NOT_READY: 0}.get(status, -1),
+            except Exception as e:  # noqa: BLE001
+                # per-state error isolation: one state's exception (a
+                # busted asset, a write that exhausted its retries) must
+                # not abort the remaining INDEPENDENT states — the
+                # reference reports reconciliation_status per run rather
+                # than losing the whole pass. step() advances idx only on
+                # success, so move past the errored state ourselves.
+                log.exception(
+                    "state %s failed; isolating and continuing", state_name
                 )
-                if status == State.NOT_READY:
-                    overall = State.NOT_READY
-                    not_ready_states.append(state_name)
-                    log.info("state %s not ready; will requeue", state_name)
-        except Exception:
-            # record the failure before the manager's rate-limited requeue
-            # (reference sets reconciliation_status=-1 on errored runs)
-            self.metrics.observe_reconcile(-1)
-            raise
+                self.ctrl.idx += 1
+                overall = State.NOT_READY
+                errored_states.append(
+                    (state_name, f"{type(e).__name__}: {e}")
+                )
+                self.metrics.set_state(state_name, -2)
+                continue
+            self.metrics.set_state(
+                state_name,
+                {State.READY: 1, State.NOT_READY: 0}.get(status, -1),
+            )
+            if status == State.NOT_READY:
+                overall = State.NOT_READY
+                not_ready_states.append(state_name)
+                log.info("state %s not ready; will requeue", state_name)
+        if self.metrics and getattr(self.metrics, "states_errored", None):
+            self.metrics.states_errored.set(len(errored_states))
 
         slice_summary = self._aggregate_slices()
 
@@ -154,9 +169,25 @@ class ClusterPolicyReconciler:
                 "OperandsNotReady",
                 f"states not ready: {', '.join(not_ready_states)}",
             )
+        if errored_states:
+            record_event(
+                self.client,
+                self.ctrl.namespace,
+                primary,
+                TYPE_WARNING,
+                "StatesDegraded",
+                "states errored: "
+                + "; ".join(f"{n} ({e})" for n, e in errored_states),
+            )
 
-        self._set_status(primary, overall, slice_summary)
+        self._set_status(primary, overall, slice_summary, errored_states)
         self._update_fleet_metrics()
+        if errored_states:
+            # the run is degraded even though it completed: report it
+            # like the reference's reconciliation_status=-1, and keep the
+            # level-triggered 5s requeue converging the healthy states
+            self.metrics.observe_reconcile(-1)
+            return Result(requeue_after=REQUEUE_NOT_READY_S)
         if overall == State.NOT_READY:
             self.metrics.observe_reconcile(0)
             return Result(requeue_after=REQUEUE_NOT_READY_S)
@@ -292,10 +323,27 @@ class ClusterPolicyReconciler:
             self._render_ms_states = current
             for state, ms in render["render_ms_by_state"].items():
                 m.state_render_ms.labels(state=state).set(ms)
+        if getattr(m, "apiserver_retries", None) and hasattr(
+            self.client, "fault_stats"
+        ):
+            fault = self.client.fault_stats()
+            retry = fault.get("retry")
+            if retry:
+                m.apiserver_retries.set(retry["retries_total"])
+                m.apiserver_retry_giveups.set(retry["giveups_total"])
+            breaker = fault.get("breaker")
+            if breaker:
+                m.apiserver_breaker_open.set(
+                    1 if breaker["state"] == "open" else 0
+                )
+                m.apiserver_breaker_trips.set(breaker["trips_total"])
 
-    def _set_status(self, cp_obj, state: str, slice_summary=None) -> None:
-        """reference ``updateCRState`` (``:198``) + a Ready condition + the
-        slice-readiness aggregate (no reference analogue)."""
+    def _set_status(
+        self, cp_obj, state: str, slice_summary=None, errored=None
+    ) -> None:
+        """reference ``updateCRState`` (``:198``) + Ready and Degraded
+        conditions, the per-state error block, and the slice-readiness
+        aggregate (no reference analogue)."""
         status = cp_obj.setdefault("status", {})
         slices = None
         if slice_summary is not None:
@@ -305,41 +353,74 @@ class ClusterPolicyReconciler:
             }
             if slice_summary.degraded:
                 slices["degraded"] = slice_summary.degraded
+        errored_block = [
+            {"state": n, "error": e} for n, e in (errored or ())
+        ]
         if (
             status.get("state") == state
             and status.get("namespace")
             == (self.ctrl.namespace or status.get("namespace"))
             and (slices is None or status.get("slices") == slices)
+            and (status.get("erroredStates") or []) == errored_block
         ):
             return
         from datetime import datetime, timezone
 
-        prev_state = status.get("state")
-        prev_conditions = status.get("conditions") or []
+        prev_conditions = {
+            c.get("type"): c for c in (status.get("conditions") or [])
+        }
         status["state"] = state
         status["namespace"] = self.ctrl.namespace
         if slices is not None:
             status["slices"] = slices
-        # k8s condition semantics: lastTransitionTime only moves when the
-        # condition's status actually flips, not on every status write
-        # (e.g. a slices-aggregate fluctuation while Ready stays True)
-        if prev_state == state and prev_conditions:
-            transition = prev_conditions[0].get("lastTransitionTime")
+        if errored_block:
+            status["erroredStates"] = errored_block
         else:
-            transition = datetime.now(timezone.utc).strftime(
-                "%Y-%m-%dT%H:%M:%SZ"
-            )
+            status.pop("erroredStates", None)
+
+        now = datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+        def condition(ctype, value, reason, message=None):
+            # k8s condition semantics: lastTransitionTime only moves when
+            # the condition's status actually flips, not on every status
+            # write (e.g. a slices-aggregate fluctuation while Ready
+            # stays True)
+            prev = prev_conditions.get(ctype)
+            cond = {
+                "type": ctype,
+                "status": value,
+                "reason": reason,
+                "lastTransitionTime": (
+                    prev.get("lastTransitionTime")
+                    if prev is not None and prev.get("status") == value
+                    else now
+                ),
+            }
+            if message:
+                cond["message"] = message
+            return cond
+
         status["conditions"] = [
-            {
-                "type": "Ready",
-                "status": "True" if state == State.READY else "False",
-                "reason": {
+            condition(
+                "Ready",
+                "True" if state == State.READY else "False",
+                {
                     State.READY: "OperandsReady",
                     State.NOT_READY: "OperandsNotReady",
                     State.IGNORED: "IgnoredDuplicate",
                 }.get(state, "Unknown"),
-                "lastTransitionTime": transition,
-            }
+            ),
+            condition(
+                "Degraded",
+                "True" if errored_block else "False",
+                "StatesErrored" if errored_block else "AllStatesHealthy",
+                message=(
+                    "; ".join(
+                        f"{b['state']}: {b['error']}" for b in errored_block
+                    )
+                    or None
+                ),
+            ),
         ]
         try:
             self.client.update_status(cp_obj)

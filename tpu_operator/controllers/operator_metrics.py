@@ -70,8 +70,16 @@ class OperatorMetrics:
         )
         self.operand_states = g(
             "operand_state",
-            "Per-state readiness: 1 ready / 0 not-ready / -1 disabled",
+            "Per-state readiness: 1 ready / 0 not-ready / -1 disabled / "
+            "-2 errored (isolated this pass, see status.erroredStates)",
             ("state",),
+        )
+        # per-state error isolation: how many states raised this pass and
+        # were isolated instead of aborting the run (Degraded condition)
+        self.states_errored = g(
+            "states_errored",
+            "States whose step() raised this pass (isolated; the pass "
+            "continued to independent states)",
         )
         # slice-scoped readiness (no reference analogue; SURVEY.md §7)
         self.slices_total = g(
@@ -192,6 +200,39 @@ class OperatorMetrics:
             "fingerprint invalidation (ms)",
             ("state",),
         )
+        # apiserver fault-tolerance surface (kube/retry.py): gauges fed
+        # from the client's own counters each pass — retry pressure and
+        # the global circuit breaker's disposition
+        self.apiserver_retries = g(
+            "apiserver_request_retries",
+            "API requests retried by the client's fault-tolerance policy "
+            "(transient 5xx/429/connection failures)",
+        )
+        self.apiserver_retry_giveups = g(
+            "apiserver_retry_giveups",
+            "API calls that exhausted their per-call retry budget",
+        )
+        self.apiserver_breaker_open = g(
+            "apiserver_breaker_open",
+            "1 while the global apiserver circuit breaker is open "
+            "(requests fail fast instead of hammering a dead server)",
+        )
+        self.apiserver_breaker_trips = g(
+            "apiserver_breaker_trips",
+            "Times the apiserver circuit breaker tripped open",
+        )
+        # optimistic-concurrency pressure: each count is one 409 retry
+        # inside mutate_with_retry (shared-object writers re-reading and
+        # re-applying); sustained growth means writers are fighting.
+        # Installed as the kube layer's hook so client.py never imports
+        # upward into controllers.
+        self.conflict_retries = c(
+            "conflict_retries_total",
+            "Optimistic-concurrency (409) retries in mutate_with_retry",
+        )
+        from tpu_operator.kube import client as _kube_client
+
+        _kube_client.on_conflict_retry = self.conflict_retries.inc
 
     # -- convenience ----------------------------------------------------
     def observe_reconcile(self, status_value: int) -> None:

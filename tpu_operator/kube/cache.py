@@ -851,6 +851,17 @@ class CachedClient(Client):
     def drift_repairs_total(self) -> int:
         return sum(inf.drift_repairs for inf in self._informers.values())
 
+    # -- fault-tolerance surface (delegates to the wrapped client, which
+    # owns the wire: one policy/breaker per transport, however many
+    # caching layers sit above it) ---------------------------------------
+    @property
+    def retry_policy(self):
+        return getattr(self.live, "retry_policy", None)
+
+    @property
+    def breaker(self):
+        return getattr(self.live, "breaker", None)
+
     def _informer_for(
         self, api_version: str, kind: str, namespace: str
     ) -> Optional[Informer]:

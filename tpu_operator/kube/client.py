@@ -41,6 +41,24 @@ class EvictionBlockedError(RuntimeError):
     level-triggered drain step does the same per reconcile pass."""
 
 
+# observability hook the metrics layer installs (OperatorMetrics points
+# it at its conflict_retries_total counter) — an injection point rather
+# than an upward import, so the kube layer stays controllers-free
+on_conflict_retry: Optional[Callable[[], None]] = None
+
+
+def _count_conflict_retry() -> None:
+    """Bump the installed conflict-retry counter (best-effort: the
+    metrics surface must never break a write path)."""
+    hook = on_conflict_retry
+    if hook is None:
+        return
+    try:
+        hook()
+    except Exception:
+        pass
+
+
 def mutate_with_retry(
     client: "Client",
     api_version: str,
@@ -51,19 +69,25 @@ def mutate_with_retry(
     mutate: Callable[[Obj], bool],
     attempts: int = 5,
     backoff_s: float = 0.05,
+    backoff_cap_s: float = 1.0,
 ) -> Obj:
     """Optimistic-concurrency read-mutate-update: re-GET and re-apply on a
     409 — the discipline every writer of a SHARED object (Nodes carry
     labels from the deploy-label bus, the upgrade FSM, TFD, the slice and
     maintenance operands) must follow. ``mutate(obj) -> bool`` returns
     whether anything changed; False short-circuits without a write.
+    Backoff is jittered exponential with a cap: the writers racing here
+    are exactly the ones that would otherwise re-collide in lockstep.
     Raises the last ConflictError when the race outlasts ``attempts``."""
+    import random
     import time
 
     last: Optional[Exception] = None
     for attempt in range(attempts):
         if attempt:
-            time.sleep(backoff_s * attempt)
+            _count_conflict_retry()
+            delay = min(backoff_cap_s, backoff_s * (2 ** (attempt - 1)))
+            time.sleep(random.uniform(delay / 2, delay))
         if attempt == 0:
             # copy=True: the informer-backed client otherwise hands back
             # a SHARED frozen view, and mutate() is about to mutate
@@ -176,6 +200,23 @@ class Client:
     a private mutable object. Plain clients (FakeClient, RestClient)
     always return private objects and simply ignore the flag, so passing
     ``copy=True`` is portable across every implementation."""
+
+    # fault-tolerance surface (kube/retry.py): every implementation
+    # carries the same pair so callers and tests tune one object
+    # regardless of backend. ``RestClient`` consults them on the wire;
+    # ``FakeClient`` holds them for parity (no wire, no transients);
+    # ``CachedClient`` delegates to its wrapped live client.
+    retry_policy = None
+    breaker = None
+
+    def fault_stats(self) -> Dict[str, Any]:
+        """Retry + breaker counters for /debug/vars and metrics."""
+        out: Dict[str, Any] = {}
+        if self.retry_policy is not None:
+            out["retry"] = self.retry_policy.stats()
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.stats()
+        return out
 
     # -- reads ----------------------------------------------------------
     def get(
@@ -377,10 +418,17 @@ class FakeClient(Client):
     """
 
     def __init__(self, objs: Iterable[Obj] = ()):  # noqa: D401
+        from tpu_operator.kube.retry import CircuitBreaker, RetryPolicy
+
         self._lock = threading.RLock()
         self._store: Dict[Tuple[str, str, str, str], Obj] = {}
         self._rv = 0
         self._watchers: List[Callable[[str, Obj], None]] = []
+        # same policy surface as RestClient (tests tune/observe it
+        # uniformly); the in-memory store has no transient failures, so
+        # these are carried, not consulted
+        self.retry_policy = RetryPolicy()
+        self.breaker = CircuitBreaker()
         for o in objs:
             self.create(copy.deepcopy(o))
 

@@ -87,6 +87,15 @@ class KubeSim:
         # from via resync
         self._watch_drop_faults: Dict[str, int] = {}
         self.watch_drops_injected = 0
+        # verb-level fault injection (the generalization of
+        # inject_watch_drop): (verb, plural) -> FIFO of fault dicts, with
+        # "*" wildcards on either axis; plus a full-partition window
+        # during which EVERY request answers 503 (and active watch
+        # streams are cut). Drives the deterministic fault-matrix test.
+        self._faults: Dict[Tuple[str, str], List[dict]] = {}
+        self._partition_until = 0.0
+        self.faults_injected = 0
+        self.partition_rejects = 0
         # Events expire like a real apiserver's --event-ttl (default 1h):
         # without it an hour-scale Event storm grows the store — and
         # every informer mirroring it — without bound. Keyed by store
@@ -113,6 +122,73 @@ class KubeSim:
             self._watch_drop_faults[plural] = n - 1
             self.watch_drops_injected += 1
             return True
+
+    # -- verb-level fault injection --------------------------------------
+    def inject_fault(
+        self,
+        verb: str = "*",
+        plural: str = "*",
+        *,
+        code: Optional[int] = None,
+        retry_after: Optional[float] = None,
+        latency_s: float = 0.0,
+        count: int = 1,
+    ) -> None:
+        """Queue ``count`` injected faults for requests matching
+        ``(verb, plural)`` — verbs are the request-accounting names
+        (GET/LIST/WATCH/POST/PUT/PATCH/DELETE), ``"*"`` matches any.
+        Each consumed fault adds ``latency_s`` of service delay, then
+        answers HTTP ``code`` when given (with a ``Retry-After`` header
+        when ``retry_after`` is set — the 429 contract clients must
+        honor); ``code=None`` makes it latency-only (delay, then serve
+        normally). Faults are consumed FIFO, most-specific key first."""
+        with self._lock:
+            self._faults.setdefault((verb, plural), []).extend(
+                {
+                    "code": code,
+                    "retry_after": retry_after,
+                    "latency_s": latency_s,
+                }
+                for _ in range(count)
+            )
+
+    def partition(self, duration_s: float) -> None:
+        """Open a full apiserver partition window: until it closes,
+        every request (every verb, watch streams included) answers 503
+        and active watch streams are cut — the operator must ride it out
+        on backoff and converge after the wall comes down."""
+        with self._lock:
+            self._partition_until = time.monotonic() + duration_s
+
+    def partitioned(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self._partition_until
+
+    def next_fault(self, verb: str, plural: str) -> Optional[dict]:
+        """Consume the next matching injected fault (or a synthetic 503
+        while a partition window is open); None = serve normally."""
+        with self._lock:
+            if time.monotonic() < self._partition_until:
+                self.partition_rejects += 1
+                return {"code": 503, "retry_after": None, "latency_s": 0.0}
+            for key in (
+                (verb, plural),
+                (verb, "*"),
+                ("*", plural),
+                ("*", "*"),
+            ):
+                q = self._faults.get(key)
+                if q:
+                    self.faults_injected += 1
+                    return q.pop(0)
+        return None
+
+    def faults_pending(self) -> int:
+        """Injected (queued) faults not yet consumed — the fault-matrix
+        test asserts this drains to zero, proving every injection was
+        actually exercised."""
+        with self._lock:
+            return sum(len(q) for q in self._faults.values())
 
     def count_request(self, verb: str, is_watch: bool = False) -> None:
         key = "WATCH" if is_watch else verb
@@ -500,6 +576,10 @@ class KubeSim:
             )
             return
         while not stop.is_set() and time.monotonic() < deadline:
+            if self.partitioned():
+                # a partition cuts live streams too: the client sees a
+                # clean close, and its reconnect hits the 503 wall
+                return
             if plural == "events":
                 # any active Event watch keeps expiry live even when
                 # nobody lists — informers must see the DELETEDs
@@ -591,13 +671,43 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     # -- plumbing ---------------------------------------------------------
-    def _json(self, code: int, obj: dict) -> None:
+    def _json(self, code: int, obj: dict, headers: Optional[dict] = None) -> None:
         data = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(data)
+
+    def _maybe_fault(self, verb: str, plural: str) -> bool:
+        """Consume an injected fault for this request. Returns True when
+        the request was answered with an injected error (the caller must
+        return); latency-only faults delay, then fall through to normal
+        service."""
+        fault = self.sim.next_fault(verb, plural)
+        if fault is None:
+            return False
+        if fault["latency_s"]:
+            time.sleep(fault["latency_s"])
+        code = fault["code"]
+        if not code:
+            return False  # latency-only: serve normally after the delay
+        headers = {}
+        if fault["retry_after"] is not None:
+            headers["Retry-After"] = fault["retry_after"]
+        reason = {
+            429: "TooManyRequests",
+            500: "InternalError",
+            503: "ServiceUnavailable",
+        }.get(code, "InjectedFault")
+        self._json(
+            code,
+            _status(code, reason, f"injected fault on {verb} {plural}"),
+            headers,
+        )
+        return True
 
     def _body(self) -> dict:
         length = int(self.headers.get("Content-Length", 0) or 0)
@@ -644,12 +754,18 @@ class _Handler(BaseHTTPRequestHandler):
         qs = parse_qs(urlparse(self.path).query)
         if name:
             self.sim.count_request("GET")
+            if self._maybe_fault("GET", plural):
+                return None
             code, obj = self.sim.get(group, version, plural, namespace, name)
             return self._json(code, obj)
         if qs.get("watch", ["false"])[0] == "true":
             self.sim.count_request("GET", is_watch=True)
+            if self._maybe_fault("WATCH", plural):
+                return None
             return self._watch(group, version, plural, namespace, qs)
         self.sim.count_request("LIST")
+        if self._maybe_fault("LIST", plural):
+            return None
         code, obj = self.sim.list(
             group,
             version,
@@ -697,6 +813,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.sim.count_request("POST")
         group, version, plural, namespace, name, sub = route
         body = self._body()
+        if self._maybe_fault("POST", plural):
+            return None
         if plural == "pods" and sub == "eviction":
             code, obj = self.sim.evict(group, version, namespace, name)
             return self._json(code, obj)
@@ -709,8 +827,14 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(404, _status(404, "NotFound", self.path))
         self.sim.count_request("PUT")
         group, version, plural, namespace, name, sub = route
+        # the body MUST be consumed before an injected error reply:
+        # unread bytes would corrupt the next request on the keep-alive
+        # connection
+        body = self._body()
+        if self._maybe_fault("PUT", plural):
+            return None
         code, obj = self.sim.update(
-            group, version, plural, namespace, name, self._body(),
+            group, version, plural, namespace, name, body,
             status_only=(sub == "status"),
         )
         return self._json(code, obj)
@@ -721,6 +845,9 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(404, _status(404, "NotFound", self.path))
         self.sim.count_request("PATCH")
         group, version, plural, namespace, name, sub = route
+        body = self._body()  # consume before any injected reply (framing)
+        if self._maybe_fault("PATCH", plural):
+            return None
         if sub:
             # subresource PATCH is not simulated: refusing loudly beats
             # silently merging a /status patch into the main resource
@@ -733,7 +860,7 @@ class _Handler(BaseHTTPRequestHandler):
                 ),
             )
         code, obj = self.sim.patch(
-            group, version, plural, namespace, name, self._body()
+            group, version, plural, namespace, name, body
         )
         return self._json(code, obj)
 
@@ -743,6 +870,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(404, _status(404, "NotFound", self.path))
         self.sim.count_request("DELETE")
         group, version, plural, namespace, name, _ = route
+        if self._maybe_fault("DELETE", plural):
+            return None
         code, obj = self.sim.delete(group, version, plural, namespace, name)
         return self._json(code, obj)
 

@@ -23,17 +23,29 @@ from typing import Dict, List, Optional
 from urllib.parse import quote, urlencode
 
 from tpu_operator.kube.client import Client, ConflictError, NotFoundError, Obj
+from tpu_operator.kube.retry import CircuitBreaker, RetryPolicy, WatchBackoff
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
 class TransientAPIError(RuntimeError):
-    """429 / 5xx from the API server — retryable for idempotent reads."""
+    """429 / 5xx from the API server — retryable (reads and writes both,
+    within the per-call ``RetryPolicy`` budget)."""
 
 
 class TooManyRequestsError(TransientAPIError):
     """HTTP 429 specifically: on the eviction subresource this is the
-    PDB-veto signal, not a load-shedding hiccup."""
+    PDB-veto signal, not a load-shedding hiccup. Carries the response's
+    ``Retry-After`` (seconds) when the server sent one."""
+
+    retry_after: Optional[float] = None
+
+
+class CircuitOpenError(TransientAPIError):
+    """Fast-fail while the apiserver circuit breaker is open: the last
+    ``CircuitBreaker.threshold`` consecutive requests all failed at the
+    transport/5xx level, so new requests are refused locally until the
+    cooldown lapses instead of stacking timeouts on a dead server."""
 
 # kind -> (plural, namespaced)
 KIND_TABLE: Dict[str, tuple] = {
@@ -100,9 +112,15 @@ class RestClient(Client):
         token: Optional[str] = None,
         ca_file: Optional[str] = None,
         insecure: bool = False,
+        retry_policy: Optional[RetryPolicy] = None,
+        breaker: Optional[CircuitBreaker] = None,
     ):
         self.host = host or os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
         self.port = int(port or os.environ.get("KUBERNETES_SERVICE_PORT", "443"))
+        # fault-tolerance surface (kube/retry.py): per-verb retry policy
+        # + the global circuit breaker, one pair per client instance
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.breaker = breaker or CircuitBreaker()
         # None = re-read the projected SA token per request (bound tokens are
         # rotated on disk by the kubelet and expire ~hourly).
         self._static_token = token
@@ -137,8 +155,23 @@ class RestClient(Client):
             self.host, self.port, context=self._ctx, timeout=timeout
         )
 
-    GET_RETRIES = 3  # idempotent reads only; mutations are retried by the
-    GET_RETRY_BACKOFF_S = 0.5  # reconcile loop's rate-limited requeue
+    # back-compat knobs: existing callers/tests tune the read retry
+    # count/backoff through these names; they now alias the RetryPolicy
+    @property
+    def GET_RETRIES(self) -> int:  # noqa: N802 - historical name
+        return self.retry_policy.read_attempts
+
+    @GET_RETRIES.setter
+    def GET_RETRIES(self, n: int) -> None:  # noqa: N802
+        self.retry_policy.read_attempts = n
+
+    @property
+    def GET_RETRY_BACKOFF_S(self) -> float:  # noqa: N802
+        return self.retry_policy.backoff_s
+
+    @GET_RETRY_BACKOFF_S.setter
+    def GET_RETRY_BACKOFF_S(self, s: float) -> None:  # noqa: N802
+        self.retry_policy.backoff_s = s
 
     def _request(
         self,
@@ -146,23 +179,65 @@ class RestClient(Client):
         path: str,
         body: Optional[Obj] = None,
         content_type: str = "application/json",
+        retry_429: bool = True,
     ) -> Obj:
-        attempts = self.GET_RETRIES if method == "GET" else 1
+        """One API call under the fault-tolerance policy: per-verb
+        bounded retries with jittered exponential backoff for transient
+        failures (connection refused/reset, 429, 5xx) on reads AND
+        writes, honoring 429 ``Retry-After``, within a per-call
+        wall-clock budget; semantic statuses (404/409/other 4xx) fail
+        fast — retrying cannot help, and the answer proves the apiserver
+        is alive. The global circuit breaker fails calls fast while the
+        apiserver is known-dead. ``retry_429=False`` exempts a call
+        whose 429 is a semantic veto, not load shedding (the eviction
+        subresource's PDB refusal)."""
+        policy = self.retry_policy
+        breaker = self.breaker
+        attempts = policy.attempts_for(method)
+        deadline = time.monotonic() + policy.budget_s
         last_err: Optional[Exception] = None
+        retry_after: Optional[float] = None
         for attempt in range(attempts):
+            # breaker first: an open breaker must fail fast, not after
+            # sleeping a full backoff delay it was never going to use
+            if not breaker.allow():
+                raise CircuitOpenError(
+                    f"{method} {path}: apiserver circuit open "
+                    f"({breaker.stats()})"
+                )
             if attempt:
-                time.sleep(self.GET_RETRY_BACKOFF_S * (2 ** (attempt - 1)))
+                delay = policy.backoff(attempt, retry_after)
+                if time.monotonic() + delay > deadline:
+                    policy.count_giveup()
+                    break  # budget exhausted: surface the last error
+                policy.count_retry(
+                    method, honored_retry_after=retry_after is not None
+                )
+                time.sleep(delay)
             try:
-                return self._request_once(method, path, body, content_type)
+                result = self._request_once(method, path, body, content_type)
+                breaker.record_success()
+                return result
             except (NotFoundError, ConflictError):
+                breaker.record_success()  # the server answered
                 raise  # semantic statuses, not transient
-            except (OSError, TransientAPIError) as e:
-                # connection refused/reset, 429, 5xx: the API server (or a
-                # lagging webhook) hiccupped — worth a bounded retry for an
-                # idempotent read
+            except TooManyRequestsError as e:
+                # load shedding: the server is alive (never trips the
+                # breaker) and may have told us exactly when to return
+                breaker.record_success()
+                if not retry_429:
+                    raise
                 last_err = e
+                retry_after = e.retry_after
+            except (OSError, TransientAPIError) as e:
+                # connection refused/reset, 5xx: the API server (or a
+                # lagging webhook) hiccupped — worth a bounded retry
+                breaker.record_failure()
+                last_err = e
+                retry_after = None
             except RuntimeError:
-                raise  # other 4xx: retrying cannot help
+                breaker.record_success()  # other 4xx: the server answered
+                raise  # retrying cannot help
         raise last_err  # type: ignore[misc]
 
     def _request_once(
@@ -190,9 +265,15 @@ class RestClient(Client):
             if resp.status == 409:
                 raise ConflictError(path)
             if resp.status == 429:
-                raise TooManyRequestsError(
+                err = TooManyRequestsError(
                     f"{method} {path} -> {resp.status}: {data[:512]!r}"
                 )
+                ra = resp.getheader("Retry-After")
+                try:
+                    err.retry_after = float(ra) if ra is not None else None
+                except (TypeError, ValueError):
+                    err.retry_after = None
+                raise err
             if resp.status >= 500:
                 raise TransientAPIError(
                     f"{method} {path} -> {resp.status}: {data[:512]!r}"
@@ -283,7 +364,12 @@ class RestClient(Client):
             # a 429 here is a PodDisruptionBudget veto, not load shedding
             pod_path = _resource_path("v1", "Pod", ns, meta["name"])
             try:
-                return self._request("POST", pod_path + "/eviction", obj)
+                # retry_429=False: this 429 is a semantic veto (the PDB
+                # refused the disruption), not load shedding — retrying
+                # inside the client would just re-ask a firm "no"
+                return self._request(
+                    "POST", pod_path + "/eviction", obj, retry_429=False
+                )
             except TooManyRequestsError as e:
                 from tpu_operator.kube.client import EvictionBlockedError
 
@@ -357,12 +443,18 @@ class RestClient(Client):
                 log.exception("watch callback failed for %s %s", etype, kind)
 
         known = set()
+        # jittered exponential reconnect backoff (reset once a list
+        # succeeds): a fleet of informers on a fixed delay re-LISTs a
+        # recovering apiserver in lockstep — the thundering herd the
+        # jitter exists to break up
+        backoff = WatchBackoff()
         while not stop_event.is_set():
             try:
                 try:
                     listing = self._request(
                         "GET", _resource_path(api_version, kind, namespace)
                     )
+                    backoff.reset()
                 except NotFoundError:
                     # the kind is not served (optional CRD not installed,
                     # e.g. ServiceMonitor without prometheus-operator, or
@@ -436,7 +528,7 @@ class RestClient(Client):
                 if stop_event.is_set():
                     return
                 log.exception("watch %s/%s disconnected; re-listing", api_version, kind)
-                stop_event.wait(5)  # backoff, then re-list
+                stop_event.wait(backoff.next_delay())  # then re-list
 
     def _watch_stream(
         self,

@@ -9,6 +9,7 @@ and signal handling.
 from __future__ import annotations
 
 import logging
+import os
 import signal
 import socket
 import threading
@@ -117,6 +118,24 @@ def default_leader_identity() -> str:
     return f"{pod}_{uid}"
 
 
+def _parse_rfc3339(ts: str):
+    """Lease ``renewTime`` parser accepting RFC3339 with and without
+    fractional seconds, and numeric offsets as well as ``Z``.
+    controller-runtime and kubectl write ``...:05.999999Z`` (MicroTime)
+    but other clients legally write ``...:05Z`` or ``...:05+00:00`` — a
+    single-format strptime treated those leases as unparseable, hence
+    perpetually expired, and STOLE a live peer's lease (fail-open).
+    Returns an aware UTC datetime, or None when the timestamp is
+    genuinely unparseable."""
+    try:
+        then = datetime.fromisoformat(ts.replace("Z", "+00:00"))
+    except (TypeError, ValueError):
+        return None
+    if then.tzinfo is None:
+        then = then.replace(tzinfo=timezone.utc)
+    return then.astimezone(timezone.utc)
+
+
 class LeaderElector:
     """Lease-based leader election (reference ``main.go:97-107``)."""
 
@@ -160,16 +179,11 @@ class LeaderElector:
         holder = spec.get("holderIdentity")
         renew = spec.get("renewTime", "")
         expired = True
-        if renew:
-            try:
-                then = datetime.strptime(renew, "%Y-%m-%dT%H:%M:%S.%fZ").replace(
-                    tzinfo=timezone.utc
-                )
-                expired = (
-                    datetime.now(timezone.utc) - then
-                ).total_seconds() > spec.get("leaseDurationSeconds", 30)
-            except ValueError:
-                pass
+        then = _parse_rfc3339(renew) if renew else None
+        if then is not None:
+            expired = (
+                datetime.now(timezone.utc) - then
+            ).total_seconds() > spec.get("leaseDurationSeconds", 30)
         if holder == self.identity or expired or not holder:
             spec.update({"holderIdentity": self.identity, "renewTime": now})
             lease["spec"] = spec
@@ -212,6 +226,18 @@ class _HealthHandler(BaseHTTPRequestHandler):
                 "reconcilers": sorted(m._reconcilers) if m else [],
                 "last_reconcile_ok": m._last_reconcile_ok if m else None,
             }
+            if m:
+                # stall-watchdog disposition: what is in flight, for how
+                # long, and whether it breached the pass deadline
+                payload["watchdog"] = m.watchdog_stats()
+            fault = getattr(m.client if m else None, "fault_stats", None)
+            if callable(fault):
+                # retry/breaker counters (kube/retry.py): the apiserver
+                # fault-tolerance layer's disposition
+                try:
+                    payload["fault_tolerance"] = fault()
+                except Exception as e:  # noqa: BLE001
+                    payload["fault_tolerance"] = {"error": str(e)}
             if hasattr(m.client, "cache_info"):
                 # per-kind informer store sizes; null = informer never
                 # synced (reads fall through live) — the staleness tell
@@ -280,6 +306,7 @@ class Manager:
         probe_port: int = 8081,
         leader_election: bool = False,
         debug_endpoints: bool = False,
+        pass_deadline_s: Optional[float] = None,
     ):
         self.client = client
         self.namespace = namespace
@@ -293,6 +320,18 @@ class Manager:
         self._stop = threading.Event()
         self._last_reconcile_ok = True
         self._threads = []
+        # stall watchdog: MaxConcurrentReconciles=1 means a single hung
+        # state check used to wedge ALL reconciling while probes stayed
+        # green forever; healthy() now flips once the in-flight pass
+        # outlives this deadline, so the kubelet restarts the pod
+        self.pass_deadline_s = (
+            pass_deadline_s
+            if pass_deadline_s is not None
+            else float(os.environ.get("RECONCILE_STALL_DEADLINE_S", "300"))
+        )
+        self._inflight_item: Optional[str] = None
+        self._inflight_since: Optional[float] = None
+        self._last_progress = time.monotonic()
         # extra /debug/vars payload fragments: name -> zero-arg callable
         # returning a JSON-serializable value (e.g. the reconciler's
         # per-pass snapshot hit rates)
@@ -310,7 +349,34 @@ class Manager:
         self.queue.add(key, delay)
 
     def healthy(self) -> bool:
-        return not self._stop.is_set()
+        return not self._stop.is_set() and not self.stalled()
+
+    def stalled(self) -> bool:
+        """True when the single worker's in-flight reconcile has
+        outlived the pass deadline — a wedged pass (hung socket, deadlock
+        in a state check) that would otherwise keep probes green while
+        nothing reconciles."""
+        since = self._inflight_since
+        return (
+            since is not None
+            and time.monotonic() - since > self.pass_deadline_s
+        )
+
+    def watchdog_stats(self) -> dict:
+        """Stall-watchdog disposition for /debug/vars."""
+        now = time.monotonic()
+        since = self._inflight_since
+        return {
+            "pass_deadline_s": self.pass_deadline_s,
+            "inflight": self._inflight_item,
+            "inflight_for_s": (
+                round(now - since, 3) if since is not None else None
+            ),
+            "stalled": bool(
+                since is not None and now - since > self.pass_deadline_s
+            ),
+            "last_progress_age_s": round(now - self._last_progress, 3),
+        }
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -410,6 +476,10 @@ class Manager:
                 fn = self._reconcilers.get(item)
                 if fn is None:
                     continue
+                # watchdog bracket: the probe thread reads these to tell
+                # a wedged pass from a busy one
+                self._inflight_item = item
+                self._inflight_since = time.monotonic()
                 try:
                     result = fn(item)
                     self.rate_limiter.forget(item)
@@ -421,6 +491,10 @@ class Manager:
                     log.exception("reconcile %s failed", item)
                     self._last_reconcile_ok = False
                     self.queue.add(item, self.rate_limiter.when(item))
+                finally:
+                    self._inflight_since = None
+                    self._inflight_item = None
+                    self._last_progress = time.monotonic()
             except Exception:
                 # a bug in the queue/limiter machinery must never silently
                 # kill the ONLY worker while probes keep reporting healthy

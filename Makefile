@@ -88,9 +88,11 @@ bench-gate:
 
 # CI fault gate: the deterministic fault matrix (injected 429/500/503/
 # latency on every write verb, a full partition window, a raising state)
-# must converge — fast enough for every PR, unlike the randomized soak
+# plus the node-remediation chaos matrix (chip death -> quarantine ->
+# recovery, flapping -> exhausted, systemic breaker) must converge —
+# fast enough for every PR, unlike the randomized soak
 chaos-fast:
-	python -m pytest tests/test_fault_matrix.py -q -p no:cacheprovider
+	python -m pytest tests/test_fault_matrix.py tests/test_remediation_matrix.py -q -p no:cacheprovider
 
 # run the operator against the in-memory cluster and converge to Ready
 dev-run:

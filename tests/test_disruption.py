@@ -1,0 +1,132 @@
+"""PDB selector/arithmetic coverage for ``kube/disruption.py`` — the
+matchExpressions operators (``In``/``NotIn``/``Exists``/``DoesNotExist``/
+unknown) were previously untested, and they decide whether an eviction
+(upgrade drain, remediation drain, maintenance sweep) gets vetoed."""
+
+from tpu_operator.kube.disruption import (
+    _selector_matches,
+    eviction_blocked_by,
+)
+
+
+def pod(name, labels=None, healthy=True, namespace="default"):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": labels or {},
+        },
+        "status": {"phase": "Running" if healthy else "Pending"},
+    }
+
+
+def pdb(name, selector, min_available=None, max_unavailable=None):
+    spec = {"selector": selector}
+    if min_available is not None:
+        spec["minAvailable"] = min_available
+    if max_unavailable is not None:
+        spec["maxUnavailable"] = max_unavailable
+    return {
+        "apiVersion": "policy/v1",
+        "kind": "PodDisruptionBudget",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+# ---------------------------------------------------------------------------
+# matchExpressions operators
+# ---------------------------------------------------------------------------
+
+
+def test_match_expressions_in():
+    sel = {
+        "matchExpressions": [
+            {"key": "tier", "operator": "In", "values": ["train", "batch"]}
+        ]
+    }
+    assert _selector_matches(sel, pod("a", {"tier": "train"}))
+    assert _selector_matches(sel, pod("b", {"tier": "batch"}))
+    assert not _selector_matches(sel, pod("c", {"tier": "serve"}))
+    assert not _selector_matches(sel, pod("d", {}))  # key absent
+
+
+def test_match_expressions_notin():
+    sel = {
+        "matchExpressions": [
+            {"key": "tier", "operator": "NotIn", "values": ["serve"]}
+        ]
+    }
+    assert _selector_matches(sel, pod("a", {"tier": "train"}))
+    # k8s NotIn semantics: a pod WITHOUT the key matches
+    assert _selector_matches(sel, pod("b", {}))
+    assert not _selector_matches(sel, pod("c", {"tier": "serve"}))
+
+
+def test_match_expressions_exists():
+    sel = {"matchExpressions": [{"key": "tier", "operator": "Exists"}]}
+    assert _selector_matches(sel, pod("a", {"tier": "anything"}))
+    assert _selector_matches(sel, pod("b", {"tier": ""}))
+    assert not _selector_matches(sel, pod("c", {"other": "x"}))
+
+
+def test_match_expressions_does_not_exist():
+    sel = {"matchExpressions": [{"key": "tier", "operator": "DoesNotExist"}]}
+    assert _selector_matches(sel, pod("a", {"other": "x"}))
+    assert not _selector_matches(sel, pod("b", {"tier": "train"}))
+
+
+def test_match_expressions_unknown_operator_fails_closed():
+    sel = {"matchExpressions": [{"key": "tier", "operator": "Bogus"}]}
+    assert not _selector_matches(sel, pod("a", {"tier": "train"}))
+
+
+def test_match_labels_and_expressions_combine():
+    sel = {
+        "matchLabels": {"app": "train"},
+        "matchExpressions": [{"key": "gen", "operator": "Exists"}],
+    }
+    assert _selector_matches(sel, pod("a", {"app": "train", "gen": "v5e"}))
+    assert not _selector_matches(sel, pod("b", {"app": "train"}))
+    assert not _selector_matches(sel, pod("c", {"gen": "v5e"}))
+
+
+# ---------------------------------------------------------------------------
+# veto arithmetic through expression-selected budgets
+# ---------------------------------------------------------------------------
+
+
+def test_eviction_vetoed_via_exists_selector():
+    """A budget selecting by Exists vetoes exactly its own pods."""
+    budget = pdb(
+        "gang",
+        {"matchExpressions": [{"key": "gang", "operator": "Exists"}]},
+        min_available=2,
+    )
+    gang = [
+        pod("g1", {"gang": "a"}),
+        pod("g2", {"gang": "a"}),
+    ]
+    loner = pod("solo", {"other": "x"})
+    # evicting a gang member would leave 1 < 2 healthy: vetoed
+    blocked = eviction_blocked_by(gang[0], gang + [loner], [budget])
+    assert blocked is not None and blocked[0] == "gang"
+    # the unselected pod evicts freely
+    assert eviction_blocked_by(loner, gang + [loner], [budget]) is None
+
+
+def test_eviction_allowed_via_does_not_exist_selector():
+    """DoesNotExist-scoped budget: pods carrying the key are outside it."""
+    budget = pdb(
+        "non-gang",
+        {"matchExpressions": [{"key": "gang", "operator": "DoesNotExist"}]},
+        max_unavailable=0,
+    )
+    gang_pod = pod("g1", {"gang": "a"})
+    plain = pod("p1", {})
+    assert eviction_blocked_by(gang_pod, [gang_pod, plain], [budget]) is None
+    assert (
+        eviction_blocked_by(plain, [gang_pod, plain], [budget]) is not None
+    )

@@ -1,0 +1,778 @@
+"""Node-health remediation FSM units (``controllers/remediation.py``):
+health derivation, the escalation ladder, the attempt cap, the shared
+disruption budget, the systemic-failure breaker, the maintenance/upgrade
+interlocks, PDB-veto deferral, and disable-time cleanup — all on the
+FakeClient with ``backoffSeconds: 0`` so every pass is deterministic."""
+
+import os
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+import pytest
+
+from tests.conftest import make_tpu_node
+from tpu_operator import consts
+from tpu_operator.api.v1.clusterpolicy_types import RemediationSpec
+from tpu_operator.controllers.remediation import NodeRemediationController
+from tpu_operator.controllers.state_manager import has_tpu_labels
+from tpu_operator.kube import FakeClient
+from tpu_operator.kube.client import has_taint
+from tpu_operator.kube.testing import make_validator_pod
+
+NS = "tpu-operator"
+
+
+def tpu_node(name, chips="8"):
+    node = make_tpu_node(name)
+    node["status"]["capacity"]["google.com/tpu"] = "8"
+    node["status"]["allocatable"]["google.com/tpu"] = chips
+    node["metadata"]["labels"][
+        consts.DEPLOY_LABEL_PREFIX + consts.COMPONENT_OPERATOR_VALIDATOR
+    ] = "true"
+    return node
+
+
+def operand_pod(name, node, app="tpu-device-plugin"):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": NS, "labels": {"app": app}},
+        "spec": {"nodeName": node},
+        "status": {
+            "phase": "Running",
+            "containerStatuses": [{"ready": True}],
+        },
+    }
+
+
+def workload_pod(name, node, namespace="default", labels=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": labels or {"job": "train"},
+            "ownerReferences": [
+                {"kind": "Job", "name": "train", "uid": "j1"}
+            ],
+        },
+        "spec": {
+            "nodeName": node,
+            "containers": [
+                {
+                    "name": "train",
+                    "resources": {"limits": {"google.com/tpu": "4"}},
+                }
+            ],
+        },
+        "status": {"phase": "Running"},
+    }
+
+
+def spec(**kw):
+    defaults = dict(
+        enabled=True,
+        max_attempts=2,
+        backoff_seconds=0,
+        max_unavailable="50%",
+        systemic_threshold="50%",
+    )
+    defaults.update(kw)
+    return RemediationSpec(**defaults)
+
+
+def run_pass(client, ctrl, sp):
+    nodes = [n for n in client.list("v1", "Node") if has_tpu_labels(n)]
+    return ctrl.reconcile(nodes, sp, NS)
+
+
+def node_state(client, name):
+    return (
+        client.get("v1", "Node", name)["metadata"].get("labels") or {}
+    ).get(consts.REMEDIATION_STATE_LABEL)
+
+
+def unsched(client, name):
+    return (
+        client.get("v1", "Node", name).get("spec") or {}
+    ).get("unschedulable", False)
+
+
+def heal(client, name, chips="8"):
+    """Chips return AND the validator DS re-places its pod (the role the
+    kubelet sim plays in the wire tests)."""
+    n = client.get("v1", "Node", name)
+    n["status"]["allocatable"]["google.com/tpu"] = chips
+    client.update(n)
+    if client.get_or_none("v1", "Pod", f"val-{name}", NS) is None:
+        client.create(make_validator_pod(name, True, NS))
+
+
+def seeded(n_nodes=4, validators=True):
+    client = FakeClient(
+        [{"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}]
+    )
+    for i in range(1, n_nodes + 1):
+        client.create(tpu_node(f"node-{i}"))
+        client.create(operand_pod(f"plugin-node-{i}", f"node-{i}"))
+        if validators:
+            client.create(make_validator_pod(f"node-{i}", True, NS))
+    return client
+
+
+# ---------------------------------------------------------------------------
+# health derivation
+# ---------------------------------------------------------------------------
+
+
+def test_health_signals():
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    # all healthy: nothing happens
+    summary = run_pass(client, ctrl, spec())
+    assert summary.unhealthy == 0 and summary.active is False
+    assert all(node_state(client, f"node-{i}") is None for i in (1, 2, 3, 4))
+
+    # signal 1: zero-allocatable chips
+    n = client.get("v1", "Node", "node-1")
+    n["status"]["allocatable"]["google.com/tpu"] = "0"
+    client.update(n)
+    # signal 2: operand pod in CrashLoopBackOff
+    p = client.get("v1", "Pod", "plugin-node-2", NS)
+    p["status"]["containerStatuses"] = [
+        {"ready": False, "state": {"waiting": {"reason": "CrashLoopBackOff"}}}
+    ]
+    client.update(p)
+    # signal 3: validator pod gone from a labeled node
+    client.delete("v1", "Pod", "val-node-3", NS)
+
+    summary = run_pass(client, ctrl, spec(systemic_threshold="90%"))
+    assert sorted(summary.unhealthy_hosts) == ["node-1", "node-2", "node-3"]
+    assert summary.active is True
+
+
+# ---------------------------------------------------------------------------
+# the escalation ladder
+# ---------------------------------------------------------------------------
+
+
+def test_fsm_escalates_quarantines_and_recovers():
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    sp = spec(systemic_threshold="90%")
+    client.create(workload_pod("train-1", "node-1"))
+
+    n = client.get("v1", "Node", "node-1")
+    n["status"]["allocatable"]["google.com/tpu"] = "0"
+    client.update(n)
+
+    run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_OBSERVED
+
+    # observed -> restart-operands -> revalidate (one escalation pass);
+    # the node's operand pods were restarted (deleted)
+    run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_REVALIDATE
+    assert client.get_or_none("v1", "Pod", "plugin-node-1", NS) is None
+    assert ctrl.attempts_total == 1
+    # the workload pod is NOT touched by an operand restart
+    assert client.get_or_none("v1", "Pod", "train-1", "default") is not None
+
+    # still dead -> cordon-drain: cordon + taint + repair label, workload
+    # evicted, and (node clear) -> quarantined in the same pass
+    summary = run_pass(client, ctrl, sp)
+    node = client.get("v1", "Node", "node-1")
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_QUARANTINED
+    assert node["spec"]["unschedulable"] is True
+    assert has_taint(node, consts.REPAIR_TAINT_KEY, consts.REPAIR_PENDING)
+    assert node["metadata"]["labels"][consts.REPAIR_LABEL] == consts.REPAIR_PENDING
+    assert client.get_or_none("v1", "Pod", "train-1", "default") is None
+    assert summary.quarantined == 1
+
+    # the quarantine Event names the node and its slice
+    events = [
+        e
+        for e in client.list("v1", "Event", NS)
+        if e.get("reason") == "NodeQuarantined"
+    ]
+    assert events and "node-1" in events[0]["message"]
+
+    # holding pattern while unhealthy
+    run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_QUARANTINED
+
+    # chips reappear (and the validator DS re-places its pod) ->
+    # recovered: uncordon, untaint, labels lifted
+    heal(client, "node-1")
+    run_pass(client, ctrl, sp)
+    node = client.get("v1", "Node", "node-1")
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_RECOVERED
+    assert node["spec"].get("unschedulable", False) is False
+    assert not has_taint(node, consts.REPAIR_TAINT_KEY)
+    assert consts.REPAIR_LABEL not in node["metadata"]["labels"]
+
+    # one more stable pass leaves the FSM entirely
+    run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") is None
+
+
+def test_precordoned_node_stays_cordoned_after_recovery():
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    sp = spec(systemic_threshold="90%")
+    n = client.get("v1", "Node", "node-1")
+    n.setdefault("spec", {})["unschedulable"] = True  # human cordon
+    n["status"]["allocatable"]["google.com/tpu"] = "0"
+    client.update(n)
+    for _ in range(4):
+        run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_QUARANTINED
+    heal(client, "node-1")
+    run_pass(client, ctrl, sp)
+    node = client.get("v1", "Node", "node-1")
+    # taint lifted, but the HUMAN's cordon is restored, not reset
+    assert not has_taint(node, consts.REPAIR_TAINT_KEY)
+    assert node["spec"]["unschedulable"] is True
+
+
+def test_flapping_node_lands_exhausted_at_attempt_cap():
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    sp = spec(systemic_threshold="90%")  # max_attempts=2
+
+    def kill():
+        n = client.get("v1", "Node", "node-1")
+        n["status"]["allocatable"]["google.com/tpu"] = "0"
+        client.update(n)
+
+    def restore():
+        heal(client, "node-1")
+
+    kill()
+    for _ in range(4):
+        run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_QUARANTINED
+    assert ctrl.attempts_total == 2  # restart + drain: the cap is spent
+
+    restore()
+    run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_RECOVERED
+
+    # the flap: unhealthy again with the attempt budget already spent
+    kill()
+    summary = run_pass(client, ctrl, sp)
+    node = client.get("v1", "Node", "node-1")
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_EXHAUSTED
+    assert node["spec"]["unschedulable"] is True
+    assert has_taint(node, consts.REPAIR_TAINT_KEY)
+    assert summary.exhausted == 1
+    assert any(
+        e.get("reason") == "NodeRemediationExhausted"
+        for e in client.list("v1", "Event", NS)
+    )
+
+    # exhausted is sticky: even a healthy-looking flap stays quarantined
+    restore()
+    run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_EXHAUSTED
+    assert client.get("v1", "Node", "node-1")["spec"]["unschedulable"] is True
+
+    # ...until a human clears the state label (the documented escape)
+    n = client.get("v1", "Node", "node-1")
+    del n["metadata"]["labels"][consts.REMEDIATION_STATE_LABEL]
+    n["metadata"]["annotations"].pop(
+        consts.REMEDIATION_ATTEMPTS_ANNOTATION, None
+    )
+    client.update(n)
+    run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") is None
+
+
+# ---------------------------------------------------------------------------
+# the shared disruption budget
+# ---------------------------------------------------------------------------
+
+
+def test_budget_defers_drain_while_upgrade_holds_the_pool():
+    """Upgrades + repairs draw on ONE maxUnavailable pool: with the cap
+    at 1 slice and an in-flight upgrade, the remediator must NOT issue a
+    second disruption — and must proceed once the upgrade completes.
+    The combined in-flight disruption count never exceeds the cap."""
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    sp = spec(max_unavailable="25%", systemic_threshold="90%")  # cap = 1 of 4
+
+    # node-2 is mid-upgrade (drain-required is an ACTIVE FSM state)
+    n = client.get("v1", "Node", "node-2")
+    n["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] = "drain-required"
+    client.update(n)
+
+    n = client.get("v1", "Node", "node-1")
+    n["status"]["allocatable"]["google.com/tpu"] = "0"
+    client.update(n)
+
+    deferred = 0
+    for _ in range(5):
+        summary = run_pass(client, ctrl, sp)
+        deferred += summary.budget_deferred
+        # invariant: combined in-flight disruptions never exceed the cap
+        assert summary.disrupted_slices <= summary.budget_cap == 1
+    assert deferred > 0
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_REVALIDATE
+    assert not unsched(client, "node-1")
+
+    # upgrade completes -> the pool frees -> the drain proceeds
+    n = client.get("v1", "Node", "node-2")
+    n["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] = "upgrade-done"
+    client.update(n)
+    run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_QUARANTINED
+
+
+def test_second_unhealthy_node_waits_for_the_first():
+    """Two sick single-host slices, cap 1: only one is disrupted at a
+    time; the second follows after the first recovers."""
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    sp = spec(max_unavailable="25%", systemic_threshold="90%")
+    for name in ("node-1", "node-2"):
+        n = client.get("v1", "Node", name)
+        n["status"]["allocatable"]["google.com/tpu"] = "0"
+        client.update(n)
+    for _ in range(5):
+        summary = run_pass(client, ctrl, sp)
+        assert summary.disrupted_slices <= 1
+    states = {node_state(client, n) for n in ("node-1", "node-2")}
+    assert consts.REMEDIATION_STATE_QUARANTINED in states
+    assert consts.REMEDIATION_STATE_REVALIDATE in states  # deferred
+
+    # first host recovers -> budget frees -> the second drains
+    first = next(
+        n
+        for n in ("node-1", "node-2")
+        if node_state(client, n) == consts.REMEDIATION_STATE_QUARANTINED
+    )
+    heal(client, first)
+    for _ in range(3):
+        run_pass(client, ctrl, sp)
+    second = "node-2" if first == "node-1" else "node-1"
+    assert node_state(client, second) == consts.REMEDIATION_STATE_QUARANTINED
+
+
+# ---------------------------------------------------------------------------
+# systemic-failure breaker
+# ---------------------------------------------------------------------------
+
+
+def test_systemic_breaker_halts_remediation():
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    sp = spec(systemic_threshold="50%")
+    client.create(workload_pod("train-1", "node-1"))
+    for name in ("node-1", "node-2"):
+        n = client.get("v1", "Node", name)
+        n["status"]["allocatable"]["google.com/tpu"] = "0"
+        client.update(n)
+
+    summary = run_pass(client, ctrl, sp)
+    assert summary.breaker_open is True
+    assert summary.unhealthy == 2 and summary.breaker_threshold == 2
+    # ZERO node writes and ZERO evictions while the breaker is open
+    for i in (1, 2, 3, 4):
+        node = client.get("v1", "Node", f"node-{i}")
+        assert consts.REMEDIATION_STATE_LABEL not in node["metadata"]["labels"]
+        assert not (node.get("spec") or {}).get("unschedulable", False)
+        assert not has_taint(node, consts.REPAIR_TAINT_KEY)
+    assert client.get_or_none("v1", "Pod", "train-1", "default") is not None
+    assert any(
+        e.get("reason") == "SystemicNodeFailure"
+        for e in client.list("v1", "Event", NS)
+    )
+    assert ctrl.breaker_opens_total == 1
+
+    # half the failure clears -> below threshold -> remediation resumes
+    n = client.get("v1", "Node", "node-2")
+    n["status"]["allocatable"]["google.com/tpu"] = "8"
+    client.update(n)
+    summary = run_pass(client, ctrl, sp)
+    assert summary.breaker_open is False
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_OBSERVED
+
+
+def test_breaker_never_opens_on_a_single_node():
+    """Tiny fleet: one dead host is exactly what remediation is FOR —
+    the percentage arithmetic must not halt it."""
+    client = seeded(n_nodes=1)
+    ctrl = NodeRemediationController(client)
+    n = client.get("v1", "Node", "node-1")
+    n["status"]["allocatable"]["google.com/tpu"] = "0"
+    client.update(n)
+    summary = run_pass(client, ctrl, spec(systemic_threshold="50%"))
+    assert summary.breaker_open is False
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_OBSERVED
+
+
+# ---------------------------------------------------------------------------
+# interlocks (remediator vs maintenance window vs upgrade FSM)
+# ---------------------------------------------------------------------------
+
+
+def test_interlock_maintenance_and_upgrade(caplog):
+    import logging
+
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    sp = spec(systemic_threshold="90%")
+
+    n = client.get("v1", "Node", "node-1")
+    n["metadata"]["labels"][consts.MAINTENANCE_STATE_LABEL] = "pending"
+    n["status"]["allocatable"]["google.com/tpu"] = "0"
+    client.update(n)
+    n = client.get("v1", "Node", "node-2")
+    n["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] = "cordon-required"
+    n["status"]["allocatable"]["google.com/tpu"] = "0"
+    client.update(n)
+
+    with caplog.at_level(logging.INFO, "tpu-operator.remediation"):
+        for _ in range(3):
+            summary = run_pass(client, ctrl, sp)
+    # both unhealthy nodes are OWNED by another actor: untouched
+    assert summary.skipped == 2
+    for name in ("node-1", "node-2"):
+        node = client.get("v1", "Node", name)
+        assert consts.REMEDIATION_STATE_LABEL not in node["metadata"]["labels"]
+        assert not has_taint(node, consts.REPAIR_TAINT_KEY)
+    # ...with a single log-once note per node, not one per pass
+    notes = [
+        r for r in caplog.records if "deferring to" in r.getMessage()
+    ]
+    assert len(notes) == 2
+
+    # the maintenance window clears -> remediation may now act
+    n = client.get("v1", "Node", "node-1")
+    del n["metadata"]["labels"][consts.MAINTENANCE_STATE_LABEL]
+    client.update(n)
+    run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_OBSERVED
+
+
+def test_skip_label_is_an_escape_hatch():
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    n = client.get("v1", "Node", "node-1")
+    n["metadata"]["labels"][consts.REMEDIATION_SKIP_LABEL] = "true"
+    n["status"]["allocatable"]["google.com/tpu"] = "0"
+    client.update(n)
+    for _ in range(3):
+        run_pass(client, ctrl, spec(systemic_threshold="90%"))
+    node = client.get("v1", "Node", "node-1")
+    assert consts.REMEDIATION_STATE_LABEL not in node["metadata"]["labels"]
+    assert not unsched(client, "node-1")
+
+
+# ---------------------------------------------------------------------------
+# PDB-vetoed drain defers (never fails) the FSM step
+# ---------------------------------------------------------------------------
+
+
+def test_pdb_veto_defers_cordon_drain():
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    sp = spec(systemic_threshold="90%")
+    client.create(workload_pod("train-1", "node-1", labels={"job": "train"}))
+    client.create(
+        {
+            "apiVersion": "policy/v1",
+            "kind": "PodDisruptionBudget",
+            "metadata": {"name": "train-pdb", "namespace": "default"},
+            "spec": {
+                "minAvailable": 1,
+                "selector": {"matchLabels": {"job": "train"}},
+            },
+        }
+    )
+    n = client.get("v1", "Node", "node-1")
+    n["status"]["allocatable"]["google.com/tpu"] = "0"
+    client.update(n)
+
+    for _ in range(5):
+        run_pass(client, ctrl, sp)
+    # the veto DEFERS: cordon + taint applied, but the FSM holds in
+    # cordon-drain with the workload alive — never failed/exhausted
+    node = client.get("v1", "Node", "node-1")
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_CORDON_DRAIN
+    assert node["spec"]["unschedulable"] is True
+    assert client.get_or_none("v1", "Pod", "train-1", "default") is not None
+    assert ctrl.drains_vetoed_total > 0
+
+    # budget lifted -> the eviction lands -> quarantined
+    client.delete("policy/v1", "PodDisruptionBudget", "train-pdb", "default")
+    run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_QUARANTINED
+    assert client.get_or_none("v1", "Pod", "train-1", "default") is None
+
+
+# ---------------------------------------------------------------------------
+# disable-time cleanup
+# ---------------------------------------------------------------------------
+
+
+def test_disable_strips_state_and_lifts_quarantine():
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    sp = spec(systemic_threshold="90%")
+    n = client.get("v1", "Node", "node-1")
+    n["status"]["allocatable"]["google.com/tpu"] = "0"
+    client.update(n)
+    for _ in range(4):
+        run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_QUARANTINED
+
+    summary = run_pass(client, ctrl, RemediationSpec(enabled=False))
+    assert summary is not None and summary.active is False
+    node = client.get("v1", "Node", "node-1")
+    labels = node["metadata"]["labels"]
+    ann = node["metadata"].get("annotations") or {}
+    assert consts.REMEDIATION_STATE_LABEL not in labels
+    assert consts.REPAIR_LABEL not in labels
+    assert consts.REMEDIATION_ATTEMPTS_ANNOTATION not in ann
+    assert not has_taint(node, consts.REPAIR_TAINT_KEY)
+    assert not node["spec"].get("unschedulable", False)
+
+
+# ---------------------------------------------------------------------------
+# reconciler integration: status block + Degraded/SystemicNodeFailure
+# ---------------------------------------------------------------------------
+
+
+def test_reconciler_reports_systemic_condition(monkeypatch):
+    import yaml
+
+    monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        ClusterPolicyReconciler,
+    )
+    from tpu_operator.kube.testing import sample_clusterpolicy_path
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    client = FakeClient(
+        [{"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}]
+    )
+    for i in (1, 2):
+        client.create(tpu_node(f"node-{i}", chips="0"))
+    with open(sample_clusterpolicy_path()) as f:
+        cp = yaml.safe_load(f)
+    cp["spec"]["remediation"] = {
+        "enabled": True,
+        "backoffSeconds": 0,
+        "systemicThreshold": "50%",
+    }
+    client.create(cp)
+    r = ClusterPolicyReconciler(
+        client, assets_dir=os.path.join(REPO, "assets")
+    )
+    res = r.reconcile()
+    assert res.requeue_after is not None
+    cr = client.get("tpu.k8s.io/v1", "ClusterPolicy", "cluster-policy")
+    remediation = cr["status"].get("remediation") or {}
+    assert remediation.get("unhealthy") == 2
+    assert remediation.get("breakerOpen") is True
+    degraded = {c["type"]: c for c in cr["status"]["conditions"]}["Degraded"]
+    assert degraded["status"] == "True"
+    assert degraded["reason"] == "SystemicNodeFailure"
+
+    # fleet recovers -> condition lifts and the block clears
+    for i in (1, 2):
+        n = client.get("v1", "Node", f"node-{i}")
+        n["status"]["allocatable"]["google.com/tpu"] = "8"
+        client.update(n)
+        client.create(make_validator_pod(f"node-{i}", True, NS))
+    r.reconcile()
+    r.reconcile()
+    cr = client.get("tpu.k8s.io/v1", "ClusterPolicy", "cluster-policy")
+    assert "remediation" not in cr["status"]
+    degraded = {c["type"]: c for c in cr["status"]["conditions"]}["Degraded"]
+    assert degraded["reason"] != "SystemicNodeFailure"
+
+
+def test_breaker_ignores_interlocked_unhealthy_nodes():
+    """A wide upgrade roll legitimately takes validators/chips down on
+    the nodes it owns; those interlocked nodes must NOT count toward the
+    systemic threshold — else every fleet-wide upgrade opens the breaker
+    and freezes remediation of genuinely failing hosts."""
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    sp = spec(systemic_threshold="50%")  # threshold = 2 of 4
+    # two nodes mid-upgrade AND looking unhealthy (operands restarting)
+    for name in ("node-1", "node-2"):
+        n = client.get("v1", "Node", name)
+        n["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] = "drain-required"
+        n["status"]["allocatable"]["google.com/tpu"] = "0"
+        client.update(n)
+    # one genuinely failing node (below threshold on its own)
+    n = client.get("v1", "Node", "node-3")
+    n["status"]["allocatable"]["google.com/tpu"] = "0"
+    client.update(n)
+
+    summary = run_pass(client, ctrl, sp)
+    assert summary.unhealthy == 3  # truthful report...
+    assert summary.breaker_open is False  # ...but only 1 is actionable
+    assert node_state(client, "node-3") == consts.REMEDIATION_STATE_OBSERVED
+    # the upgrade-owned nodes stay untouched (interlock)
+    for name in ("node-1", "node-2"):
+        labels = client.get("v1", "Node", name)["metadata"]["labels"]
+        assert consts.REMEDIATION_STATE_LABEL not in labels
+
+
+def test_systemic_threshold_rounds_up():
+    """'At least this fraction' semantics: 5 nodes at 50% needs 3
+    unhealthy, not floor(2.5)=2 — an ordinary double failure on an
+    odd-sized fleet must not halt remediation."""
+    client = seeded(n_nodes=5)
+    ctrl = NodeRemediationController(client)
+    sp = spec(systemic_threshold="50%", max_unavailable="100%")
+    for name in ("node-1", "node-2"):
+        n = client.get("v1", "Node", name)
+        n["status"]["allocatable"]["google.com/tpu"] = "0"
+        client.update(n)
+    summary = run_pass(client, ctrl, sp)
+    assert summary.breaker_threshold == 3
+    assert summary.breaker_open is False
+    # the third failure crosses the line
+    n = client.get("v1", "Node", "node-3")
+    n["status"]["allocatable"]["google.com/tpu"] = "0"
+    client.update(n)
+    summary = run_pass(client, ctrl, sp)
+    assert summary.breaker_open is True
+
+
+def test_restart_operands_leaves_non_operand_pods_alone():
+    """Only tpu-* operand pods are restarted: a user pod that merely
+    lives in the operator namespace (with some 'app' label) survives
+    the restart rung."""
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    client.create(operand_pod("user-agent-node-1", "node-1", app="my-agent"))
+    n = client.get("v1", "Node", "node-1")
+    n["status"]["allocatable"]["google.com/tpu"] = "0"
+    client.update(n)
+    sp = spec(systemic_threshold="90%")
+    run_pass(client, ctrl, sp)  # observed
+    run_pass(client, ctrl, sp)  # restart-operands
+    assert client.get_or_none("v1", "Pod", "plugin-node-1", NS) is None
+    assert (
+        client.get_or_none("v1", "Pod", "user-agent-node-1", NS) is not None
+    )
+
+
+def test_non_operand_crashloop_is_not_a_health_signal():
+    """A user pod crashlooping in the operator namespace must not mark
+    the node unhealthy: the restart rung only touches tpu-* operands, so
+    the signal could never clear and a healthy host would escalate all
+    the way to quarantine."""
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    client.create(operand_pod("user-agent-node-1", "node-1", app="my-agent"))
+    p = client.get("v1", "Pod", "user-agent-node-1", NS)
+    p["status"]["containerStatuses"] = [
+        {"ready": False, "state": {"waiting": {"reason": "CrashLoopBackOff"}}}
+    ]
+    client.update(p)
+    summary = run_pass(client, ctrl, spec(systemic_threshold="90%"))
+    assert summary.unhealthy == 0
+    assert node_state(client, "node-1") is None
+
+
+def test_breaker_ignores_already_quarantined_hosts():
+    """Independent failures accumulating over time, each already
+    contained by a quarantine, must not add up to a false 'systemic'
+    verdict — the breaker detects a fleet TURNING unhealthy at once."""
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    sp = spec(systemic_threshold="50%", max_unavailable="100%")  # thr = 2
+    # host A died a while ago and is already quarantined
+    n = client.get("v1", "Node", "node-1")
+    n["status"]["allocatable"]["google.com/tpu"] = "0"
+    client.update(n)
+    for _ in range(4):
+        run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_QUARANTINED
+    # host B dies later: one NEW failure, not a systemic event
+    n = client.get("v1", "Node", "node-2")
+    n["status"]["allocatable"]["google.com/tpu"] = "0"
+    client.update(n)
+    summary = run_pass(client, ctrl, sp)
+    assert summary.unhealthy == 2  # truthful count...
+    assert summary.breaker_open is False  # ...but only 1 is NEW
+    for _ in range(4):
+        run_pass(client, ctrl, sp)
+    assert node_state(client, "node-2") == consts.REMEDIATION_STATE_QUARANTINED
+
+
+def test_unmanaged_pod_holds_drain_with_a_note(caplog):
+    """An ownerless TPU pod is never force-deleted: the drain holds in
+    cordon-drain (like the PDB veto) — but LOUDLY, with one log-once
+    note naming the way out."""
+    import logging
+
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    sp = spec(systemic_threshold="90%")
+    client.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "naked", "namespace": "default"},
+            "spec": {
+                "nodeName": "node-1",
+                "containers": [
+                    {"resources": {"limits": {"google.com/tpu": "4"}}}
+                ],
+            },
+            "status": {"phase": "Running"},
+        }
+    )
+    n = client.get("v1", "Node", "node-1")
+    n["status"]["allocatable"]["google.com/tpu"] = "0"
+    client.update(n)
+    with caplog.at_level(logging.INFO, "tpu-operator.remediation"):
+        for _ in range(5):
+            run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_CORDON_DRAIN
+    assert client.get_or_none("v1", "Pod", "naked", "default") is not None
+    notes = [
+        r
+        for r in caplog.records
+        if r.name == "tpu-operator.remediation"
+        and "unmanaged" in r.getMessage()
+    ]
+    assert len(notes) == 1  # log-once, not once per pass
+
+
+def test_exhausted_entry_drains_workloads_too():
+    """Quarantine via the exhausted shortcut (flapping relapse) must
+    evict pinned TPU workloads like the cordon-drain path does —
+    NoSchedule only gates NEW placement."""
+    client = seeded()
+    ctrl = NodeRemediationController(client)
+    sp = spec(systemic_threshold="90%")  # max_attempts=2
+
+    def kill():
+        n = client.get("v1", "Node", "node-1")
+        n["status"]["allocatable"]["google.com/tpu"] = "0"
+        client.update(n)
+
+    kill()
+    for _ in range(4):
+        run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_QUARANTINED
+    heal(client, "node-1")
+    run_pass(client, ctrl, sp)
+    # a gang job lands on the briefly-healthy flapper before the relapse
+    client.create(workload_pod("train-flap", "node-1"))
+    kill()
+    run_pass(client, ctrl, sp)
+    assert node_state(client, "node-1") == consts.REMEDIATION_STATE_EXHAUSTED
+    assert client.get_or_none("v1", "Pod", "train-flap", "default") is None

@@ -1,0 +1,416 @@
+"""Deterministic node-remediation chaos matrix (tier-1, `make chaos-fast`).
+
+The node-level analogue of ``test_fault_matrix.py``: kubesim's node
+fault injection (chip kill/restore, CrashLoopBackOff, health flapping)
+drives the full operator — manager, informer cache, reconcile pass with
+the remediation FSM, slice aggregation — over the real HTTP wire, and
+the invariants are the remediation contract:
+
+* chip death on one host of a multi-host slice ends ``quarantined``
+  (cordon + ``tpu.k8s.io/repair`` NoSchedule taint) with the slice
+  verdict flipping and the degradation naming the host; restoring the
+  chips ends ``recovered`` with the node uncordoned/untainted and the
+  slice READY again;
+* a flapping host burns its attempt cap and lands ``exhausted`` —
+  quarantined even while momentarily healthy, until a human intervenes;
+* a >= systemicThreshold fleet failure opens the breaker: ZERO
+  disruptions are issued (no cordon, no taint, no eviction) and the CR
+  carries a ``Degraded/SystemicNodeFailure`` condition.
+"""
+
+import os
+import time
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+from tests.conftest import running_operator, wait_until
+from tpu_operator import consts
+from tpu_operator.kube.client import has_taint
+from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+from tpu_operator.kube.testing import (
+    edit_clusterpolicy,
+    make_tpu_node,
+    seed_cluster,
+)
+
+NS = "tpu-operator"
+CPV = "tpu.k8s.io/v1"
+SLICE_ID = "rm-slice-a"
+SLICE_NODES = ("rm-node-1", "rm-node-2")
+SINGLE_NODES = ("rm-node-3", "rm-node-4", "rm-node-5", "rm-node-6")
+NODES = SLICE_NODES + SINGLE_NODES
+
+
+def _start_cluster(node_names=NODES, slice_nodes=SLICE_NODES, chips=8):
+    """kubesim + TPU fleet (a 2-host slice plus single-host nodes), all
+    hosts advertising chips via the injection helper."""
+    server = KubeSimServer(KubeSim(bookmark_interval_s=1.0)).start()
+    sim = server.sim
+    client = make_client(server.port)
+    client.create(
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}
+    )
+    from tpu_operator.cfg.crdgen import build_crd
+
+    client.create(build_crd())
+    for name in node_names:
+        extra = {}
+        if name in slice_nodes:
+            extra = {
+                consts.TFD_SLICE_ID_LABEL: SLICE_ID,
+                consts.TFD_SLICE_HOSTS_LABEL: str(len(slice_nodes)),
+            }
+        client.create(make_tpu_node(name, extra_labels=extra))
+        sim.set_node_chips(name, chips)
+    import yaml
+
+    from tpu_operator.kube.testing import sample_clusterpolicy_path
+
+    with open(sample_clusterpolicy_path()) as f:
+        client.create(yaml.safe_load(f))
+    return server, sim, client
+
+
+def _enable_remediation(client, **knobs):
+    merged = {
+        "enabled": True,
+        "maxAttempts": 2,
+        "backoffSeconds": 0,
+        "maxUnavailable": "50%",
+        "systemicThreshold": "50%",
+    }
+    merged.update(knobs)
+    edit_clusterpolicy(
+        client, lambda cp: cp["spec"].update(remediation=merged)
+    )
+
+
+def _cp_status(client):
+    cp = client.get_or_none(CPV, "ClusterPolicy", "cluster-policy") or {}
+    return cp.get("status") or {}
+
+
+def _node(client, name):
+    return client.get("v1", "Node", name)
+
+
+def _state(client, name):
+    return (_node(client, name)["metadata"].get("labels") or {}).get(
+        consts.REMEDIATION_STATE_LABEL
+    )
+
+
+def _quarantined(client, name):
+    node = _node(client, name)
+    return (
+        _state(client, name) == consts.REMEDIATION_STATE_QUARANTINED
+        and node.get("spec", {}).get("unschedulable", False)
+        and has_taint(node, consts.REPAIR_TAINT_KEY, consts.REPAIR_PENDING)
+    )
+
+
+def _clean(client, name):
+    node = _node(client, name)
+    labels = node["metadata"].get("labels") or {}
+    return (
+        consts.REMEDIATION_STATE_LABEL not in labels
+        and consts.REPAIR_LABEL not in labels
+        and not node.get("spec", {}).get("unschedulable", False)
+        and not has_taint(node, consts.REPAIR_TAINT_KEY)
+    )
+
+
+def _slice_ready(client, members):
+    return all(
+        (_node(client, n)["metadata"].get("labels") or {}).get(
+            consts.SLICE_READY_LABEL
+        )
+        == "true"
+        for n in members
+    )
+
+
+def _event(client, reason, *needles):
+    for e in client.list("v1", "Event", NS):
+        if e.get("reason") == reason and all(
+            n in e.get("message", "") for n in needles
+        ):
+            return e
+    return None
+
+
+def test_chip_death_quarantines_then_recovery_uncordons():
+    """Matrix row 1: one host of the 2-host slice loses its chips ->
+    quarantined with the slice verdict naming the host; chips return ->
+    recovered, uncordoned, untainted, slice READY."""
+    server, sim, client = _start_cluster()
+    victim = SLICE_NODES[0]
+    try:
+        with running_operator(client, NS, NODES):
+            assert wait_until(
+                lambda: _cp_status(client).get("state") == "ready", 90
+            ), _cp_status(client)
+            assert wait_until(
+                lambda: _slice_ready(client, SLICE_NODES), 60
+            ), {n: _node(client, n)["metadata"]["labels"] for n in SLICE_NODES}
+            _enable_remediation(client)
+
+            sim.kill_node_chips(victim)
+            assert wait_until(lambda: _quarantined(client, victim), 90), (
+                victim,
+                _state(client, victim),
+            )
+            # the whole slice flipped, and the degradation names the host
+            assert wait_until(
+                lambda: not _slice_ready(client, SLICE_NODES), 30
+            )
+            assert wait_until(
+                lambda: _event(client, "SliceDegraded", SLICE_ID, victim)
+                is not None,
+                30,
+            ), [e.get("message") for e in client.list("v1", "Event", NS)]
+            # ...and the quarantine Event names host + slice
+            assert wait_until(
+                lambda: _event(client, "NodeQuarantined", victim, SLICE_ID)
+                is not None,
+                30,
+            )
+            # the CR counts it
+            assert wait_until(
+                lambda: (_cp_status(client).get("remediation") or {}).get(
+                    "quarantined", 0
+                )
+                >= 1,
+                30,
+            ), _cp_status(client)
+            # the healthy sibling is untouched
+            assert _clean(client, SLICE_NODES[1])
+
+            # chips return -> recovered: clean node, READY slice
+            sim.restore_node_chips(victim)
+            assert wait_until(lambda: _clean(client, victim), 90), (
+                _state(client, victim),
+                _node(client, victim)["spec"],
+            )
+            assert wait_until(
+                lambda: _slice_ready(client, SLICE_NODES), 90
+            ), {n: _node(client, n)["metadata"]["labels"] for n in SLICE_NODES}
+            assert _event(client, "NodeRemediationRecovered", victim)
+    finally:
+        server.stop()
+
+
+def test_flapping_host_lands_exhausted():
+    """Matrix row 2: kill -> quarantine -> restore -> recover -> kill
+    again burns the attempt cap (maxAttempts=2): the host lands
+    ``exhausted``, quarantined even while its chips read healthy, and
+    its (single-host) slice stays out of service."""
+    server, sim, client = _start_cluster()
+    victim = SINGLE_NODES[0]
+    try:
+        with running_operator(client, NS, NODES):
+            assert wait_until(
+                lambda: _cp_status(client).get("state") == "ready", 90
+            )
+            _enable_remediation(client)
+
+            sim.kill_node_chips(victim)  # flap edge 1: down
+            assert wait_until(lambda: _quarantined(client, victim), 90), (
+                _state(client, victim)
+            )
+            sim.flap_node_chips(victim)  # flap edge 2: up again
+            assert wait_until(lambda: _clean(client, victim), 90), (
+                _state(client, victim)
+            )
+            sim.flap_node_chips(victim)  # flap edge 3: down again
+            assert wait_until(
+                lambda: _state(client, victim)
+                == consts.REMEDIATION_STATE_EXHAUSTED,
+                90,
+            ), _state(client, victim)
+            node = _node(client, victim)
+            assert node["spec"]["unschedulable"] is True
+            assert has_taint(node, consts.REPAIR_TAINT_KEY)
+            assert _event(client, "NodeRemediationExhausted", victim)
+
+            # exhausted is sticky: chips back, node still fenced — and
+            # its slice verdict stays false (the quarantined-host branch
+            # of the aggregate, not the chip signal, holds it down)
+            sim.restore_node_chips(victim)
+            time.sleep(2.0)
+            assert (
+                _state(client, victim) == consts.REMEDIATION_STATE_EXHAUSTED
+            )
+            assert _node(client, victim)["spec"]["unschedulable"] is True
+            assert wait_until(
+                lambda: (
+                    _node(client, victim)["metadata"].get("labels") or {}
+                ).get(consts.SLICE_READY_LABEL)
+                == "false",
+                30,
+            )
+            assert wait_until(
+                lambda: (_cp_status(client).get("remediation") or {}).get(
+                    "exhausted", 0
+                )
+                >= 1,
+                30,
+            ), _cp_status(client)
+    finally:
+        server.stop()
+
+
+def test_systemic_failure_opens_breaker_zero_disruptions():
+    """Matrix row 3: 50% of the fleet dying at once opens the breaker —
+    remediation halts with ZERO disruptions (no cordon, no taint, no
+    eviction: the workload pod survives) and the CR carries
+    Degraded/SystemicNodeFailure; half the failure clearing closes the
+    breaker and remediation resumes on the rest."""
+    nodes = SINGLE_NODES  # 4 single-host nodes; threshold 50% -> 2
+    server, sim, client = _start_cluster(
+        node_names=nodes, slice_nodes=()
+    )
+    try:
+        with running_operator(client, NS, list(nodes)):
+            assert wait_until(
+                lambda: _cp_status(client).get("state") == "ready", 90
+            )
+            # a TPU workload pod on a soon-dead node: it must SURVIVE the
+            # systemic event (zero evictions is the breaker's promise)
+            client.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {
+                        "name": "train-1",
+                        "namespace": "default",
+                        "labels": {"job": "train"},
+                        "ownerReferences": [
+                            {"kind": "Job", "name": "train", "uid": "j1"}
+                        ],
+                    },
+                    "spec": {
+                        "nodeName": nodes[0],
+                        "containers": [
+                            {
+                                "name": "train",
+                                "resources": {
+                                    "limits": {"google.com/tpu": "4"}
+                                },
+                            }
+                        ],
+                    },
+                    "status": {"phase": "Running"},
+                }
+            )
+            # both hosts die BEFORE remediation is switched on: the very
+            # first enabled pass sees the systemic picture (enabling
+            # first would race a single-victim pass into `observed`
+            # before the second kill lands — a label write the
+            # zero-writes assertion below would then misread)
+            sim.kill_node_chips(nodes[0])
+            sim.kill_node_chips(nodes[1])
+            _enable_remediation(client)
+            assert wait_until(
+                lambda: (_cp_status(client).get("remediation") or {}).get(
+                    "breakerOpen"
+                )
+                is True,
+                60,
+            ), _cp_status(client)
+            conditions = {
+                c["type"]: c
+                for c in _cp_status(client).get("conditions") or []
+            }
+            assert conditions["Degraded"]["status"] == "True"
+            assert conditions["Degraded"]["reason"] == "SystemicNodeFailure"
+            assert _event(client, "SystemicNodeFailure")
+
+            # zero disruptions: give the operator a few passes to (not)
+            # act, then check nothing was cordoned/tainted/evicted
+            time.sleep(2.0)
+            for name in nodes:
+                node = _node(client, name)
+                labels = node["metadata"].get("labels") or {}
+                assert consts.REMEDIATION_STATE_LABEL not in labels, name
+                assert not node.get("spec", {}).get(
+                    "unschedulable", False
+                ), name
+                assert not has_taint(node, consts.REPAIR_TAINT_KEY), name
+            assert (
+                client.get_or_none("v1", "Pod", "train-1", "default")
+                is not None
+            )
+
+            # half the failure clears -> breaker closes -> the remaining
+            # dead host is remediated normally (quarantined, drained)
+            sim.restore_node_chips(nodes[1])
+            assert wait_until(
+                lambda: _quarantined(client, nodes[0]), 120
+            ), (_state(client, nodes[0]), _cp_status(client))
+            assert wait_until(
+                lambda: client.get_or_none(
+                    "v1", "Pod", "train-1", "default"
+                )
+                is None,
+                30,
+            )
+            assert not (
+                (_cp_status(client).get("remediation") or {}).get(
+                    "breakerOpen"
+                )
+            )
+    finally:
+        server.stop()
+
+
+def test_crashloop_operand_remediated_by_restart_without_quarantine():
+    """Matrix row 4: a CrashLoopBackOff operand (kubesim's
+    ``crashloop_pod`` injection) is fixed by the CHEAP rung of the
+    ladder — restart-operands deletes the pod, the DaemonSet recreates
+    it Running — and the node recovers with no cordon, no taint, no
+    eviction ever issued."""
+    nodes = SINGLE_NODES
+    server, sim, client = _start_cluster(node_names=nodes, slice_nodes=())
+    victim = nodes[0]
+    try:
+        with running_operator(client, NS, list(nodes)):
+            assert wait_until(
+                lambda: _cp_status(client).get("state") == "ready", 90
+            )
+            # backoffSeconds=1: the revalidate dwell outlasts the kubelet
+            # sim's recreate interval, so the restart FIX is observed
+            # before the FSM could escalate
+            _enable_remediation(client, backoffSeconds=1)
+
+            pod = next(
+                p
+                for p in client.list("v1", "Pod", NS)
+                if p["spec"].get("nodeName") == victim
+                and (p["metadata"].get("labels") or {}).get("app")
+            )
+            pod_name = pod["metadata"]["name"]
+            sim.crashloop_pod(NS, pod_name)
+
+            # the FSM walks observed -> restart-operands -> revalidate,
+            # the DS recreates the pod Running, and the node recovers
+            assert wait_until(
+                lambda: (
+                    (
+                        client.get_or_none("v1", "Pod", pod_name, NS) or {}
+                    ).get("status", {})
+                    or {}
+                ).get("containerStatuses")
+                == [{"ready": True}],
+                90,
+            ), client.get_or_none("v1", "Pod", pod_name, NS)
+            assert wait_until(lambda: _clean(client, victim), 90), _state(
+                client, victim
+            )
+            # the cheap rung sufficed: the node was never cordoned
+            node = _node(client, victim)
+            assert not node.get("spec", {}).get("unschedulable", False)
+            assert not has_taint(node, consts.REPAIR_TAINT_KEY)
+    finally:
+        server.stop()

@@ -1,0 +1,297 @@
+"""Node taint support across every client layer — the quarantine
+primitive: read (``node_taints``/``has_taint``), strategic-merge write
+(``set_node_taint``/``remove_node_taint`` keyed on (key, effect) like the
+apiserver's strategic merge for ``spec.taints``), conflict-retry via
+``mutate_with_retry``, and NoSchedule-aware pod placement in the
+DS-controller/kubelet simulator."""
+
+import os
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+import pytest
+
+from tests.conftest import make_tpu_node
+from tpu_operator import consts
+from tpu_operator.kube import FakeClient
+from tpu_operator.kube.client import (
+    ConflictError,
+    has_taint,
+    merge_taint,
+    node_taints,
+    remove_node_taint,
+    set_node_taint,
+)
+
+NS = "tpu-operator"
+
+
+# ---------------------------------------------------------------------------
+# merge semantics (the single shared definition)
+# ---------------------------------------------------------------------------
+
+
+def test_merge_taint_appends_and_replaces():
+    taints = [{"key": "a", "value": "1", "effect": "NoSchedule"}]
+    # new key appends
+    assert merge_taint(taints, "b", "2", "NoSchedule")
+    assert len(taints) == 2
+    # same key+effect replaces in place (strategic merge on patchMergeKey)
+    assert merge_taint(taints, "a", "9", "NoSchedule")
+    assert taints[0] == {"key": "a", "value": "9", "effect": "NoSchedule"}
+    assert len(taints) == 2
+    # identical desired taint: no change
+    assert not merge_taint(taints, "a", "9", "NoSchedule")
+    # same key, DIFFERENT effect: a distinct taint, appended
+    assert merge_taint(taints, "a", "9", "NoExecute")
+    assert len(taints) == 3
+
+
+# ---------------------------------------------------------------------------
+# read + write through the client layers
+# ---------------------------------------------------------------------------
+
+
+def _roundtrip(client, name):
+    set_node_taint(
+        client, name, consts.REPAIR_TAINT_KEY, consts.REPAIR_PENDING
+    )
+    node = client.get("v1", "Node", name)
+    assert has_taint(node, consts.REPAIR_TAINT_KEY)
+    assert has_taint(node, consts.REPAIR_TAINT_KEY, consts.REPAIR_PENDING)
+    assert not has_taint(node, consts.REPAIR_TAINT_KEY, "other")
+    [taint] = [
+        t
+        for t in node_taints(node)
+        if t["key"] == consts.REPAIR_TAINT_KEY
+    ]
+    assert taint["effect"] == "NoSchedule"
+    # idempotent re-apply: rv must not move (no write happened)
+    rv = node["metadata"]["resourceVersion"]
+    set_node_taint(
+        client, name, consts.REPAIR_TAINT_KEY, consts.REPAIR_PENDING
+    )
+    assert (
+        client.get("v1", "Node", name)["metadata"]["resourceVersion"] == rv
+    )
+    # removal drops the key and leaves other taints alone
+    set_node_taint(client, name, "user-taint", "x", "NoExecute")
+    remove_node_taint(client, name, consts.REPAIR_TAINT_KEY)
+    node = client.get("v1", "Node", name)
+    assert not has_taint(node, consts.REPAIR_TAINT_KEY)
+    assert has_taint(node, "user-taint")
+    # removing the last taint drops the (now empty) list entirely
+    remove_node_taint(client, name, "user-taint")
+    node = client.get("v1", "Node", name)
+    assert "taints" not in node.get("spec", {})
+    # removing an absent taint writes nothing
+    rv = node["metadata"]["resourceVersion"]
+    remove_node_taint(client, name, "never-there")
+    assert (
+        client.get("v1", "Node", name)["metadata"]["resourceVersion"] == rv
+    )
+
+
+def test_taints_fake_client():
+    client = FakeClient([make_tpu_node("t-node-1")])
+    _roundtrip(client, "t-node-1")
+
+
+def test_taints_kubesim_rest_client():
+    from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+
+    server = KubeSimServer(KubeSim()).start()
+    try:
+        client = make_client(server.port)
+        client.create(make_tpu_node("t-node-1"))
+        _roundtrip(client, "t-node-1")
+    finally:
+        server.stop()
+
+
+def test_taints_cached_client_write_through():
+    from tpu_operator.kube.cache import CachedClient
+
+    base = FakeClient([make_tpu_node("t-node-1")])
+    client = CachedClient(base, namespace=NS)
+    assert client.start_informers() is True
+    try:
+        _roundtrip(client, "t-node-1")
+        # the cached view carries the taint written through it
+        set_node_taint(
+            client, "t-node-1", consts.REPAIR_TAINT_KEY, consts.REPAIR_PENDING
+        )
+        assert has_taint(
+            client.get("v1", "Node", "t-node-1"), consts.REPAIR_TAINT_KEY
+        )
+    finally:
+        client.stop()
+
+
+def test_taint_write_conflict_retries():
+    """A concurrent writer bumping the rv mid-mutate must be absorbed by
+    mutate_with_retry, not surface as a ConflictError."""
+    client = FakeClient([make_tpu_node("t-node-1")])
+    real_update = client.update
+    raced = {"done": False}
+
+    def racing_update(obj):
+        if not raced["done"] and obj.get("kind") == "Node":
+            raced["done"] = True
+            # another actor labels the node between our read and write
+            other = client.get("v1", "Node", "t-node-1")
+            other["metadata"]["labels"]["racer"] = "yes"
+            real_update(other)
+        return real_update(obj)
+
+    client.update = racing_update
+    set_node_taint(
+        client, "t-node-1", consts.REPAIR_TAINT_KEY, consts.REPAIR_PENDING
+    )
+    node = client.get("v1", "Node", "t-node-1")
+    assert raced["done"]
+    assert has_taint(node, consts.REPAIR_TAINT_KEY)
+    assert node["metadata"]["labels"]["racer"] == "yes"  # nothing reverted
+
+
+# ---------------------------------------------------------------------------
+# NoSchedule-aware pod placement (DS-controller/kubelet sim)
+# ---------------------------------------------------------------------------
+
+
+def _ds(name, tolerations=None):
+    spec = {"nodeSelector": {}}
+    if tolerations is not None:
+        spec["tolerations"] = tolerations
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {"name": name, "namespace": NS},
+        "spec": {
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {
+                    "annotations": {
+                        consts.LAST_APPLIED_HASH_ANNOTATION: "h1"
+                    }
+                },
+                "spec": spec,
+            },
+            "updateStrategy": {"type": "RollingUpdate"},
+        },
+    }
+
+
+def test_kubelet_sim_honors_noschedule_taints():
+    from tpu_operator.kube.testing import simulate_kubelet_nodes
+
+    client = FakeClient(
+        [
+            {
+                "apiVersion": "v1",
+                "kind": "Namespace",
+                "metadata": {"name": NS},
+            },
+            make_tpu_node("clean-node"),
+            make_tpu_node("tainted-node"),
+        ]
+    )
+    set_node_taint(
+        client,
+        "tainted-node",
+        consts.REPAIR_TAINT_KEY,
+        consts.REPAIR_PENDING,
+    )
+    client.create(_ds("plain-ds"))
+    client.create(
+        _ds(
+            "tolerant-ds",
+            tolerations=[
+                {
+                    "key": consts.REPAIR_TAINT_KEY,
+                    "operator": "Exists",
+                    "effect": "NoSchedule",
+                }
+            ],
+        )
+    )
+    simulate_kubelet_nodes(client, NS, ["clean-node", "tainted-node"])
+    pods = {p["metadata"]["name"] for p in client.list("v1", "Pod", NS)}
+    # the intolerant DS lands only on the clean node
+    assert "plain-ds-clean-node" in pods
+    assert "plain-ds-tainted-node" not in pods
+    # the tolerant DS (the operand shape) lands on both
+    assert "tolerant-ds-clean-node" in pods
+    assert "tolerant-ds-tainted-node" in pods
+    # desired counts reflect schedulable nodes only
+    plain = client.get("apps/v1", "DaemonSet", "plain-ds", NS)
+    assert plain["status"]["desiredNumberScheduled"] == 1
+    tolerant = client.get("apps/v1", "DaemonSet", "tolerant-ds", NS)
+    assert tolerant["status"]["desiredNumberScheduled"] == 2
+
+
+def test_toleration_matching_semantics():
+    from tpu_operator.kube.testing import toleration_matches
+
+    taint = {
+        "key": consts.REPAIR_TAINT_KEY,
+        "value": consts.REPAIR_PENDING,
+        "effect": "NoSchedule",
+    }
+    # empty key + Exists tolerates everything
+    assert toleration_matches({"operator": "Exists"}, taint)
+    # key-scoped Exists, any value
+    assert toleration_matches(
+        {"key": consts.REPAIR_TAINT_KEY, "operator": "Exists"}, taint
+    )
+    # Equal requires the value too
+    assert toleration_matches(
+        {
+            "key": consts.REPAIR_TAINT_KEY,
+            "operator": "Equal",
+            "value": consts.REPAIR_PENDING,
+        },
+        taint,
+    )
+    assert not toleration_matches(
+        {"key": consts.REPAIR_TAINT_KEY, "operator": "Equal", "value": "x"},
+        taint,
+    )
+    # wrong key / wrong effect never tolerate
+    assert not toleration_matches(
+        {"key": "other", "operator": "Exists"}, taint
+    )
+    assert not toleration_matches(
+        {
+            "key": consts.REPAIR_TAINT_KEY,
+            "operator": "Exists",
+            "effect": "NoExecute",
+        },
+        taint,
+    )
+    # empty key WITHOUT Exists is invalid -> tolerates nothing
+    assert not toleration_matches({"operator": "Equal", "value": "x"}, taint)
+
+
+def test_rendered_operand_daemonsets_tolerate_repair_taint():
+    """Every rendered operand DaemonSet carries the repair-taint
+    toleration: quarantine fences workloads, never the operator's own
+    agents (revalidation needs them running on the tainted host)."""
+    from tpu_operator.controllers.object_controls import (
+        _apply_common_daemonset_config,
+    )
+
+    class _N:
+        from tpu_operator.api.v1.clusterpolicy_types import ClusterPolicy
+
+        cp = ClusterPolicy()
+
+    ds = _ds("any-operand")
+    _apply_common_daemonset_config(_N, ds)
+    tols = ds["spec"]["template"]["spec"]["tolerations"]
+    assert {
+        "key": consts.REPAIR_TAINT_KEY,
+        "operator": "Exists",
+        "effect": "NoSchedule",
+    } in tols

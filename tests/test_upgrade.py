@@ -774,3 +774,198 @@ def test_wait_for_jobs_sees_pods_outside_scoped_cache(cluster):
     cluster.delete("v1", "Pod", "coordinator", "default")
     pump(mgr, policy, times=1)
     assert node_state(cluster, "node-1") != us.STATE_WAIT_FOR_JOBS_REQUIRED
+
+
+# ---------------------------------------------------------------------------
+# upgrade-failed is no longer terminal: bounded auto-retry + skip hatch
+# (before: a failed node consumed maxUnavailable budget FOREVER and
+# starved sibling slices until a human cleared the label)
+# ---------------------------------------------------------------------------
+
+
+def _fail_node_via_drain_timeout(cluster, mgr, policy, name="node-1"):
+    cluster.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": f"naked-{name}", "namespace": "default"},
+            "spec": {
+                "nodeName": name,
+                "containers": [
+                    {"resources": {"limits": {"google.com/tpu": "4"}}}
+                ],
+            },
+        }
+    )
+    pump(mgr, policy, times=6)
+    assert node_state(cluster, name) == us.STATE_DRAIN_REQUIRED
+    _age_node_state(cluster, name, policy.drain.timeout_seconds + 1)
+    pump(mgr, policy, times=1)
+    assert node_state(cluster, name) == us.STATE_FAILED
+
+
+def test_failed_node_auto_retries_after_backoff(cluster):
+    import json
+
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable="25%",
+        drain=DrainSpec(enable=True, timeout_seconds=300),
+    )
+    _fail_node_via_drain_timeout(cluster, mgr, policy)
+
+    # not yet due (the failure re-stamped the state clock): stays failed
+    pump(mgr, policy, times=2)
+    assert node_state(cluster, "node-1") == us.STATE_FAILED
+
+    # past the first backoff window -> auto-retry re-enters the FSM and
+    # records the attempt in the annotation
+    _age_node_state(cluster, "node-1", us.FAILED_RETRY_BASE_S + 1)
+    pump(mgr, policy, times=1)
+    assert node_state(cluster, "node-1") != us.STATE_FAILED
+    ann = cluster.get("v1", "Node", "node-1")["metadata"]["annotations"]
+    assert json.loads(ann[consts.UPGRADE_RETRY_ANNOTATION])["count"] == 1
+
+
+def test_failed_retry_capped(cluster):
+    """Past FAILED_RETRY_MAX the node stays failed — retries must be
+    bounded, not a forever crash-loop of drains."""
+    import json
+
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    node = cluster.get("v1", "Node", "node-1")
+    node["metadata"].setdefault("annotations", {})[
+        consts.UPGRADE_RETRY_ANNOTATION
+    ] = json.dumps({"count": us.FAILED_RETRY_MAX})
+    cluster.update(node)
+    mgr.provider.set_state(cluster.get("v1", "Node", "node-1"), us.STATE_FAILED)
+    _age_node_state(cluster, "node-1", us.FAILED_RETRY_CAP_S + 1)
+    policy = UpgradePolicySpec(auto_upgrade=True, max_unavailable="100%")
+    pump(mgr, policy, times=3)
+    assert node_state(cluster, "node-1") == us.STATE_FAILED
+
+
+def test_failed_node_no_longer_starves_pending_slices(cluster):
+    """THE regression: with maxUnavailable=1 slice, a failed node used to
+    pin the whole budget forever (admit=0, every sibling slice pending
+    until a human intervened). The auto-retry returns the node to the
+    pool, after which admission resumes."""
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable="25%",  # 1 of the 4 single-host slices
+        drain=DrainSpec(enable=True, timeout_seconds=300),
+    )
+    _fail_node_via_drain_timeout(cluster, mgr, policy)
+
+    # the failed slice pins the budget: nothing else is admitted
+    budget = us.slice_budget(mgr.build_state(), policy)
+    assert budget.failed_sids == {"node-1"}
+    assert budget.admit == 0
+    for i in (2, 3, 4):
+        assert node_state(cluster, f"node-{i}") == us.STATE_UPGRADE_REQUIRED
+
+    # the drain blocker is fixed and the backoff elapses -> the node
+    # auto-retries, the budget frees, and pending slices move again
+    cluster.delete("v1", "Pod", "naked-node-1", "default")
+    _age_node_state(cluster, "node-1", us.FAILED_RETRY_BASE_S + 1)
+    pump(mgr, policy, times=1)
+    budget = us.slice_budget(mgr.build_state(), policy)
+    assert budget.failed_sids == set()
+    assert budget.admit == 1
+    pump(mgr, policy, times=1)
+    active = sum(
+        1
+        for i in (1, 2, 3, 4)
+        if node_state(cluster, f"node-{i}")
+        not in (us.STATE_UPGRADE_REQUIRED, None)
+    )
+    assert active >= 1  # admission resumed — no longer starved
+
+
+def test_skip_label_drops_failed_node_and_frees_budget(cluster):
+    """The explicit escape hatch: UPGRADE_SKIP_LABEL on a failed node
+    drops it from the FSM immediately (no backoff wait), releasing its
+    budget share while leaving the node cordoned for inspection."""
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable="25%",
+        drain=DrainSpec(enable=True, timeout_seconds=300),
+    )
+    _fail_node_via_drain_timeout(cluster, mgr, policy)
+    assert us.slice_budget(mgr.build_state(), policy).admit == 0
+
+    node = cluster.get("v1", "Node", "node-1")
+    node["metadata"]["labels"][consts.UPGRADE_SKIP_LABEL] = "true"
+    cluster.update(node)
+    pump(mgr, policy, times=1)
+    node = cluster.get("v1", "Node", "node-1")
+    assert consts.UPGRADE_STATE_LABEL not in node["metadata"]["labels"]
+    assert consts.UPGRADE_RETRY_ANNOTATION not in node["metadata"].get(
+        "annotations", {}
+    )
+    assert node["spec"]["unschedulable"]  # left cordoned for a human
+    budget = us.slice_budget(mgr.build_state(), policy)
+    assert budget.failed_sids == set()
+    assert budget.admit == 1
+
+
+def test_slice_budget_counts_remediation_quarantine(cluster):
+    """Upgrades + repairs share ONE maxUnavailable pool: a slice whose
+    member host the remediation FSM holds quarantined consumes upgrade
+    admission exactly like an upgrade-failed slice."""
+    node = cluster.get("v1", "Node", "node-2")
+    node["metadata"]["labels"][
+        consts.REMEDIATION_STATE_LABEL
+    ] = consts.REMEDIATION_STATE_QUARANTINED
+    cluster.update(node)
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=4, max_unavailable="25%"
+    )
+    budget = us.slice_budget(mgr.build_state(), policy)
+    assert budget.repair_sids == {"node-2"}
+    assert budget.admit == 0  # the quarantined slice holds the whole cap
+    mgr.apply_state(mgr.build_state(), policy)
+    # nothing was admitted: combined disruptions stay within the cap
+    for i in (1, 3, 4):
+        assert node_state(cluster, f"node-{i}") == us.STATE_UPGRADE_REQUIRED
+
+    # the quarantine lifts -> upgrades admit again
+    node = cluster.get("v1", "Node", "node-2")
+    del node["metadata"]["labels"][consts.REMEDIATION_STATE_LABEL]
+    cluster.update(node)
+    assert us.slice_budget(mgr.build_state(), policy).admit == 1
+
+
+def test_upgrade_never_admits_a_quarantined_slice(cluster):
+    """A remediation-quarantined slice must be excluded from PENDING,
+    not just subtracted from headroom: admitting it would drain a
+    chips-dead host into a guaranteed validation failure (upgrade-failed
+    on a quarantined node deadlocks both FSMs until a human unpicks
+    them)."""
+    node = cluster.get("v1", "Node", "node-1")
+    node["metadata"]["labels"][
+        consts.REMEDIATION_STATE_LABEL
+    ] = consts.REMEDIATION_STATE_QUARANTINED
+    cluster.update(node)
+    mgr = us.ClusterUpgradeStateManager(cluster, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=4, max_unavailable="50%"
+    )
+    budget = us.slice_budget(mgr.build_state(), policy)
+    # headroom exists (cap 2, one repair slice) but the quarantined
+    # slice is NOT pending — other slices get the remaining admission
+    assert budget.admit == 1
+    assert "node-1" not in budget.pending_sids
+    pump(mgr, policy, times=3)
+    assert node_state(cluster, "node-1") in (None, us.STATE_UPGRADE_REQUIRED)
+    # node-1 was never cordoned by the upgrade FSM
+    assert not cluster.get("v1", "Node", "node-1").get("spec", {}).get(
+        "unschedulable", False
+    )

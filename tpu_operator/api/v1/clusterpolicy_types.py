@@ -505,6 +505,37 @@ class MaintenanceHandlerSpec(_ImageSpec):
 
 
 @dataclass
+class RemediationSpec(SpecBase):
+    """Node-health remediation FSM knobs (TPU-specific; no reference
+    analogue — SURVEY §5 failure detection). Opt-in like the maintenance
+    handler: remediation cordons, taints and drains nodes on purpose.
+
+    ``maxUnavailable`` is the fleet-wide disruption budget SHARED with
+    rolling libtpu upgrades: both admissions count the same JOINT set of
+    disrupted slices (upgrade-active/failed + remediation-quarantined,
+    ``upgrade_state.slice_budget``), each against its own cap — with this
+    knob equal to ``upgradePolicy.maxUnavailable`` (both default "25%")
+    that is exactly one pool; if they differ, the tighter cap governs new
+    disruptions on its own side.
+    ``maxAttempts`` caps escalation steps per node before ``exhausted``;
+    ``backoffSeconds`` is the jittered-exponential base between steps.
+    ``systemicThreshold`` is the systemic-failure breaker: when at least
+    that fraction of TPU nodes turns unhealthy in one pass, remediation
+    halts with zero drains (a bad libtpu push must not drain the fleet).
+    """
+
+    enabled: Optional[bool] = None
+    max_unavailable: str = "25%"
+    max_attempts: int = 5
+    backoff_seconds: int = 30
+    systemic_threshold: str = "50%"
+
+    def is_enabled(self) -> bool:
+        # opt-in: remediation issues disruptions (cordon/taint/drain)
+        return bool(self.enabled)
+
+
+@dataclass
 class SliceSpec(SpecBase):
     """Subslice exposure strategy — the reference's ``MIGSpec``.
 
@@ -753,6 +784,7 @@ class ClusterPolicySpec(SpecBase):
     maintenance_handler: MaintenanceHandlerSpec = field(
         default_factory=MaintenanceHandlerSpec
     )
+    remediation: RemediationSpec = field(default_factory=RemediationSpec)
     slice: SliceSpec = field(default_factory=SliceSpec)
     slice_manager: SliceManagerSpec = field(default_factory=SliceManagerSpec)
     validator: ValidatorSpec = field(default_factory=ValidatorSpec)
@@ -788,6 +820,11 @@ class ClusterPolicyStatus(SpecBase):
     # [{"state": name, "error": "Type: message"}]; the pass continues to
     # independent states and a Degraded condition summarizes this block
     errored_states: List[Dict[str, Any]] = field(default_factory=list)
+    # node-health remediation counts: {"unhealthy": N, "quarantined": N,
+    # "exhausted": N, "breakerOpen": bool} — the fleet-repair truth at a
+    # glance; breakerOpen mirrors the Degraded/SystemicNodeFailure
+    # condition
+    remediation: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass

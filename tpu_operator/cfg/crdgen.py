@@ -52,6 +52,14 @@ _FIELD_RULES: Dict[str, Dict[str, Any]] = {
     },
     "timeoutSeconds": {"minimum": 0},
     "maxParallelUpgrades": {"minimum": 0},
+    # remediation FSM knobs: the breaker threshold is int-or-percent like
+    # maxUnavailable; attempts/backoff are plain non-negative integers
+    "systemicThreshold": {
+        "x-kubernetes-int-or-string": True,
+        "pattern": r"^\d+%?$",
+    },
+    "maxAttempts": {"minimum": 0},
+    "backoffSeconds": {"minimum": 0},
     "hostPort": {"minimum": 1, "maximum": 65535},
     "tolerations": {"items": TOLERATION_SCHEMA},
     # k8s Quantities: `cpu: 2` and `cpu: "2"` are both valid, so these

@@ -120,6 +120,48 @@ MAINTENANCE_STATE_LABEL = f"{GROUP}/maintenance"
 # upgrade FSM's initial-state pattern: the all-clear restores, not resets)
 MAINTENANCE_INITIAL_STATE_ANNOTATION = f"{GROUP}/maintenance-initial-unschedulable"
 
+# --- node-health remediation FSM (TPU-specific; no reference analogue,
+#     reusing the upgrade FSM's durable node-label store pattern,
+#     upgrade_state.go:419-429) -----------------------------------------
+# per-node FSM state, persisted as a label so remediation survives
+# operator restarts:
+#   observed -> restart-operands -> revalidate -> cordon-drain ->
+#   quarantined -> recovered | exhausted
+REMEDIATION_STATE_LABEL = f"{GROUP}/remediation-state"
+REMEDIATION_STATE_SINCE_ANNOTATION = f"{GROUP}/remediation-state-since"
+# escalation bookkeeping: {"attempts": N, "retryAt": iso8601} JSON —
+# jittered exponential backoff between escalation steps, attempt-capped
+# by spec.remediation.maxAttempts
+REMEDIATION_ATTEMPTS_ANNOTATION = f"{GROUP}/remediation-attempts"
+# node was already cordoned when remediation quarantined it; recovery
+# restores, not resets (the upgrade FSM's initial-state pattern)
+REMEDIATION_INITIAL_STATE_ANNOTATION = (
+    f"{GROUP}/remediation.node-initial-state.unschedulable"
+)
+# escape hatch: the remediator never touches a node carrying this
+REMEDIATION_SKIP_LABEL = f"{GROUP}/remediation.skip"
+# the quarantine primitive: a NoSchedule taint + matching label applied
+# by cordon-drain, removed on recovery
+REPAIR_TAINT_KEY = f"{GROUP}/repair"
+REPAIR_LABEL = f"{GROUP}/repair"
+REPAIR_PENDING = "pending"
+
+REMEDIATION_STATE_OBSERVED = "observed"
+REMEDIATION_STATE_RESTART = "restart-operands"
+REMEDIATION_STATE_REVALIDATE = "revalidate"
+REMEDIATION_STATE_CORDON_DRAIN = "cordon-drain"
+REMEDIATION_STATE_QUARANTINED = "quarantined"
+REMEDIATION_STATE_RECOVERED = "recovered"
+REMEDIATION_STATE_EXHAUSTED = "exhausted"
+# states whose node is disrupted (cordoned/tainted) — these consume the
+# shared maxUnavailable disruption budget alongside upgrade-active and
+# upgrade-failed nodes (upgrade_state.slice_budget counts both sides)
+REMEDIATION_DISRUPTED_STATES = (
+    REMEDIATION_STATE_CORDON_DRAIN,
+    REMEDIATION_STATE_QUARANTINED,
+    REMEDIATION_STATE_EXHAUSTED,
+)
+
 # slice partitioning label FSM (reference nvidia.com/mig.config[.state])
 SLICE_CONFIG_LABEL = f"{GROUP}/tpu.slice.config"
 SLICE_CONFIG_STATE_LABEL = f"{GROUP}/tpu.slice.config.state"
@@ -129,6 +171,11 @@ DEVICE_PLUGIN_CONFIG_LABEL = f"{GROUP}/device-plugin.config"
 
 # upgrade FSM label (reference nvidia.com/gpu-driver-upgrade-state)
 UPGRADE_STATE_LABEL = f"{GROUP}/libtpu-upgrade-state"
+# bounded auto-retry of upgrade-failed nodes: {"count": N} JSON — a failed
+# node re-enters the FSM after a jittered exponential backoff instead of
+# permanently consuming maxUnavailable budget (clear UPGRADE_STATE_LABEL or
+# set UPGRADE_SKIP_LABEL to intervene by hand)
+UPGRADE_RETRY_ANNOTATION = f"{GROUP}/libtpu-upgrade-retries"
 # when the node entered its current FSM state (drives drain/validation
 # timeouts -> upgrade-failed)
 UPGRADE_STATE_SINCE_ANNOTATION = f"{GROUP}/libtpu-upgrade-state-since"

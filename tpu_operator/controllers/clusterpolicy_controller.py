@@ -59,6 +59,13 @@ class ClusterPolicyReconciler:
         self.ctrl = ClusterPolicyController(client, assets_dir=assets_dir)
         self.metrics = OperatorMetrics()
         self.ctrl.metrics = self.metrics
+        # node-health remediation FSM (runs inside the reconcile pass,
+        # after label_tpu_nodes has produced the pass's node list)
+        from tpu_operator.controllers.remediation import (
+            NodeRemediationController,
+        )
+
+        self.remediation = NodeRemediationController(client)
         # (Node, Pod) store versions of the last clean slice aggregation
         # — while both hold, the per-node slice grouping and readiness
         # math is a pure recomputation over an unchanged world, so the
@@ -148,6 +155,12 @@ class ClusterPolicyReconciler:
         if self.metrics and getattr(self.metrics, "states_errored", None):
             self.metrics.states_errored.set(len(errored_states))
 
+        # node-health remediation (its quarantine label writes move the
+        # Node store version, so the slice aggregate below never memoizes
+        # a pre-quarantine world; the labels themselves land in the next
+        # pass's node list — level-triggered, like every other writer)
+        remediation_summary = self._run_remediation()
+
         slice_summary = self._aggregate_slices()
 
         was_ready = (primary.get("status", {}) or {}).get("state") == State.READY
@@ -180,7 +193,10 @@ class ClusterPolicyReconciler:
                 + "; ".join(f"{n} ({e})" for n, e in errored_states),
             )
 
-        self._set_status(primary, overall, slice_summary, errored_states)
+        self._set_status(
+            primary, overall, slice_summary, errored_states,
+            remediation_summary,
+        )
         self._update_fleet_metrics()
         if errored_states:
             # the run is degraded even though it completed: report it
@@ -192,9 +208,64 @@ class ClusterPolicyReconciler:
             self.metrics.observe_reconcile(0)
             return Result(requeue_after=REQUEUE_NOT_READY_S)
         self.metrics.observe_reconcile(1)
+        if remediation_summary is not None and remediation_summary.active:
+            # unhealthy nodes mid-FSM: their escalation backoffs elapse
+            # without any cluster event to wake the reconciler, so the
+            # level-triggered requeue is the remediation clock
+            return Result(ready=True, requeue_after=REQUEUE_NOT_READY_S)
         return Result(ready=True)
 
     # ------------------------------------------------------------------
+    def _run_remediation(self):
+        """Node-health remediation pass (tentpole of the robustness
+        story): derives per-node health from the pass's in-hand node
+        list + one namespace pod listing, steps each unhealthy node's
+        FSM, and reports counts for status/metrics. Failure-isolated
+        like any state: a remediation exception must not abort the
+        reconcile."""
+        from tpu_operator.controllers.state_manager import has_tpu_labels
+
+        try:
+            tpu_nodes = [
+                n for n in (self.ctrl._nodes_cache or ()) if has_tpu_labels(n)
+            ]
+            summary = self.remediation.reconcile(
+                tpu_nodes, self.ctrl.cp.spec.remediation, self.ctrl.namespace
+            )
+        except Exception:
+            log.exception("node remediation pass failed")
+            # zero the gauges AND hand back an errored (all-zero) summary:
+            # freezing metrics or status at the LAST pass's picture (an
+            # open breaker, a quarantine count) while remediation is not
+            # actually running would keep alerts — and the CR — on stale
+            # data; errored=True keeps the 5s requeue retrying the pass
+            self._update_remediation_metrics(None)
+            from tpu_operator.controllers.remediation import (
+                RemediationSummary,
+            )
+
+            return RemediationSummary(errored=True)
+        self._update_remediation_metrics(summary)
+        return summary
+
+    def _update_remediation_metrics(self, summary) -> None:
+        m = self.metrics
+        if not m or not getattr(m, "remediation_nodes_unhealthy", None):
+            return
+        rc = self.remediation
+        if summary is None:
+            m.remediation_nodes_unhealthy.set(0)
+            m.remediation_nodes_quarantined.set(0)
+            m.remediation_nodes_exhausted.set(0)
+            m.remediation_breaker_open.set(0)
+        else:
+            m.remediation_nodes_unhealthy.set(summary.unhealthy)
+            m.remediation_nodes_quarantined.set(summary.quarantined)
+            m.remediation_nodes_exhausted.set(summary.exhausted)
+            m.remediation_breaker_open.set(1 if summary.breaker_open else 0)
+        m.remediation_drains_vetoed.set(rc.drains_vetoed_total)
+        m.remediation_attempts_total.set(rc.attempts_total)
+
     def _aggregate_slices(self):
         """Slice-scoped readiness (SURVEY.md §7 hard part): a multi-host
         pod-slice is only Ready when every member host validated. Publishes
@@ -339,11 +410,17 @@ class ClusterPolicyReconciler:
                 m.apiserver_breaker_trips.set(breaker["trips_total"])
 
     def _set_status(
-        self, cp_obj, state: str, slice_summary=None, errored=None
+        self,
+        cp_obj,
+        state: str,
+        slice_summary=None,
+        errored=None,
+        remediation_summary=None,
     ) -> None:
         """reference ``updateCRState`` (``:198``) + Ready and Degraded
-        conditions, the per-state error block, and the slice-readiness
-        aggregate (no reference analogue)."""
+        conditions, the per-state error block, the slice-readiness
+        aggregate, and the node-remediation counts (no reference
+        analogues)."""
         status = cp_obj.setdefault("status", {})
         slices = None
         if slice_summary is not None:
@@ -356,12 +433,28 @@ class ClusterPolicyReconciler:
         errored_block = [
             {"state": n, "error": e} for n, e in (errored or ())
         ]
+        breaker_open = bool(
+            remediation_summary is not None
+            and remediation_summary.breaker_open
+        )
+        # the effective block: present only while there is something to
+        # report (an all-healthy fleet keeps status clean, and the
+        # no-change comparison below must agree with what gets stored)
+        remediation_block = None
+        if remediation_summary is not None:
+            block = remediation_summary.status_block()
+            if any(block.values()):
+                remediation_block = block
         if (
             status.get("state") == state
             and status.get("namespace")
             == (self.ctrl.namespace or status.get("namespace"))
             and (slices is None or status.get("slices") == slices)
             and (status.get("erroredStates") or []) == errored_block
+            and (
+                remediation_summary is None
+                or status.get("remediation") == remediation_block
+            )
         ):
             return
         from datetime import datetime, timezone
@@ -377,6 +470,11 @@ class ClusterPolicyReconciler:
             status["erroredStates"] = errored_block
         else:
             status.pop("erroredStates", None)
+        if remediation_summary is not None:
+            if remediation_block is not None:
+                status["remediation"] = remediation_block
+            else:
+                status.pop("remediation", None)
 
         now = datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 
@@ -412,11 +510,33 @@ class ClusterPolicyReconciler:
             ),
             condition(
                 "Degraded",
-                "True" if errored_block else "False",
-                "StatesErrored" if errored_block else "AllStatesHealthy",
+                "True" if (errored_block or breaker_open) else "False",
+                # the systemic breaker outranks per-state errors: a
+                # fleet-wide node failure is the headline, not a busted
+                # asset dir
+                (
+                    "SystemicNodeFailure"
+                    if breaker_open
+                    else "StatesErrored"
+                    if errored_block
+                    else "AllStatesHealthy"
+                ),
                 message=(
                     "; ".join(
-                        f"{b['state']}: {b['error']}" for b in errored_block
+                        (
+                            [
+                                f"{remediation_summary.unhealthy} of "
+                                f"{remediation_summary.total} TPU nodes "
+                                f"unhealthy; remediation halted with zero "
+                                f"drains"
+                            ]
+                            if breaker_open
+                            else []
+                        )
+                        + [
+                            f"{b['state']}: {b['error']}"
+                            for b in errored_block
+                        ]
                     )
                     or None
                 ),

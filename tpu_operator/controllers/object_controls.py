@@ -536,6 +536,18 @@ def _apply_common_daemonset_config(n, ds: Obj) -> None:
         for tol in dspec.tolerations:
             if tol not in existing:
                 existing.append(tol)
+    # every operand tolerates the remediation quarantine taint: the FSM's
+    # revalidate/recover steps need the plugin + validator RUNNING on the
+    # tainted host to observe the chips coming back — quarantine fences
+    # workloads off the node, never the operator's own agents
+    repair_tol = {
+        "key": consts.REPAIR_TAINT_KEY,
+        "operator": "Exists",
+        "effect": "NoSchedule",
+    }
+    tolerations = pod_spec.setdefault("tolerations", [])
+    if repair_tol not in tolerations:
+        tolerations.append(repair_tol)
     if dspec.priority_class_name:
         pod_spec["priorityClassName"] = dspec.priority_class_name
     # updateStrategy override applies only to RollingUpdate-capable operands

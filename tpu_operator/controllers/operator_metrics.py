@@ -130,7 +130,44 @@ class OperatorMetrics:
         # a drain is stuck behind a budget and the upgrade cannot proceed
         self.evictions_blocked = c(
             "upgrade_evictions_blocked_total",
-            "Upgrade-drain evictions vetoed by a PodDisruptionBudget",
+            "Drain evictions vetoed by a PodDisruptionBudget across every "
+            "drain path (libtpu upgrades, host maintenance, node "
+            "remediation — all share PodManager.evict_pods)",
+        )
+        # node-health remediation FSM (controllers/remediation.py): the
+        # fleet-repair surface — how many hosts are unhealthy, how many
+        # the FSM holds quarantined/exhausted, drain vetoes, escalation
+        # attempts, and the systemic-failure breaker's disposition
+        self.remediation_nodes_unhealthy = g(
+            "remediation_nodes_unhealthy",
+            "TPU nodes derived unhealthy this pass (0-allocatable chips, "
+            "CrashLoopBackOff operands, or validator not Running)",
+        )
+        self.remediation_nodes_quarantined = g(
+            "remediation_nodes_quarantined",
+            "TPU nodes the remediation FSM holds cordoned + tainted "
+            "(cordon-drain or quarantined)",
+        )
+        self.remediation_nodes_exhausted = g(
+            "remediation_nodes_exhausted",
+            "TPU nodes that hit the remediation attempt cap (flapping) "
+            "and stay quarantined until a human intervenes",
+        )
+        self.remediation_drains_vetoed = g(
+            "remediation_drains_vetoed",
+            "Remediation-drain evictions vetoed by a PodDisruptionBudget "
+            "(each veto defers, never fails, the FSM step)",
+        )
+        self.remediation_breaker_open = g(
+            "remediation_breaker_open",
+            "1 while the systemic-failure breaker is open (>= "
+            "systemicThreshold of the fleet unhealthy: remediation "
+            "halted, zero drains)",
+        )
+        self.remediation_attempts_total = g(
+            "remediation_attempts_total",
+            "Escalation steps executed by the remediation FSM "
+            "(operand restarts + cordon-drains) since process start",
         )
         # informer health (client-go reflector resync analogue): nonzero
         # means a watch stream silently swallowed an event and the

@@ -55,6 +55,11 @@ class SliceInfo:
     # outage (multi-host jobs drain once, proactively — not when the
     # kubelet finally reports dead chips)
     maintenance_hosts: List[str] = field(default_factory=list)
+    # members the node-health remediation FSM holds cordoned + tainted
+    # (cordon-drain / quarantined / exhausted): named as the per-slice
+    # degradation reason — an exhausted flapping host can look healthy
+    # moment-to-moment yet must keep its slice out of service
+    quarantined_hosts: List[str] = field(default_factory=list)
 
     @property
     def ready(self) -> bool:
@@ -240,16 +245,26 @@ def aggregate(
                 cached[n].get("metadata", {}).get("labels", {}) or {}
             ).get(consts.MAINTENANCE_STATE_LABEL)
         )
+        info.quarantined_hosts = sorted(
+            n
+            for n in info.member_nodes
+            if (
+                cached[n].get("metadata", {}).get("labels", {}) or {}
+            ).get(consts.REMEDIATION_STATE_LABEL)
+            in consts.REMEDIATION_DISRUPTED_STATES
+        )
         # a member counts only when validated AND not advertising zero
         # allocatable chips (kubelet-derived health can sour a host long
         # after its validator initContainer chain passed) AND not inside
-        # a maintenance window (the chips are about to vanish)
+        # a maintenance window (the chips are about to vanish) AND not
+        # held by the remediation FSM (quarantined/exhausted)
         info.ready_nodes = sum(
             1
             for n in info.member_nodes
             if n in validated
             and n not in info.unhealthy_hosts
             and n not in info.maintenance_hosts
+            and n not in info.quarantined_hosts
         )
         verdict = "true" if info.ready else "false"
         was_ready = any(
@@ -302,7 +317,13 @@ def _record_degradation(client: Client, namespace: str, info: SliceInfo) -> None
         record_event,
     )
 
-    if info.maintenance_hosts:
+    if info.quarantined_hosts:
+        detail = (
+            f"host(s) {', '.join(info.quarantined_hosts)} are "
+            f"quarantined for repair "
+            f"({c.REPAIR_TAINT_KEY}={c.REPAIR_PENDING} taint)"
+        )
+    elif info.maintenance_hosts:
         detail = (
             f"host(s) {', '.join(info.maintenance_hosts)} are inside a "
             f"scheduled host-maintenance window"

@@ -128,6 +128,83 @@ def apply_label_delta(
     return changed
 
 
+def node_taints(node: Obj) -> List[Dict[str, Any]]:
+    """The node's taint list (possibly a shared frozen view — read-only)."""
+    return node.get("spec", {}).get("taints") or []
+
+
+def has_taint(node: Obj, key: str, value: Optional[str] = None) -> bool:
+    """Whether the node carries a taint with ``key`` (and ``value`` when
+    given) — the read half of the taint contract, shared by the
+    remediation FSM, the slice aggregate, and tests."""
+    for taint in node_taints(node):
+        if taint.get("key") != key:
+            continue
+        if value is None or taint.get("value") == value:
+            return True
+    return False
+
+
+def merge_taint(
+    taints: List[Dict[str, Any]], key: str, value: str, effect: str
+) -> bool:
+    """Strategic-merge one taint into ``taints`` in place, keyed on
+    ``(key, effect)`` like the apiserver's strategic merge patch for
+    ``spec.taints`` (patchMergeKey=key): an existing same-key+effect
+    entry is replaced, anything else appended. Returns whether the list
+    changed — the single merge definition every writer goes through."""
+    desired = {"key": key, "value": value, "effect": effect}
+    for i, taint in enumerate(taints):
+        if taint.get("key") == key and taint.get("effect") == effect:
+            if taint == desired:
+                return False
+            taints[i] = desired
+            return True
+    taints.append(desired)
+    return True
+
+
+def set_node_taint(
+    client: "Client",
+    node_name: str,
+    key: str,
+    value: str,
+    effect: str = "NoSchedule",
+) -> Obj:
+    """Apply (or update) one taint on a Node with the shared-Node
+    conflict-retry discipline. Works identically across every client
+    layer (FakeClient, kubesim-backed RestClient, CachedClient): the
+    merge is computed on a fresh read and re-applied on 409."""
+
+    def mutate(node: Obj) -> bool:
+        taints = node.setdefault("spec", {}).setdefault("taints", [])
+        return merge_taint(taints, key, value, effect)
+
+    return mutate_with_retry(client, "v1", "Node", node_name, mutate=mutate)
+
+
+def remove_node_taint(client: "Client", node_name: str, key: str) -> Obj:
+    """Remove every taint with ``key`` from a Node (conflict-retried);
+    no-op (no write) when the node doesn't carry it."""
+
+    def mutate(node: Obj) -> bool:
+        spec = node.get("spec") or {}
+        taints = spec.get("taints")
+        if not taints:
+            return False
+        kept = [t for t in taints if t.get("key") != key]
+        if len(kept) == len(taints):
+            return False
+        if kept:
+            spec["taints"] = kept
+        else:
+            # an empty taint list round-trips as absent, like kubectl
+            spec.pop("taints", None)
+        return True
+
+    return mutate_with_retry(client, "v1", "Node", node_name, mutate=mutate)
+
+
 def obj_key(obj: Obj) -> Tuple[str, str, str, str]:
     meta = obj.get("metadata", {})
     return (

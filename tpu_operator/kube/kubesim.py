@@ -183,6 +183,93 @@ class KubeSim:
                     return q.pop(0)
         return None
 
+    # -- node-level fault injection --------------------------------------
+    def _mutate_stored(self, plural: str, namespace: str, name: str, fn) -> dict:
+        """Mutate a stored object in place under the lock, stamp a fresh
+        resourceVersion and emit MODIFIED — the injection primitive the
+        node-fault helpers share. The watch stream carries the change,
+        so informer-backed operators see injected state like any kubelet
+        write."""
+        with self._lock:
+            key = self._key("", "v1", plural, namespace, name)
+            stored = self._objs.get(key)
+            if stored is None:
+                raise KeyError(f"{plural} {namespace}/{name} not found")
+            fn(stored)
+            stored["metadata"]["resourceVersion"] = self._bump()
+            self._emit("MODIFIED", key, stored)
+            return copy.deepcopy(stored)
+
+    def set_node_chips(self, name: str, allocatable: int, capacity: Optional[int] = None) -> dict:
+        """Write the node's ``google.com/tpu`` capacity/allocatable —
+        the kubelet's resource advertisement, injected."""
+
+        def fn(node):
+            status = node.setdefault("status", {})
+            status.setdefault("capacity", {})["google.com/tpu"] = str(
+                capacity if capacity is not None else max(allocatable, 0)
+            )
+            status.setdefault("allocatable", {})["google.com/tpu"] = str(
+                allocatable
+            )
+
+        return self._mutate_stored("nodes", "", name, fn)
+
+    def kill_node_chips(self, name: str) -> dict:
+        """Chip death: allocatable drops to 0 while capacity stays — the
+        exact shape a real kubelet reports when the device plugin marks
+        every chip Unhealthy (``slice_status.host_allocatable_ok`` reads
+        it as False)."""
+
+        def fn(node):
+            status = node.setdefault("status", {})
+            cap = status.setdefault("capacity", {})
+            if "google.com/tpu" not in cap:
+                cap["google.com/tpu"] = "8"
+            status.setdefault("allocatable", {})["google.com/tpu"] = "0"
+
+        return self._mutate_stored("nodes", "", name, fn)
+
+    def restore_node_chips(self, name: str, count: int = 8) -> dict:
+        """Chips pass probes again: allocatable returns to ``count``."""
+        return self.set_node_chips(name, count, capacity=count)
+
+    def flap_node_chips(self, name: str, count: int = 8) -> dict:
+        """One flap edge: kill if the node currently advertises chips,
+        restore otherwise — drives the flapping-host matrix row."""
+        with self._lock:
+            key = self._key("", "v1", "nodes", "", name)
+            stored = self._objs.get(key)
+            alive = stored is not None and (
+                stored.get("status", {}).get("allocatable", {}) or {}
+            ).get("google.com/tpu") not in (None, "0")
+        return (
+            self.kill_node_chips(name)
+            if alive
+            else self.restore_node_chips(name, count)
+        )
+
+    def crashloop_pod(self, namespace: str, name: str) -> dict:
+        """Force a (DaemonSet) pod into CrashLoopBackOff: phase Running
+        with a waiting container — the kubelet status shape the
+        remediator's health derivation keys on."""
+
+        def fn(pod):
+            pod["status"] = {
+                "phase": "Running",
+                "containerStatuses": [
+                    {
+                        "ready": False,
+                        "restartCount": 5,
+                        "state": {
+                            "waiting": {"reason": "CrashLoopBackOff"}
+                        },
+                    }
+                ],
+            }
+
+        return self._mutate_stored("pods", namespace, name, fn)
+
     def faults_pending(self) -> int:
         """Injected (queued) faults not yet consumed — the fault-matrix
         test asserts this drains to zero, proving every injection was

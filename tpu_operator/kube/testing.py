@@ -143,6 +143,36 @@ def simulate_kubelet_once(
             )
 
 
+def toleration_matches(tol: dict, taint: dict) -> bool:
+    """Whether one toleration tolerates one taint (k8s semantics: empty
+    key + Exists tolerates everything; effect empty matches any)."""
+    op = tol.get("operator", "Equal")
+    key = tol.get("key", "")
+    if key:
+        if key != taint.get("key"):
+            return False
+    elif op != "Exists":
+        return False  # empty key only legal with Exists
+    if op == "Equal" and tol.get("value", "") != taint.get("value", ""):
+        return False
+    effect = tol.get("effect", "")
+    return not effect or effect == taint.get("effect", "")
+
+
+def tolerates_node_taints(pod_spec: dict, node: Obj) -> bool:
+    """Scheduler predicate: every NoSchedule taint on the node must be
+    matched by some toleration on the pod spec — the half of taint
+    semantics pod placement needs (NoSchedule gates placement only; it
+    never evicts running pods, unlike NoExecute)."""
+    tolerations = pod_spec.get("tolerations") or []
+    for taint in node.get("spec", {}).get("taints") or []:
+        if taint.get("effect") != "NoSchedule":
+            continue
+        if not any(toleration_matches(t, taint) for t in tolerations):
+            return False
+    return True
+
+
 def simulate_kubelet_nodes(
     client: Client, namespace: str, node_names, halt_event=None
 ) -> None:
@@ -165,9 +195,12 @@ def simulate_kubelet_nodes(
     the real DS controller does — a per-generation libtpu DS only gets
     pods (and desired-counts) on nodes of its generation."""
     node_names = list(node_names)
+    node_objs = {
+        n["metadata"]["name"]: n for n in client.list("v1", "Node")
+    }
     node_labels = {
-        n["metadata"]["name"]: n["metadata"].get("labels", {}) or {}
-        for n in client.list("v1", "Node")
+        name: n["metadata"].get("labels", {}) or {}
+        for name, n in node_objs.items()
     }
     # DS-controller role first: delete operand pods bound to nodes that no
     # longer exist. A pod created in a race with its node's deletion
@@ -185,11 +218,18 @@ def simulate_kubelet_nodes(
         selector = (
             ds["spec"]["template"]["spec"].get("nodeSelector", {}) or {}
         )
+        # placement honors NoSchedule taints the way the real DS
+        # controller does: a node quarantined with the repair taint only
+        # gets pods from DaemonSets that tolerate it (operand templates
+        # do — revalidation needs the plugin + validator running there)
         matching = [
             name
             for name in node_names
             if name in node_labels
             and all(node_labels[name].get(k) == v for k, v in selector.items())
+            and tolerates_node_taints(
+                ds["spec"]["template"]["spec"], node_objs[name]
+            )
         ]
         _stamp_ds_status(client, ds, len(matching))
         on_delete = ds["spec"].get("updateStrategy", {}).get("type") == "OnDelete"

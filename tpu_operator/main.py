@@ -142,6 +142,9 @@ def build_manager(
     mgr.register_debug_vars(
         "render_cache", reconciler.ctrl.render_cache.stats
     )
+    # node-health remediation: last pass's verdicts + lifetime counters
+    # (attempts, PDB vetoes, budget deferrals, breaker opens)
+    mgr.register_debug_vars("remediation", reconciler.remediation.stats)
     upgrade = UpgradeReconciler(client, namespace)
     mgr.add_reconciler(UPGRADE_KEY, lambda _key: upgrade.reconcile())
     return mgr, reconciler, upgrade
@@ -152,8 +155,15 @@ def wire_event_sources(mgr, client, namespace: str, stop_event=None) -> None:
     controllers/clusterpolicy_controller.go:317-344). Shared by main()
     and the kubesim manager e2e so the tested path IS the shipped path."""
     node_cache = {}
+    # pods currently in CrashLoopBackOff (namespace/name): remediation's
+    # health derivation keys on this, and unlike chip death (a Node
+    # event) a crashloop is a POD event nothing else watches — the
+    # reconciler must wake on the transition, in either direction
+    crashlooping = set()
 
     def on_event(event, obj):
+        from tpu_operator.controllers.remediation import pod_crashlooping
+
         kind = obj.get("kind")
         if kind == "ClusterPolicy":
             mgr.enqueue(CP_KEY)
@@ -164,6 +174,20 @@ def wire_event_sources(mgr, client, namespace: str, stop_event=None) -> None:
             node_cache[name] = None if event == "DELETED" else obj
             if node_event_needs_reconcile(event, old, obj):
                 mgr.enqueue(CP_KEY)
+        elif kind == "Pod":
+            meta = obj.get("metadata", {})
+            # same tpu-* operand filter the remediator's health verdict
+            # applies: a user pod's crashloop is not a node-health signal
+            # and must not burn reconcile passes
+            app = (meta.get("labels") or {}).get("app") or ""
+            if not app.startswith("tpu-"):
+                return
+            key = f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+            was = key in crashlooping
+            now = event != "DELETED" and pod_crashlooping(obj)
+            (crashlooping.add if now else crashlooping.discard)(key)
+            if was != now:
+                mgr.enqueue(CP_KEY, delay=0.1)
         elif kind == "DaemonSet":
             # owned-operand drift (reference watch on owned DaemonSets)
             mgr.enqueue(CP_KEY, delay=0.1)
@@ -187,11 +211,15 @@ def wire_event_sources(mgr, client, namespace: str, stop_event=None) -> None:
         # fake client pushes events in-process
         client.add_watcher(on_event)
     elif hasattr(client, "watch"):
-        # real API server: one list+watch loop per watched kind
+        # real API server: one list+watch loop per watched kind. The
+        # operand Pod watch is namespace-scoped: the crashloop predicate
+        # above only cares about operand pods, and a cluster-wide pod
+        # stream would be pure overhead on this (non-cached) path
         for av, kind, ns in (
             (consts.API_VERSION, "ClusterPolicy", ""),
             ("v1", "Node", ""),
             ("apps/v1", "DaemonSet", namespace),
+            ("v1", "Pod", namespace),
         ):
             threading.Thread(
                 target=client.watch,

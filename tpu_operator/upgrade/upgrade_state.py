@@ -222,6 +222,7 @@ class NodeStateProvider:
             for key in (
                 consts.UPGRADE_STATE_SINCE_ANNOTATION,
                 consts.UPGRADE_INITIAL_STATE_ANNOTATION,
+                consts.UPGRADE_RETRY_ANNOTATION,
             ):
                 if key in ann:
                     del ann[key]
@@ -477,18 +478,47 @@ def parse_max_unavailable(value, total: int) -> int:
 # ceiling territory
 VALIDATION_TIMEOUT_S = 1800.0
 
+# upgrade-failed is no longer terminal-forever: a failed node permanently
+# consumed maxUnavailable budget and stalled sibling slices until a human
+# cleared the label. Bounded auto-retry instead: after a jittered
+# exponential backoff (base * 2^count, equal-jittered, capped) the node
+# re-enters upgrade-required, with the count recorded in
+# UPGRADE_RETRY_ANNOTATION; past FAILED_RETRY_MAX the node stays failed
+# (escape hatches: clear the state label, or set UPGRADE_SKIP_LABEL to
+# drop it from the FSM — and the budget — entirely).
+FAILED_RETRY_MAX = 3
+FAILED_RETRY_BASE_S = 300.0
+FAILED_RETRY_CAP_S = 3600.0
+
 
 @dataclass
 class SliceBudget:
     """The slice-unit admission arithmetic, computed ONCE and shared by
-    ``apply_state`` (what actually admits) and the controller's gauge
-    export (what reports) so the two cannot drift."""
+    ``apply_state`` (what actually admits), the node-health remediator
+    (``controllers/remediation.py`` — same disruption pool), and the
+    controller's gauge export (what reports) so the three cannot drift."""
 
     groups: Dict[str, List[NodeUpgradeState]]
     active_sids: set
     failed_sids: set
     pending_sids: set
     admit: int  # slices the budget would admit this pass
+    # slices disrupted by the node-health remediator (a member host in
+    # cordon-drain/quarantined/exhausted): upgrades and repairs share ONE
+    # maxUnavailable pool, so these consume upgrade admission too
+    repair_sids: set = field(default_factory=set)
+
+
+def remediation_disrupted(node: Obj) -> bool:
+    """Whether the node-health remediator currently holds this node
+    disrupted (cordoned/tainted) — the predicate both budget consumers
+    (upgrade admission here, remediation admission in
+    ``controllers/remediation.py``) share."""
+    labels = node.get("metadata", {}).get("labels", {}) or {}
+    return (
+        labels.get(consts.REMEDIATION_STATE_LABEL)
+        in consts.REMEDIATION_DISRUPTED_STATES
+    )
 
 
 def slice_budget(state: ClusterUpgradeState, policy) -> SliceBudget:
@@ -503,20 +533,32 @@ def slice_budget(state: ClusterUpgradeState, policy) -> SliceBudget:
         for sid, entries in groups.items()
         if any(e.state == STATE_FAILED for e in entries)
     }
+    repair = {
+        sid
+        for sid, entries in groups.items()
+        if any(remediation_disrupted(e.node) for e in entries)
+    }
+    # repair slices are excluded from PENDING too, not just subtracted
+    # from headroom: admitting a quarantined slice would cordon/drain a
+    # chips-dead host into a guaranteed validation failure, landing it
+    # upgrade-failed — which the remediator then defers to, freezing the
+    # quarantine until a human unpicks both FSMs
     pending = {
         sid
         for sid, entries in groups.items()
         if any(e.state == STATE_UPGRADE_REQUIRED for e in entries)
-    } - active - failed
+    } - active - failed - repair
     max_unavailable = parse_max_unavailable(policy.max_unavailable, len(groups))
     admit = max(
         0,
         min(
             (policy.max_parallel_upgrades or 1) - len(active),
-            max_unavailable - len(active | failed),
+            # upgrades + repairs draw on ONE pool: a slice quarantined by
+            # the remediator is just as unavailable as one mid-upgrade
+            max_unavailable - len(active | failed | repair),
         ),
     )
-    return SliceBudget(groups, active, failed, pending, admit)
+    return SliceBudget(groups, active, failed, pending, admit, repair)
 
 
 class ClusterUpgradeStateManager:
@@ -715,6 +757,11 @@ class ClusterUpgradeStateManager:
         budget = slice_budget(state, policy)
         groups = budget.groups
         active_sids = budget.active_sids
+
+        # failed nodes auto-retry on a bounded backoff (the budget this
+        # pass still counts them failed — conservatively; the next pass
+        # reclassifies a retried node as pending)
+        self._retry_failed_nodes(state)
 
         # late-arriving pending members of a slice already mid-roll JOIN
         # its batch (no extra budget: the slice is already disrupted)
@@ -1001,6 +1048,11 @@ class ClusterUpgradeStateManager:
         def uncordon_step(ns):
             self.cordon.uncordon(ns.node["metadata"]["name"])
             self.provider.set_state(ns.node, STATE_DONE)
+            # a completed upgrade resets the failed-retry budget: the
+            # next failure (possibly a different version) starts fresh
+            self.provider.set_annotation(
+                ns.node, consts.UPGRADE_RETRY_ANNOTATION, None
+            )
 
         # uncordon: the slice returns to the scheduler as one unit —
         # releasing host 1 while host 3 still validates would advertise
@@ -1057,6 +1109,77 @@ class ClusterUpgradeStateManager:
                     sid,
                 )
 
+    def _retry_failed_nodes(self, state: ClusterUpgradeState) -> None:
+        """Bounded auto-retry of ``upgrade-failed`` nodes. Before this, a
+        failed node was terminal: it consumed maxUnavailable budget
+        forever (``slice_budget`` subtracts failed slices from admission)
+        and starved every pending sibling slice until a human cleared the
+        label. Now a failed node re-enters ``upgrade-required`` after an
+        equal-jittered exponential backoff, at most ``FAILED_RETRY_MAX``
+        times (count persisted in ``UPGRADE_RETRY_ANNOTATION`` so restarts
+        don't reset it); ``UPGRADE_SKIP_LABEL`` drops the node from the
+        FSM — and the budget — immediately."""
+        import json
+        import random
+
+        for ns in state.node_states.get(STATE_FAILED, []):
+            node = ns.node
+            name = node["metadata"]["name"]
+            labels = node["metadata"].get("labels", {}) or {}
+            if labels.get(consts.UPGRADE_SKIP_LABEL) == "true":
+                # explicit escape hatch: leave the FSM entirely — the
+                # slice stops consuming budget NOW; the node stays
+                # cordoned for the operator to inspect
+                def skip_step(ns):
+                    self.provider.set_annotation(
+                        ns.node, consts.UPGRADE_RETRY_ANNOTATION, None
+                    )
+                    self.provider.clear_state(ns.node)
+
+                if self._node_step(ns, skip_step):
+                    log.warning(
+                        "node %s: upgrade-failed + skip label — dropping "
+                        "from the FSM (budget released; node left "
+                        "cordoned)",
+                        name,
+                    )
+                continue
+            raw = (node["metadata"].get("annotations", {}) or {}).get(
+                consts.UPGRADE_RETRY_ANNOTATION, ""
+            )
+            try:
+                count = int(json.loads(raw).get("count", 0)) if raw else 0
+            except (ValueError, AttributeError, TypeError):
+                count = 0
+            if count >= FAILED_RETRY_MAX:
+                continue  # retries exhausted: human intervention only
+            delay = min(FAILED_RETRY_CAP_S, FAILED_RETRY_BASE_S * (2**count))
+            # equal jitter via per-pass sampling: age grows monotonically,
+            # the sampled threshold floats in [delay/2, delay] — a fleet
+            # of failed nodes desynchronizes instead of retrying in step
+            if self.provider.state_age_s(node) < random.uniform(
+                delay / 2, delay
+            ):
+                continue
+
+            def retry_step(ns, count=count):
+                self.provider.set_annotation(
+                    ns.node,
+                    consts.UPGRADE_RETRY_ANNOTATION,
+                    json.dumps({"count": count + 1, "lastRetryAt": _now_iso()}),
+                )
+                self.provider.set_state(ns.node, STATE_UPGRADE_REQUIRED)
+
+            if self._node_step(ns, retry_step):
+                log.warning(
+                    "node %s: retrying failed libtpu upgrade "
+                    "(attempt %d of %d after %.0fs backoff)",
+                    name,
+                    count + 1,
+                    FAILED_RETRY_MAX,
+                    delay,
+                )
+
     def _record_slice_event(
         self, event_type: str, reason: str, message: str, slice_id: str
     ) -> None:
@@ -1097,6 +1220,9 @@ class ClusterUpgradeStateManager:
             try:
                 self.provider.set_annotation(
                     node, consts.UPGRADE_INITIAL_STATE_ANNOTATION, None
+                )
+                self.provider.set_annotation(
+                    node, consts.UPGRADE_RETRY_ANNOTATION, None
                 )
             except Exception:
                 # node is Done and still cordoned, so a lingering annotation

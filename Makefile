@@ -27,7 +27,8 @@ DOCKER_PUSH_TARGETS = $(patsubst %,docker-push-%,$(IMAGES))
 # declared AFTER the target lists exist: a .PHONY on an undefined
 # variable expands to nothing and silently un-phonies the fan-out
 .PHONY: all native test crd bundle release-bundle validate lint clean \
-	dev-run dev-run-kubesim soak bench bench-gate chaos-fast builder docker-build \
+	dev-run dev-run-kubesim soak bench bench-gate bench-converge chaos-fast \
+	builder docker-build \
 	docker-push $(DOCKER_BUILD_TARGETS) $(DOCKER_PUSH_TARGETS)
 
 all: native crd bundle
@@ -62,6 +63,7 @@ validate:
 	python -m tpu_operator.cfg.main validate chart --dir deployments/tpu-operator
 	python -m tpu_operator.cfg.main validate csv --input bundle/manifests/tpu-operator.clusterserviceversion.yaml
 	python -m tpu_operator.cfg.main validate bundle --dir bundle
+	$(MAKE) bench-converge
 
 # per-image build/push fan-out; `make docker-build DIST=multi-arch
 # PUSH_ON_BUILD=true` is the release pipeline
@@ -85,6 +87,12 @@ bench:
 # reconcile pass (read path + render cache) must hold its ceiling
 bench-gate:
 	python -m pytest tests/test_reconcile_pass_bench.py -q -m slow -p no:cacheprovider
+
+# CI converge gate: 1000-node fleet time-to-Ready, min-of-rounds, under
+# a ceiling seeded from the pre-write-pipeline baseline (167.5s on the
+# bench box) — trips when the convergence write path re-serializes
+bench-converge:
+	python -m pytest tests/test_converge_bench.py -q -m slow -p no:cacheprovider
 
 # CI fault gate: the deterministic fault matrix (injected 429/500/503/
 # latency on every write verb, a full partition window, a raising state)

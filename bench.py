@@ -818,6 +818,13 @@ def main() -> int:
     if gated_pass_ms is None:
         gated_pass_ms = fleet_1000.get("reconcile_pass_ms")
     pass_gate_ok = fleet_pass_gate_ok(gated_pass_ms)
+    # the concurrent-write-pipeline axis (ISSUE 5): time_to_ready_s and
+    # converge_wall_per_write_us ride in the fleet harness payload;
+    # record the pre-pipeline baseline next to them so the round-over-
+    # round comparison reads without digging through git history
+    # (pre-PR main: 142.1 s best-of-rounds on a quiet box, ~6 ms serial
+    # wall/write; the pipeline A/B measured 34.1 s, 4.2x)
+    fleet_1000["time_to_ready_s_pre_pipeline_baseline"] = 142.1
     fleet_1000["reconcile_pass_ms_ceiling"] = FLEET_1000_PASS_MS_CEILING
     fleet_1000["reconcile_pass_ms_old_baseline"] = (
         FLEET_1000_PASS_MS_OLD_BASELINE

@@ -28,6 +28,52 @@ def test_event_dedup():
     assert len(c.list("v1", "Event", NS)) == 2
 
 
+def test_event_correlator_coalesces_identical_reposts(monkeypatch):
+    """ISSUE 5 satellite: an identical (reason, message) re-posted on
+    consecutive passes must NOT re-write the Event each time — the
+    correlator coalesces in process (zero apiserver requests inside the
+    window) and folds the accumulated count into the next write-through,
+    so the store still ends at one Event object with a truthful count."""
+    from tpu_operator.kube import events as events_mod
+
+    c = FakeClient()
+    obj = {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n1"}}
+    record_event(c, NS, obj, "Warning", "NotReady", "same story")
+    rv_after_first = c.list("v1", "Event", NS)[0]["metadata"][
+        "resourceVersion"
+    ]
+    # two identical re-posts inside the window: coalesced locally —
+    # the stored Event does not move at all
+    record_event(c, NS, obj, "Warning", "NotReady", "same story")
+    record_event(c, NS, obj, "Warning", "NotReady", "same story")
+    events = c.list("v1", "Event", NS)
+    assert len(events) == 1
+    assert events[0]["metadata"]["resourceVersion"] == rv_after_first, (
+        "an identical re-post inside the window must cost zero writes"
+    )
+    assert events[0]["count"] == 1
+    # window elapses: the next record flushes ONE write carrying the
+    # coalesced repeats — one Event object, count covers all four posts
+    monkeypatch.setattr(events_mod, "EVENT_REFRESH_INTERVAL_S", 0.0)
+    record_event(c, NS, obj, "Warning", "NotReady", "same story")
+    events = c.list("v1", "Event", NS)
+    assert len(events) == 1
+    assert events[0]["count"] == 4
+
+
+def test_event_correlator_message_change_writes_through_immediately():
+    """A CHANGED message must never be held back by the correlator —
+    the degradation story the operator tells has moved."""
+    c = FakeClient()
+    obj = {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n1"}}
+    record_event(c, NS, obj, "Warning", "NotReady", "3 states pending")
+    record_event(c, NS, obj, "Warning", "NotReady", "1 state pending")
+    events = c.list("v1", "Event", NS)
+    assert len(events) == 1
+    assert events[0]["message"] == "1 state pending"
+    assert events[0]["count"] == 2
+
+
 def test_reconcile_emits_events_and_conditions(monkeypatch):
     monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
     client = FakeClient(

@@ -227,7 +227,7 @@ def test_watch_survives_410_compaction(cluster):
     # create 'gap' and compact ATOMICALLY (the watcher can't drain while
     # we hold the sim lock): its event is destroyed before delivery, so
     # the watcher's cursor is strictly behind min_event_rv -> 410
-    with server.sim._cond:
+    with server.sim._lock:
         code, _ = server.sim.create(
             "", "v1", "configmaps", NS,
             {"apiVersion": "v1", "kind": "ConfigMap",

@@ -150,12 +150,20 @@ def test_crash_recovery_from_label(env):
     assert consts.MAINTENANCE_STATE_LABEL not in n["metadata"]["labels"]
 
 
-def test_restart_mid_window_reenters_idempotently(env):
+def test_restart_mid_window_reenters_idempotently(env, monkeypatch):
     """A fresh handler that starts while the window is still open re-runs
     entry idempotently: the cordon/label no-op, the eviction sweep clears
     stragglers a crashed predecessor left (the label proves the cordon
     happened, NOT that eviction completed), the pre-cordon annotation is
-    preserved, and the Warning Event dedups instead of duplicating."""
+    preserved, and the Warning Event dedups instead of duplicating.
+
+    The correlator window is pinned to 0 so every record reaches the
+    store — this test is about re-entry deduping to ONE Event object
+    (count bump), not about in-process write coalescing (covered in
+    test_events_and_status.py)."""
+    from tpu_operator.kube import events as events_mod
+
+    monkeypatch.setattr(events_mod, "EVENT_REFRESH_INTERVAL_S", 0.0)
     client, handler, feed = env
     feed["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
     handler.reconcile_once()

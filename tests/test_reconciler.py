@@ -183,14 +183,16 @@ def test_step_exception_is_isolated_and_records_failure_metric(
         r.metrics, "observe_reconcile", lambda v: recorded.append(v)
     )
 
-    real_step = r.ctrl.step
+    real_run = r.ctrl.run_state
 
-    def boom():
-        if r.ctrl.state_names[r.ctrl.idx] == "state-metricsd":
+    def boom(state):
+        # run_state is the per-state entry point both step() and the
+        # DAG-wave executor (run_states) go through
+        if state == "state-metricsd":
             raise RuntimeError("control exploded")
-        return real_step()
+        return real_run(state)
 
-    monkeypatch.setattr(r.ctrl, "step", boom)
+    monkeypatch.setattr(r.ctrl, "run_state", boom)
     res = r.reconcile()  # must NOT raise
     assert res.requeue_after is not None
     assert recorded[-1] == -1
@@ -216,7 +218,7 @@ def test_step_exception_is_isolated_and_records_failure_metric(
 
     # the fault cleared: the next pass drops the Degraded condition and
     # the erroredStates block
-    monkeypatch.setattr(r.ctrl, "step", real_step)
+    monkeypatch.setattr(r.ctrl, "run_state", real_run)
     r.reconcile()
     cr = client.get(consts.API_VERSION, "ClusterPolicy", "cluster-policy")
     assert "erroredStates" not in cr["status"]

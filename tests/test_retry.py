@@ -146,3 +146,116 @@ def test_clients_share_the_policy_surface():
     assert cached.retry_policy is fake.retry_policy
     assert cached.breaker is fake.breaker
     assert cached.fault_stats() == fake.fault_stats()
+
+
+# ---------------------------------------------------------------------------
+# thread-safety under the write pipeline (ISSUE 5 satellite): the breaker
+# and retry counters are now shared by up to WRITE_PIPELINE_DEPTH
+# concurrent workers — hammer them and assert the bookkeeping is exact
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_hammered_from_many_threads_trips_exactly_once():
+    """N threads each record a burst of failures at the same instant: the
+    breaker must trip EXACTLY once (one cooldown window, trips_total 1) —
+    an unlocked implementation double-trips and double-doubles the
+    cooldown. A success after the cooldown resets everything exactly
+    once, too."""
+    import threading
+    import time as _time
+
+    breaker = CircuitBreaker(threshold=5, cooldown_base_s=0.2)
+    n_threads = 8
+    barrier = threading.Barrier(n_threads, timeout=10)
+
+    def hammer():
+        barrier.wait()
+        for _ in range(50):
+            breaker.record_failure()
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    stats = breaker.stats()
+    # 400 concurrent failures, one trip: every failure landing inside the
+    # open window is a straggler, not a new trip
+    assert stats["trips_total"] == 1, stats
+    assert stats["state"] == "open"
+    # cooldown is the base window, not doubled by racing trippers
+    assert 0.0 < stats["open_for_s"] <= 0.2 + 0.01
+    _time.sleep(0.25)
+    assert breaker.allow() is True  # cooldown lapsed (half-open)
+    breaker.record_success()
+    assert breaker.stats()["state"] == "closed"
+    assert breaker.stats()["consecutive_failures"] == 0
+
+
+def test_breaker_allow_and_failure_race_counts_are_consistent():
+    """Concurrent allow()/record_failure()/record_success() must keep the
+    counters internally consistent (no lost fast-fail counts, no negative
+    or wildly inflated trip totals)."""
+    import threading
+
+    breaker = CircuitBreaker(threshold=3, cooldown_base_s=60.0)
+    stop = threading.Event()
+    denied = []
+
+    def spin_allow():
+        count = 0
+        while not stop.is_set():
+            if not breaker.allow():
+                count += 1
+        denied.append(count)
+
+    readers = [threading.Thread(target=spin_allow) for _ in range(4)]
+    for t in readers:
+        t.start()
+    for _ in range(3):
+        breaker.record_failure()  # trips: a 60s window, every allow denied
+    import time as _time
+
+    _time.sleep(0.05)
+    stop.set()
+    for t in readers:
+        t.join(timeout=10)
+    stats = breaker.stats()
+    assert stats["trips_total"] == 1
+    # every denial the reader threads observed is accounted for
+    assert stats["fast_fails_total"] == sum(denied)
+
+
+def test_retry_policy_counters_hammered_from_many_threads_are_exact():
+    """count_retry/count_giveup from N threads: totals must equal the
+    exact number of calls (the per-verb map included) — lost updates
+    here would silently understate retry pressure on the metrics
+    surface."""
+    import threading
+
+    policy = RetryPolicy()
+    n_threads, per_thread = 8, 200
+    barrier = threading.Barrier(n_threads, timeout=10)
+
+    def hammer(tid):
+        barrier.wait()
+        for i in range(per_thread):
+            policy.count_retry(
+                "PATCH" if i % 2 else "PUT",
+                honored_retry_after=(i % 4 == 0),
+            )
+        policy.count_giveup()
+
+    threads = [
+        threading.Thread(target=hammer, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    stats = policy.stats()
+    assert stats["retries_total"] == n_threads * per_thread
+    assert stats["giveups_total"] == n_threads
+    assert stats["retries_by_verb"]["PUT"] == n_threads * per_thread // 2
+    assert stats["retries_by_verb"]["PATCH"] == n_threads * per_thread // 2
+    assert stats["retry_after_honored"] == n_threads * (per_thread // 4)

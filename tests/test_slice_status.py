@@ -108,16 +108,34 @@ def test_slice_ready_only_when_all_hosts_validated():
     assert summary.degraded == ["pool-a"]
     for n in nodes:
         node = client.get("v1", "Node", n["metadata"]["name"])
-        assert node["metadata"]["labels"][consts.SLICE_READY_LABEL] == "false"
+        # a never-labeled node is not-ready by ABSENCE: writing "false"
+        # onto a whole converging fleet would double the label write
+        # volume for zero information (the workload gate selects on
+        # "true", so absence already refuses scheduling)
+        assert (
+            consts.SLICE_READY_LABEL not in node["metadata"]["labels"]
+        )
 
     # last host comes up -> whole slice flips ready
     client.delete("v1", "Pod", "val-n3", NS)
     validator_pod(client, "n3", ready=True)
     summary = slice_status.aggregate(client, NS, nodes)
     assert summary.ready == 1 and summary.degraded == []
+    fresh = [
+        client.get("v1", "Node", n["metadata"]["name"]) for n in nodes
+    ]
+    for node in fresh:
+        assert node["metadata"]["labels"][consts.SLICE_READY_LABEL] == "true"
+
+    # a REAL true→false flip still writes through (consumers must see
+    # an actually-degraded slice, not a stale "true")
+    client.delete("v1", "Pod", "val-n3", NS)
+    validator_pod(client, "n3", ready=False)
+    summary = slice_status.aggregate(client, NS, fresh)
+    assert summary.ready == 0
     for n in nodes:
         node = client.get("v1", "Node", n["metadata"]["name"])
-        assert node["metadata"]["labels"][consts.SLICE_READY_LABEL] == "true"
+        assert node["metadata"]["labels"][consts.SLICE_READY_LABEL] == "false"
 
 
 def test_missing_member_hosts_keep_slice_not_ready():

@@ -414,3 +414,85 @@ def test_missing_namespace_env_raises(monkeypatch):
     c = ClusterPolicyController(client, assets_dir=ASSETS)
     with pytest.raises(RuntimeError, match="OPERATOR_NAMESPACE"):
         c.init(client.get("tpu.k8s.io/v1", "ClusterPolicy", "cluster-policy"))
+
+
+# ---------------------------------------------------------------------------
+# state DAG (ISSUE 5): explicit ordering table + topological waves
+# ---------------------------------------------------------------------------
+
+
+def test_state_dag_waves_cover_every_state_exactly_once():
+    from tpu_operator.controllers.state_manager import (
+        STATE_DAG,
+        STATE_ORDER,
+        state_waves,
+    )
+
+    waves = state_waves(STATE_ORDER)
+    flat = [s for wave in waves for s in wave]
+    assert sorted(flat) == sorted(STATE_ORDER)
+    # pre-requisites strictly first, alone (everything depends on it)
+    assert waves[0] == ["pre-requisites"]
+    # every edge is honored: a state's wave comes after its deps' waves
+    wave_of = {s: i for i, wave in enumerate(waves) for s in wave}
+    for state, deps in STATE_DAG.items():
+        for dep in deps:
+            assert wave_of[dep] < wave_of[state], (state, dep)
+    # the sandbox chain keeps its conservative strict order
+    sandbox = [
+        "state-vm-manager",
+        "state-vm-device-manager",
+        "state-sandbox-validation",
+        "state-vfio-manager",
+        "state-sandbox-device-plugin",
+        "state-kata-manager",
+    ]
+    for earlier, later in zip(sandbox, sandbox[1:]):
+        assert wave_of[earlier] < wave_of[later]
+    # the container-workload operand states genuinely parallelized
+    # (the wave after pre-requisites holds more than one state)
+    assert len(waves[1]) > 1
+
+
+def test_state_waves_subset_preserves_order():
+    """A restricted state list (tests drive subsets) still yields a
+    valid schedule: absent dependencies are ignored, present ones
+    honored."""
+    from tpu_operator.controllers.state_manager import state_waves
+
+    waves = state_waves(["pre-requisites", "state-libtpu", "state-vm-manager"])
+    flat = [s for wave in waves for s in wave]
+    assert sorted(flat) == [
+        "pre-requisites",
+        "state-libtpu",
+        "state-vm-manager",
+    ]
+    wave_of = {s: i for i, wave in enumerate(waves) for s in wave}
+    # the present edge (libtpu → pre-requisites) is honored; vm-manager's
+    # dependency is absent from the subset, so it schedules freely
+    assert wave_of["pre-requisites"] < wave_of["state-libtpu"]
+
+
+def test_run_states_outcomes_in_state_order_and_isolated(ctrl, monkeypatch):
+    """run_states returns (state, outcome) in STATE_ORDER order; one
+    raising state is returned as its exception while its wave-mates
+    still deploy."""
+    client = ctrl.client
+    real = ctrl.run_state
+
+    def boom(state):
+        if state == "state-metricsd":
+            raise RuntimeError("busted asset")
+        return real(state)
+
+    monkeypatch.setattr(ctrl, "run_state", boom)
+    results = ctrl.run_states()
+    assert [s for s, _ in results] == ctrl.state_names
+    outcomes = dict(results)
+    assert isinstance(outcomes["state-metricsd"], RuntimeError)
+    # a wave-mate of the errored state still ran its controls: the TFD
+    # DaemonSet exists
+    assert client.get_or_none(
+        "apps/v1", "DaemonSet", "tpu-feature-discovery", NS
+    ) is not None
+    assert ctrl.last()

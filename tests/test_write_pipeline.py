@@ -1,0 +1,158 @@
+"""WritePipeline unit + property tests.
+
+The acceptance-critical property: writes to the SAME key can never
+apply out of order, at any pipeline depth — two revisions of one object
+submitted in order land in order, while independent keys genuinely
+overlap. Plus the drain barrier, error aggregation (preserving the
+submitted call's exception type), and the depth=1 serial escape hatch.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_operator.kube.write_pipeline import (
+    PipelineError,
+    WritePipeline,
+)
+
+
+def test_per_key_ordering_property_at_every_depth():
+    """Out-of-order apply of two revisions of one object is impossible:
+    for each of 32 keys, 20 'revisions' are submitted in order while the
+    pipeline runs at several depths; every key's observed sequence must
+    equal its submission sequence exactly."""
+    for depth in (2, 4, 16, 64):
+        pipe = WritePipeline(depth=depth)
+        applied = {}  # key -> [revision...]
+        lock = threading.Lock()
+
+        def apply(key, rev):
+            # jitter the task duration so later submissions would
+            # OVERTAKE earlier ones if ordering relied on timing
+            time.sleep(0.0003 * ((rev * 7 + key) % 5))
+            with lock:
+                applied.setdefault(key, []).append(rev)
+
+        for rev in range(20):
+            for key in range(32):
+                pipe.submit(("Node", "", f"n{key}"), apply, key, rev)
+        assert pipe.drain(timeout=60) == []
+        for key in range(32):
+            assert applied[key] == list(range(20)), (
+                f"depth={depth}: key {key} applied out of order"
+            )
+
+
+def test_independent_keys_actually_overlap():
+    """Two different keys must run concurrently — the whole point. Each
+    task parks on a barrier only the OTHER task can release."""
+    pipe = WritePipeline(depth=4)
+    barrier = threading.Barrier(2, timeout=10)
+
+    def task():
+        barrier.wait()  # deadlocks unless both run at once
+        return "ok"
+
+    f1 = pipe.submit(("Node", "", "a"), task)
+    f2 = pipe.submit(("Node", "", "b"), task)
+    assert f1.result(timeout=10) == "ok"
+    assert f2.result(timeout=10) == "ok"
+    assert pipe.stats()["inflight_peak"] >= 2
+
+
+def test_same_key_never_overlaps():
+    """Same-key tasks are strictly serialized: the in-flight count for
+    one key can never exceed 1."""
+    pipe = WritePipeline(depth=8)
+    active = []
+    lock = threading.Lock()
+    overlap = []
+
+    def task(i):
+        with lock:
+            active.append(i)
+            if len(active) > 1:
+                overlap.append(tuple(active))
+        time.sleep(0.002)
+        with lock:
+            active.remove(i)
+
+    for i in range(25):
+        pipe.submit(("Node", "", "same"), task, i)
+    pipe.drain(timeout=30)
+    assert overlap == []
+
+
+def test_future_result_reraises_the_original_exception():
+    pipe = WritePipeline(depth=4)
+
+    def boom():
+        raise ConnectionResetError("socket died")
+
+    fut = pipe.submit("k", boom)
+    with pytest.raises(ConnectionResetError, match="socket died"):
+        fut.result(timeout=10)
+    # the error is ALSO aggregated for the drain barrier
+    errors = pipe.drain()
+    assert len(errors) == 1 and isinstance(errors[0], ConnectionResetError)
+    # ...and cleared by it
+    assert pipe.drain() == []
+
+
+def test_drain_raise_errors_wraps_as_pipeline_error():
+    pipe = WritePipeline(depth=4)
+    pipe.submit("a", lambda: 1)
+    pipe.submit("b", lambda: (_ for _ in ()).throw(ValueError("bad")))
+    with pytest.raises(PipelineError) as exc:
+        pipe.drain(timeout=10, raise_errors=True)
+    assert isinstance(exc.value.errors[0], ValueError)
+    assert isinstance(exc.value.__cause__, ValueError)
+
+
+def test_depth_one_runs_inline_with_no_threads():
+    before = threading.active_count()
+    pipe = WritePipeline(depth=1)
+    order = []
+    for i in range(5):
+        pipe.submit("k", order.append, i)
+    assert order == [0, 1, 2, 3, 4]
+    assert pipe.drain() == []
+    assert threading.active_count() == before
+    assert pipe.stats()["inline_total"] == 5
+
+
+def test_drain_is_a_barrier():
+    """drain() must not return while any task is queued or running."""
+    pipe = WritePipeline(depth=2)
+    done = []
+
+    def slow(i):
+        time.sleep(0.05)
+        done.append(i)
+
+    for i in range(6):
+        pipe.submit(f"k{i % 3}", slow, i)
+    pipe.drain(timeout=30)
+    assert len(done) == 6
+
+
+def test_stats_shape():
+    pipe = WritePipeline(depth=3)
+    pipe.submit("a", lambda: None)
+    pipe.drain(timeout=10)
+    stats = pipe.stats()
+    for field in (
+        "depth",
+        "inflight",
+        "queue_wait_ms_avg",
+        "errors_total",
+        "submitted_total",
+        "completed_total",
+    ):
+        assert field in stats
+    assert stats["depth"] == 3
+    assert stats["submitted_total"] == stats["completed_total"] == 1
+    assert stats["inflight"] == 0
+    assert 0.0 <= pipe.utilization(wall_s=1.0) <= 1.0

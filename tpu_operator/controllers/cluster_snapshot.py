@@ -32,6 +32,7 @@ node list explicitly via ``set_nodes``.
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Dict, List, Optional, Tuple, Union
 
 from tpu_operator.kube.client import Client, Obj
@@ -39,9 +40,13 @@ from tpu_operator.kube.frozen import FrozenList
 
 
 class ClusterSnapshot:
-    """Pass-scoped read memo. NOT thread-safe — one reconcile pass runs
-    on one worker (the manager serializes per key), matching its
-    lifetime exactly.
+    """Pass-scoped read memo. Thread-safe: one reconcile pass still owns
+    one snapshot (the manager serializes per key), but the write
+    pipeline now runs a wave's state controls CONCURRENTLY within that
+    pass, and they all share these memos — an RLock guards every
+    fill-or-serve (held across the fill: informer reads are
+    milliseconds, and double-computing a memo under contention would
+    double-count the miss).
 
     ``namespace`` may be a callable: the snapshot is created at pass
     start, BEFORE ``init()`` resolves the operator namespace on the very
@@ -50,6 +55,7 @@ class ClusterSnapshot:
     def __init__(
         self, client: Client, namespace: Union[str, Callable[[], str]]
     ):
+        self._lock = threading.RLock()
         self._client = client
         self._namespace_src = namespace
         self._nodes: Optional[List[Obj]] = None
@@ -89,11 +95,12 @@ class ClusterSnapshot:
 
     def nodes(self) -> List[Obj]:
         """The pass's Node list (shared frozen views; do not mutate)."""
-        if self._nodes is None:
-            self.misses += 1
-        else:
-            self.hits += 1
-        return self._node_list()
+        with self._lock:
+            if self._nodes is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+            return self._node_list()
 
     def set_nodes(self, nodes: List[Obj]) -> None:
         """Refresh the memoized node list after a writer changed node
@@ -104,8 +111,9 @@ class ClusterSnapshot:
         version: the writes that motivated the refresh moved the store
         past it, so version-keyed memos correctly refuse to form this
         pass."""
-        self._nodes = FrozenList(nodes)
-        self._selector_counts.clear()
+        with self._lock:
+            self._nodes = FrozenList(nodes)
+            self._selector_counts.clear()
 
     def count_nodes_matching(self, selector: Dict[str, str]) -> int:
         """How many nodes carry every ``k == v`` of ``selector`` (the
@@ -113,35 +121,37 @@ class ClusterSnapshot:
         18 states re-asking about the same handful of deploy-label
         selectors share one scan each."""
         key = tuple(sorted(selector.items()))
-        cached = self._selector_counts.get(key)
-        if cached is not None:
-            self.hits += 1
-            return cached
-        self.misses += 1
-        count = 0
-        for node in self._node_list():
-            labels = node.get("metadata", {}).get("labels", {}) or {}
-            if all(labels.get(k) == v for k, v in selector.items()):
-                count += 1
-        self._selector_counts[key] = count
-        return count
+        with self._lock:
+            cached = self._selector_counts.get(key)
+            if cached is not None:
+                self.hits += 1
+                return cached
+            self.misses += 1
+            count = 0
+            for node in self._node_list():
+                labels = node.get("metadata", {}).get("labels", {}) or {}
+                if all(labels.get(k) == v for k, v in selector.items()):
+                    count += 1
+            self._selector_counts[key] = count
+            return count
 
     # -- pods ------------------------------------------------------------
     def pods_by_app(self, app: str) -> List[Obj]:
         """Operator-namespace pods labeled ``app=<app>`` (shared frozen
         views). One indexed informer read per app per pass."""
-        cached = self._pods_by_app.get(app)
-        if cached is not None:
-            self.hits += 1
-            return cached
-        self.misses += 1
-        pods = FrozenList(
-            self._client.list(
-                "v1", "Pod", self._namespace, label_selector={"app": app}
+        with self._lock:
+            cached = self._pods_by_app.get(app)
+            if cached is not None:
+                self.hits += 1
+                return cached
+            self.misses += 1
+            pods = FrozenList(
+                self._client.list(
+                    "v1", "Pod", self._namespace, label_selector={"app": app}
+                )
             )
-        )
-        self._pods_by_app[app] = pods
-        return pods
+            self._pods_by_app[app] = pods
+            return pods
 
     # -- daemonsets ------------------------------------------------------
     def daemonsets(self) -> List[Obj]:
@@ -152,23 +162,25 @@ class ClusterSnapshot:
         refreshed after in-pass creates/deletes: the sweeps carry their
         own ``keep`` sets, and ``delete_if_exists`` probes the cache, so
         a pass-start view stays correct."""
-        if self._daemonsets is None:
-            self.misses += 1
-            self._daemonsets = FrozenList(
-                self._client.list("apps/v1", "DaemonSet", self._namespace)
-            )
-        else:
-            self.hits += 1
-        return self._daemonsets
+        with self._lock:
+            if self._daemonsets is None:
+                self.misses += 1
+                self._daemonsets = FrozenList(
+                    self._client.list("apps/v1", "DaemonSet", self._namespace)
+                )
+            else:
+                self.hits += 1
+            return self._daemonsets
 
     # -- observability ---------------------------------------------------
     def stats(self) -> Dict[str, float]:
-        total = self.hits + self.misses
-        return {
-            "hits": self.hits,
-            "misses": self.misses,
-            "hit_rate": round(self.hits / total, 4) if total else 0.0,
-            "selectors_memoized": len(self._selector_counts),
-            "apps_memoized": len(self._pods_by_app),
-            "daemonsets_memoized": 1 if self._daemonsets is not None else 0,
-        }
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hits / total, 4) if total else 0.0,
+                "selectors_memoized": len(self._selector_counts),
+                "apps_memoized": len(self._pods_by_app),
+                "daemonsets_memoized": 1 if self._daemonsets is not None else 0,
+            }

@@ -122,28 +122,31 @@ class ClusterPolicyReconciler:
         overall = State.READY
         not_ready_states = []
         errored_states = []  # (state, "ExcType: message") — this pass
-        self.ctrl.idx = 0
-        while not self.ctrl.last():
-            state_name = self.ctrl.state_names[self.ctrl.idx]
-            try:
-                status = self.ctrl.step()
-            except Exception as e:  # noqa: BLE001
-                # per-state error isolation: one state's exception (a
-                # busted asset, a write that exhausted its retries) must
-                # not abort the remaining INDEPENDENT states — the
-                # reference reports reconciliation_status per run rather
-                # than losing the whole pass. step() advances idx only on
-                # success, so move past the errored state ourselves.
-                log.exception(
-                    "state %s failed; isolating and continuing", state_name
+        # DAG-pipelined deployment: states with no ordering edge deploy
+        # concurrently; outcomes come back in STATE_ORDER order.
+        # Per-state error isolation is preserved: one state's exception
+        # (a busted asset, a write that exhausted its retries) never
+        # aborts the INDEPENDENT states — the reference reports
+        # reconciliation_status per run rather than losing the whole
+        # pass. A pass starting from Ready is a zero-write steady pass:
+        # it runs the waves sequentially (see run_states).
+        steady = (primary.get("status", {}) or {}).get("state") == State.READY
+        for state_name, outcome in self.ctrl.run_states(
+            concurrent=not steady
+        ):
+            if isinstance(outcome, BaseException):
+                log.error(
+                    "state %s failed; isolating and continuing",
+                    state_name,
+                    exc_info=outcome,
                 )
-                self.ctrl.idx += 1
                 overall = State.NOT_READY
                 errored_states.append(
-                    (state_name, f"{type(e).__name__}: {e}")
+                    (state_name, f"{type(outcome).__name__}: {outcome}")
                 )
                 self.metrics.set_state(state_name, -2)
                 continue
+            status = outcome
             self.metrics.set_state(
                 state_name,
                 {State.READY: 1, State.NOT_READY: 0}.get(status, -1),
@@ -154,6 +157,11 @@ class ClusterPolicyReconciler:
                 log.info("state %s not ready; will requeue", state_name)
         if self.metrics and getattr(self.metrics, "states_errored", None):
             self.metrics.states_errored.set(len(errored_states))
+        # flush barrier: nothing of this pass's write fan-out may
+        # outlive the pass (remediation/slice aggregation below read the
+        # world the states just wrote). Errors already surfaced through
+        # the per-state futures; drain only collects stragglers.
+        self.ctrl.writes.drain()
 
         # node-health remediation (its quarantine label writes move the
         # Node store version, so the slice aggregate below never memoizes
@@ -293,7 +301,10 @@ class ClusterPolicyReconciler:
                     if has_tpu_labels(n)
                 ]
                 summary = slice_status.aggregate(
-                    self.client, self.ctrl.namespace, tpu_nodes
+                    self.client,
+                    self.ctrl.namespace,
+                    tpu_nodes,
+                    pipeline=self.ctrl.writes,
                 )
             except Exception:
                 log.exception("slice readiness aggregation failed")
@@ -394,6 +405,12 @@ class ClusterPolicyReconciler:
             self._render_ms_states = current
             for state, ms in render["render_ms_by_state"].items():
                 m.state_render_ms.labels(state=state).set(ms)
+        if getattr(m, "write_pipeline_depth", None):
+            ws = self.ctrl.writes.stats()
+            m.write_pipeline_depth.set(ws["depth"])
+            m.write_pipeline_inflight.set(ws["inflight"])
+            m.write_pipeline_queue_wait_ms.set(ws["queue_wait_ms_avg"])
+            m.write_pipeline_errors_total.set(ws["errors_total"])
         if getattr(m, "apiserver_retries", None) and hasattr(
             self.client, "fault_stats"
         ):

@@ -237,6 +237,28 @@ class OperatorMetrics:
             "fingerprint invalidation (ms)",
             ("state",),
         )
+        # concurrent write pipeline (kube/write_pipeline.py): the
+        # convergence fan-out's disposition — configured depth, live
+        # in-flight writes, how long tasks wait for a worker, and task
+        # failures (each also surfaced to its submitter)
+        self.write_pipeline_depth = g(
+            "write_pipeline_depth",
+            "Configured write-pipeline concurrency (WRITE_PIPELINE_DEPTH; "
+            "1 = serial escape hatch)",
+        )
+        self.write_pipeline_inflight = g(
+            "write_pipeline_inflight",
+            "Write-pipeline tasks currently executing",
+        )
+        self.write_pipeline_queue_wait_ms = g(
+            "write_pipeline_queue_wait_ms",
+            "Average queue wait before a pipeline worker picked a write up",
+        )
+        self.write_pipeline_errors_total = g(
+            "write_pipeline_errors",
+            "Write-pipeline tasks that raised (after the client's own "
+            "retry/breaker policy gave up)",
+        )
         # apiserver fault-tolerance surface (kube/retry.py): gauges fed
         # from the client's own counters each pass — retry pressure and
         # the global circuit breaker's disposition

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import threading
 from typing import Any, Dict, Iterable, Optional, Tuple
 
 Obj = Dict[str, Any]
@@ -81,11 +82,16 @@ def render_fingerprint(
 class RenderCache:
     """Fingerprint-gated memo of rendered-and-hashed manifests.
 
-    NOT thread-safe — it lives on the ``ClusterPolicyController`` whose
-    passes the manager serializes (MaxConcurrentReconciles=1), exactly
-    like the per-pass ``ClusterSnapshot``."""
+    Thread-safe: the manager still serializes passes
+    (MaxConcurrentReconciles=1), but within a pass the write pipeline
+    runs a wave's state controls concurrently and they all look up /
+    store here — a lock guards the entry dict and the counters
+    (``begin_pass`` stays single-threaded by construction, but takes
+    the lock anyway so a racing /debug/vars scrape reads a consistent
+    picture)."""
 
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self._base_fp: Optional[str] = None
         self._generations: Tuple[str, ...] = ()
         #: full fingerprint (base + sorted generations) — the /debug/vars
@@ -109,6 +115,10 @@ class RenderCache:
         generation-set change drops exactly the vanished generations'
         fan-out entries."""
         gens = tuple(sorted(generations))
+        with self._lock:
+            self._begin_pass_locked(base_fp, gens)
+
+    def _begin_pass_locked(self, base_fp: str, gens: Tuple[str, ...]) -> None:
         if self._base_fp is not None and base_fp != self._base_fp:
             self._entries.clear()
             self._render_s_by_state.clear()
@@ -131,14 +141,15 @@ class RenderCache:
     def lookup(self, key: Key) -> Optional[Tuple[Obj, str]]:
         """The memoized (frozen manifest, content hash) for ``key``, or
         None on a miss (the caller renders and ``store``s)."""
-        ent = self._entries.get(key)
-        if ent is None:
-            self.pass_misses += 1
-            self.misses_total += 1
-            return None
-        self.pass_hits += 1
-        self.hits_total += 1
-        return ent[0], ent[1]
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                self.pass_misses += 1
+                self.misses_total += 1
+                return None
+            self.pass_hits += 1
+            self.hits_total += 1
+            return ent[0], ent[1]
 
     def store(
         self,
@@ -149,15 +160,17 @@ class RenderCache:
         render_s: float,
         generation: Optional[str] = None,
     ) -> None:
-        self._entries[key] = (frozen_obj, content_hash, generation)
-        self._render_s_by_state[state_name] = (
-            self._render_s_by_state.get(state_name, 0.0) + render_s
-        )
-        self.renders_total += 1
+        with self._lock:
+            self._entries[key] = (frozen_obj, content_hash, generation)
+            self._render_s_by_state[state_name] = (
+                self._render_s_by_state.get(state_name, 0.0) + render_s
+            )
+            self.renders_total += 1
 
     # ------------------------------------------------------------------
     def __len__(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     def stats(self) -> Dict[str, Any]:
         """Debug-surface / metrics payload: current fingerprint, entry
@@ -166,26 +179,32 @@ class RenderCache:
         reconcile thread mutates the cache — snapshot the dicts before
         iterating (a racing scrape may read a mid-pass value, but must
         never trip 'dict changed size during iteration')."""
-        render_s_by_state = dict(self._render_s_by_state)
-        total = self.hits_total + self.misses_total
-        pass_total = self.pass_hits + self.pass_misses
-        return {
-            "fingerprint": self.fingerprint,
-            "entries": len(self._entries),
-            "last_pass": {
-                "hits": self.pass_hits,
-                "misses": self.pass_misses,
-                "hit_rate": (
-                    round(self.pass_hits / pass_total, 4) if pass_total else 0.0
+        with self._lock:
+            render_s_by_state = dict(self._render_s_by_state)
+            total = self.hits_total + self.misses_total
+            pass_total = self.pass_hits + self.pass_misses
+            entries = len(self._entries)
+            return {
+                "fingerprint": self.fingerprint,
+                "entries": entries,
+                "last_pass": {
+                    "hits": self.pass_hits,
+                    "misses": self.pass_misses,
+                    "hit_rate": (
+                        round(self.pass_hits / pass_total, 4)
+                        if pass_total
+                        else 0.0
+                    ),
+                },
+                "hits_total": self.hits_total,
+                "misses_total": self.misses_total,
+                "hit_rate_total": (
+                    round(self.hits_total / total, 4) if total else 0.0
                 ),
-            },
-            "hits_total": self.hits_total,
-            "misses_total": self.misses_total,
-            "hit_rate_total": round(self.hits_total / total, 4) if total else 0.0,
-            "renders_total": self.renders_total,
-            "invalidations": self.invalidations,
-            "render_ms_by_state": {
-                state: round(sec * 1000.0, 3)
-                for state, sec in sorted(render_s_by_state.items())
-            },
-        }
+                "renders_total": self.renders_total,
+                "invalidations": self.invalidations,
+                "render_ms_by_state": {
+                    state: round(sec * 1000.0, 3)
+                    for state, sec in sorted(render_s_by_state.items())
+                },
+            }

@@ -46,6 +46,7 @@ from tpu_operator.kube.client import (
     mutate_with_retry,
 )
 from tpu_operator.kube.frozen import thaw
+from tpu_operator.kube.write_pipeline import WritePipeline
 
 log = logging.getLogger("tpu-operator.state")
 
@@ -86,6 +87,74 @@ SANDBOX_STATES: Set[str] = {
     "state-sandbox-device-plugin",
     "state-kata-manager",
 }
+
+# ---------------------------------------------------------------------------
+# state ordering DAG
+# ---------------------------------------------------------------------------
+# Each state's self-contained assets (its own ServiceAccount/RBAC/operand)
+# make the container-workload operand states mutually independent: at the
+# cluster level everything is level-triggered and hash-idempotent, so the
+# ONLY hard edge is that pre-requisites (RuntimeClass, PSP) land first.
+# Those states deploy concurrently through the write pipeline. The
+# sandbox chain keeps its strict order (vfio unbind / device handoff on a
+# real host is genuinely sequenced). A state absent from the independent
+# set falls back to the CONSERVATIVE default: it depends on its
+# predecessor in STATE_ORDER — i.e. exactly the pre-pipeline behavior.
+_PARALLEL_AFTER_PREREQS: Set[str] = {
+    "state-operator-metrics",
+    "state-libtpu",
+    "state-runtime",
+    "state-operator-validation",
+    "state-device-plugin",
+    "state-metricsd",
+    "state-metrics-exporter",
+    "tpu-feature-discovery",
+    "state-slice-manager",
+    "state-node-status-exporter",
+    "state-maintenance-handler",
+}
+
+
+def _build_state_dag() -> Dict[str, Tuple[str, ...]]:
+    dag: Dict[str, Tuple[str, ...]] = {}
+    for i, state in enumerate(STATE_ORDER):
+        if i == 0:
+            dag[state] = ()
+        elif state in _PARALLEL_AFTER_PREREQS:
+            dag[state] = (STATE_ORDER[0],)
+        else:
+            dag[state] = (STATE_ORDER[i - 1],)
+    return dag
+
+
+# state -> states that must COMPLETE before it starts (explicit table;
+# see _build_state_dag for the conservative-default rule)
+STATE_DAG: Dict[str, Tuple[str, ...]] = _build_state_dag()
+
+
+def state_waves(state_names: List[str]) -> List[List[str]]:
+    """Topological levels of ``STATE_DAG`` restricted to
+    ``state_names``: states in one wave have no ordering edge between
+    them and may deploy concurrently; wave N+1 starts only after wave N
+    fully completed (the drain barrier). Order inside a wave follows
+    STATE_ORDER, so the serialized fallback (every wave a singleton)
+    reproduces the historical sequence exactly."""
+    present = set(state_names)
+    level: Dict[str, int] = {}
+
+    def lvl(state: str) -> int:
+        got = level.get(state)
+        if got is not None:
+            return got
+        deps = [d for d in STATE_DAG.get(state, ()) if d in present]
+        got = 1 + max((lvl(d) for d in deps), default=-1)
+        level[state] = got
+        return got
+
+    waves: Dict[int, List[str]] = {}
+    for state in state_names:
+        waves.setdefault(lvl(state), []).append(state)
+    return [waves[i] for i in sorted(waves)]
 
 # component -> deploy-label key, built once: the per-node label delta
 # runs over every node every pass, and re-concatenating ~14 label keys
@@ -204,6 +273,15 @@ class ClusterPolicyController:
         self.snapshot_hits_total = 0
         self.snapshot_misses_total = 0
         self.last_snapshot_stats: Dict[str, float] = {}
+        # bounded-concurrency write pipeline (kube/write_pipeline.py):
+        # the label fan-out and every control's apply ride it; per-key
+        # ordering keeps same-object writes serialized while independent
+        # objects overlap. WRITE_PIPELINE_DEPTH=1 restores fully serial
+        # execution.
+        self.writes = WritePipeline(name="reconcile-writes")
+        # state runners for DAG waves (lazily built; only spun up when a
+        # wave actually holds more than one state)
+        self._state_pool = None
 
     # ------------------------------------------------------------------
     # pass lifecycle (controller-runtime gets this locality implicitly:
@@ -214,6 +292,17 @@ class ClusterPolicyController:
         return self.snapshot
 
     def end_pass(self) -> Dict[str, float]:
+        # drain runs on EVERY pass exit, including exception paths (the
+        # reconciler calls end_pass from a finally): a pass that died
+        # mid-fan-out (a label patch exhausting its retries) must not
+        # leave stragglers writing into the next pass's snapshot. Errors
+        # already surfaced through the per-future handlers; this only
+        # clears the aggregate so a dead pass's errors don't leak into
+        # the next pass's drain.
+        try:
+            self.writes.drain()
+        except Exception:
+            log.exception("write pipeline drain failed at pass end")
         snap, self.snapshot = self.snapshot, None
         if snap is None:
             return {}
@@ -346,16 +435,15 @@ class ClusterPolicyController:
             return
         self._label_world = None
         self._nodes_cache_version = version
-        wrote = False
         self.has_tpu_nodes = False
         self.has_nfd_labels = False
         self.tpu_generations = set()
         self.tpu_node_count = 0
-        # read SHARED frozen views; a node is thawed (copied) only when
-        # its labels actually need a write — the steady state labels
-        # nothing and copies nothing
-        final_nodes: List[Obj] = []
-        for node in nodes:
+        # phase 1 — pure scan over SHARED frozen views: cluster facts +
+        # the per-node label delta; nothing is copied or written yet
+        results: List[Optional[Obj]] = [None] * len(nodes)
+        to_write: List[Tuple[int, Obj, Dict[str, Optional[str]]]] = []
+        for i, node in enumerate(nodes):
             labels = node["metadata"].get("labels") or {}
             if any(k.startswith("feature.node.kubernetes.io/") for k in labels):
                 self.has_nfd_labels = True
@@ -367,38 +455,36 @@ class ClusterPolicyController:
                     self.tpu_generations.add(gen)
             changes = self._node_label_changes(node)
             if changes:
-                # Node labels are the shared bus: TFD, the slice manager,
-                # the maintenance handler, the upgrade FSM — and humans
-                # pausing components — all write concurrently. The write
-                # is a labels-only merge patch (delta payload, not the
-                # whole Node with its kubelet status + image list),
-                # CONDITIONED on the rv this delta was computed from: a
-                # concurrent write 409s, and the retry recomputes the
-                # delta from the fresh node instead of blindly
-                # re-applying a stale decision (an rv-less patch would
-                # silently revert a human's just-written "paused-*"
-                # override).
-                name = node["metadata"]["name"]
-                wrote = True
-                try:
-                    node = self.client.patch_labels(
-                        "v1",
-                        "Node",
-                        name,
-                        labels=changes,
-                        resource_version=node["metadata"].get(
-                            "resourceVersion"
-                        ),
-                    )
-                except ConflictError:
-                    node = self._relabel_fresh(name, node, changes)
-                    if node is None:
-                        continue
-                except NotFoundError:
-                    log.info("node %s vanished during labeling", name)
-                    continue
-            final_nodes.append(node)
-        self._nodes_cache = final_nodes
+                to_write.append((i, node, changes))
+            else:
+                results[i] = node
+        wrote = bool(to_write)
+        # phase 2 — the write fan-out: N independent nodes patch
+        # concurrently through the pipeline (keyed per node, so the
+        # conflict-recompute path for one node can never reorder against
+        # its own patch), instead of N serial RTTs. A single write (the
+        # common steady-state repair) runs inline.
+        if len(to_write) == 1:
+            i, node, changes = to_write[0]
+            results[i] = self._label_one_node(node, changes)
+        elif to_write:
+            futs = [
+                (
+                    i,
+                    self.writes.submit(
+                        ("Node", "", node["metadata"]["name"]),
+                        self._label_one_node,
+                        node,
+                        changes,
+                    ),
+                )
+                for i, node, changes in to_write
+            ]
+            for i, fut in futs:
+                results[i] = fut.result()
+        self._nodes_cache = final_nodes = [
+            n for n in results if n is not None
+        ]
         if self.has_tpu_nodes:
             # next no-TPU stretch (nodes drained away) logs the skips
             # again — once per transition, not once per process
@@ -412,6 +498,35 @@ class ClusterPolicyController:
             # valid until the node store moves again. A pass that wrote
             # is never memoized — its own write-throughs moved the store
             self._label_world = world
+
+    def _label_one_node(
+        self, node: Obj, changes: Dict[str, Optional[str]]
+    ) -> Optional[Obj]:
+        """Write one node's label delta (pipeline task body). Node
+        labels are the shared bus: TFD, the slice manager, the
+        maintenance handler, the upgrade FSM — and humans pausing
+        components — all write concurrently. The write is a labels-only
+        merge patch (delta payload, not the whole Node with its kubelet
+        status + image list), CONDITIONED on the rv this delta was
+        computed from: a concurrent write 409s, and the retry recomputes
+        the delta from the fresh node instead of blindly re-applying a
+        stale decision (an rv-less patch would silently revert a human's
+        just-written "paused-*" override). Returns the node to carry
+        forward, or None when it vanished."""
+        name = node["metadata"]["name"]
+        try:
+            return self.client.patch_labels(
+                "v1",
+                "Node",
+                name,
+                labels=changes,
+                resource_version=node["metadata"].get("resourceVersion"),
+            )
+        except ConflictError:
+            return self._relabel_fresh(name, node, changes)
+        except NotFoundError:
+            log.info("node %s vanished during labeling", name)
+            return None
 
     def _relabel_fresh(
         self,
@@ -630,15 +745,91 @@ class ClusterPolicyController:
     def step(self) -> str:
         """Run all controls of the current state; aggregate readiness
         (reference ``step``, ``controllers/state_manager.go:933-951``)."""
-        state = self.state_names[self.idx]
+        status = self.run_state(self.state_names[self.idx])
+        self.idx += 1
+        return status
+
+    def run_state(self, state: str) -> str:
+        """Execute one state's controls in asset order (ServiceAccount →
+        RBAC → operand) and aggregate readiness. One state's applies are
+        few and hash-gated (at steady state each control is a single
+        cached read), so they run inline on the state's worker; the
+        WIDE concurrency comes from ``run_states`` running independent
+        STATES of one DAG wave in parallel, and from the true N-wide
+        fan-outs (node labels, slice labels) riding the write pipeline
+        per object — a per-control thread handoff here would cost more
+        than the steady-state control does."""
         overall = State.READY
         for control_name, obj in self.controls[state]:
             fn = object_controls.CONTROLS[control_name]
             status = fn(self, state, obj)
             if status == State.NOT_READY:
                 overall = State.NOT_READY
-        self.idx += 1
         return overall
+
+    def run_states(self, concurrent: Optional[bool] = None):
+        """Execute ALL states honoring ``STATE_DAG``: states of one
+        topological wave run concurrently (their applies overlapping on
+        the write pipeline's workers), with a barrier between waves.
+        Per-state outcomes — a ``State`` value or the exception the
+        state raised — come back in ``STATE_ORDER`` order so
+        status/Events/metrics stay deterministic. A raising state never
+        aborts its wave (the reconciler's error-isolation contract);
+        ``idx`` is parked at the end so ``last()`` holds.
+
+        ``concurrent=False`` runs every wave's states sequentially on
+        the calling thread. The reconciler passes this on steady
+        (already-Ready) passes: a converged pass issues ZERO writes, so
+        fanning its pure cached reads across threads would buy nothing
+        and pay scheduler latency per state — the 50 ms steady-pass
+        bench gate rides on that. Converging passes (anything not yet
+        Ready) keep the wave parallelism, which is where the writes
+        are. ``WRITE_PIPELINE_DEPTH=1`` forces sequential always."""
+        results: Dict[str, object] = {}
+        if concurrent is None:
+            concurrent = True
+
+        def run_catching(state: str) -> object:
+            try:
+                return self.run_state(state)
+            except Exception as e:  # noqa: BLE001 - isolated per state
+                return e
+
+        for wave in state_waves(self.state_names):
+            if (
+                len(wave) == 1
+                or not concurrent
+                or self.writes.depth == 1
+            ):
+                for state in wave:
+                    results[state] = run_catching(state)
+                continue
+            pool = self._ensure_state_pool()
+            for state, fut in [
+                (s, pool.submit(run_catching, s)) for s in wave
+            ]:
+                results[state] = fut.result()
+        self.idx = len(self.state_names)
+        return [(s, results[s]) for s in self.state_names]
+
+    def _ensure_state_pool(self):
+        """Lazily-built executor for wave-mate states. Sized to the
+        widest possible wave; its threads mostly BLOCK on pipeline
+        futures, so the real I/O concurrency cap stays the pipeline
+        depth."""
+        if self._state_pool is None:
+            import weakref
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._state_pool = ThreadPoolExecutor(
+                max_workers=max(2, len(_PARALLEL_AFTER_PREREQS)),
+                thread_name_prefix="state-wave",
+            )
+            weakref.finalize(
+                self,
+                lambda ex=self._state_pool: ex.shutdown(wait=False),
+            )
+        return self._state_pool
 
     def last(self) -> bool:
         return self.idx == len(self.state_names)

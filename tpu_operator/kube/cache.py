@@ -362,11 +362,19 @@ class Informer:
         with self._lock:
             have = self._store.get(key)
             # monotonicity guard: a watch event older than what a
-            # write-through already stored must not roll the cache back
+            # write-through already stored must not roll the cache back.
+            # An EQUAL rv is the same revision — re-storing it would be
+            # a no-op except that it bumps store_version, and a watch
+            # window re-list (ADDED for every object, unchanged rvs)
+            # would then invalidate every version-keyed memo fleet-wide
+            # (the 1000-node label/slice scans) once per window.
             if have is not None:
                 old_rv, new_rv = _rv_int(have), _rv_int(obj)
-                if old_rv is not None and new_rv is not None and new_rv < old_rv:
-                    return
+                if old_rv is not None and new_rv is not None:
+                    if new_rv < old_rv:
+                        return
+                    if new_rv == old_rv and etype != "DELETED":
+                        return
             if etype == "DELETED":
                 self._del_locked(key)
                 now = _monotonic()
@@ -861,6 +869,15 @@ class CachedClient(Client):
     @property
     def breaker(self):
         return getattr(self.live, "breaker", None)
+
+    def fault_stats(self):
+        """Delegate to the wrapped client: RestClient's version carries
+        extra transport detail (the keep-alive connection pool) the base
+        retry/breaker surface doesn't know about."""
+        fn = getattr(self.live, "fault_stats", None)
+        if callable(fn):
+            return fn()
+        return super().fault_stats()
 
     def _informer_for(
         self, api_version: str, kind: str, namespace: str

@@ -42,6 +42,7 @@ import re
 import threading
 import time
 import uuid
+from bisect import bisect_right
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -66,12 +67,23 @@ class KubeSim:
 
     def __init__(self, compact_keep: int = 512, bookmark_interval_s: float = 5.0):
         self._lock = threading.RLock()
-        self._cond = threading.Condition(self._lock)
+        # one condition per plural, all sharing the store lock: a write
+        # wakes only the streams watching THAT plural — with ~18 informer
+        # streams attached, notify_all amplified every one of a pod
+        # storm's writes into 18 wakeups (17 of them spurious), and the
+        # wake churn was a measurable slice of fleet convergence
+        self._conds: Dict[str, threading.Condition] = {}
         self._rv = 0
         # (group, version, plural, namespace, name) -> object
         self._objs: Dict[Tuple[str, str, str, str, str], dict] = {}
-        # bounded event log for watches: (rv, etype, key, object-copy)
+        # bounded event log for watches: (rv, etype, key, object-copy),
+        # rv strictly ascending; _event_rvs mirrors the rv column so a
+        # watcher wake can bisect straight to its cursor instead of
+        # re-scanning the whole log — with W watch streams each waking
+        # on every write, the linear scan was O(W × log) CPU per write
+        # and the single hottest path of the convergence bench
         self._events: List[Tuple[int, str, Tuple, dict]] = []
+        self._event_rvs: List[int] = []
         self._min_event_rv = 0  # oldest rv still replayable
         self.compact_keep = compact_keep
         self.bookmark_interval_s = bookmark_interval_s
@@ -185,20 +197,24 @@ class KubeSim:
 
     # -- node-level fault injection --------------------------------------
     def _mutate_stored(self, plural: str, namespace: str, name: str, fn) -> dict:
-        """Mutate a stored object in place under the lock, stamp a fresh
-        resourceVersion and emit MODIFIED — the injection primitive the
-        node-fault helpers share. The watch stream carries the change,
-        so informer-backed operators see injected state like any kubelet
-        write."""
+        """Copy-on-write mutation under the lock: the stored object is
+        REPLACED, never mutated in place (the store-wide invariant the
+        zero-copy LIST serialization leans on), then a fresh
+        resourceVersion is stamped and MODIFIED emitted — the injection
+        primitive the node-fault helpers share. The watch stream carries
+        the change, so informer-backed operators see injected state like
+        any kubelet write."""
         with self._lock:
             key = self._key("", "v1", plural, namespace, name)
             stored = self._objs.get(key)
             if stored is None:
                 raise KeyError(f"{plural} {namespace}/{name} not found")
-            fn(stored)
-            stored["metadata"]["resourceVersion"] = self._bump()
-            self._emit("MODIFIED", key, stored)
-            return copy.deepcopy(stored)
+            fresh = copy.deepcopy(stored)
+            fn(fresh)
+            fresh["metadata"]["resourceVersion"] = self._bump()
+            self._objs[key] = fresh
+            self._emit("MODIFIED", key, fresh)
+            return copy.deepcopy(fresh)
 
     def set_node_chips(self, name: str, allocatable: int, capacity: Optional[int] = None) -> dict:
         """Write the node's ``google.com/tpu`` capacity/allocatable —
@@ -299,13 +315,27 @@ class KubeSim:
         _, namespaced = PLURAL_TABLE[plural]
         return (group, version, plural, namespace if namespaced else "", name)
 
+    def _cond_for(self, plural: str) -> threading.Condition:
+        """The plural's watch condition (caller holds the lock)."""
+        cond = self._conds.get(plural)
+        if cond is None:
+            cond = self._conds[plural] = threading.Condition(self._lock)
+        return cond
+
     def _emit(self, etype: str, key, obj: dict) -> None:
-        self._events.append((self._rv, etype, key, copy.deepcopy(obj)))
+        # the log holds REFERENCES: every write path replaces stored
+        # objects instead of mutating them (copy-on-write invariant), so
+        # a logged revision can never change after the fact — the
+        # per-write deepcopy this replaces was a measurable slice of the
+        # fleet-convergence bench
+        self._events.append((self._rv, etype, key, obj))
+        self._event_rvs.append(self._rv)
         if len(self._events) > self.compact_keep:
             drop = len(self._events) - self.compact_keep
             self._min_event_rv = self._events[drop - 1][0]
             del self._events[:drop]
-        self._cond.notify_all()
+            del self._event_rvs[:drop]
+        self._cond_for(key[2]).notify_all()
 
     def expire_events(self) -> int:
         """Drop Events untouched for ``event_ttl_s`` (the apiserver's
@@ -335,6 +365,7 @@ class KubeSim:
             if self._events:
                 self._min_event_rv = self._events[-1][0]
                 self._events.clear()
+                self._event_rvs.clear()
 
     # -- CR schema admission ---------------------------------------------
     def _register_crd(self, crd: dict) -> None:
@@ -406,7 +437,10 @@ class KubeSim:
             if plural == "events":
                 self._event_touch[key] = time.monotonic()
             self._emit("ADDED", key, self._objs[key])
-            return 201, copy.deepcopy(self._objs[key])
+            # a store REFERENCE: the HTTP handler serializes it, and the
+            # copy-on-write invariant keeps it immutable — callers must
+            # copy before mutating
+            return 201, self._objs[key]
 
     def update(self, group, version, plural, namespace, name, body: dict, status_only=False):
         kind, _ = PLURAL_TABLE[plural]
@@ -440,7 +474,7 @@ class KubeSim:
                 if plural == "events":
                     self._event_touch[key] = time.monotonic()
                 self._emit("MODIFIED", key, self._objs[key])
-                return 200, copy.deepcopy(self._objs[key])
+                return 200, self._objs[key]  # reference (see create)
             if kind in STATUS_SUBRESOURCE_KINDS:
                 # a main-resource PUT cannot change status
                 if "status" in stored:
@@ -478,7 +512,7 @@ class KubeSim:
         if plural == "events":
             self._event_touch[key] = time.monotonic()
         self._emit("MODIFIED", key, self._objs[key])
-        return 200, copy.deepcopy(self._objs[key])
+        return 200, self._objs[key]  # reference (see create)
 
     def patch(self, group, version, plural, namespace, name, body: dict):
         """RFC 7386 JSON merge patch against the CURRENT revision: a
@@ -542,6 +576,10 @@ class KubeSim:
         if self._objs.pop(key, None) is None:
             return
         self._event_touch.pop(key, None)
+        # copy before stamping the deletion rv: the last stored revision
+        # may still be referenced by the event log / an in-flight LIST
+        # serialization, and a logged revision must never change
+        obj = copy.deepcopy(obj)
         obj["metadata"]["resourceVersion"] = self._bump()
         self._emit("DELETED", key, obj)
         self._gc(obj["metadata"].get("uid"))
@@ -607,6 +645,37 @@ class KubeSim:
             return 200, copy.deepcopy(stored)
 
     def list(self, group, version, plural, namespace, label_sel="", field_sel=""):
+        code, payload = self._list_refs(
+            group, version, plural, namespace, label_sel, field_sel
+        )
+        if code != 200:
+            return code, payload
+        # public/in-process callers get private copies (they may mutate)
+        payload["items"] = [copy.deepcopy(o) for o in payload["items"]]
+        return 200, payload
+
+    def list_json(
+        self, group, version, plural, namespace, label_sel="", field_sel=""
+    ) -> Tuple[int, bytes]:
+        """LIST serialized straight from the store references — the HTTP
+        handler's path. A fleet LIST (1000 Nodes, 9000 operand pods per
+        kubelet sweep) used to deepcopy every object only for the result
+        to be json-dumped and discarded; serializing under the lock
+        skips the copy entirely (json.dumps never mutates). Stored
+        objects are only ever REPLACED on write, so the references are
+        stable for the duration of the dump."""
+        code, payload = self._list_refs(
+            group, version, plural, namespace, label_sel, field_sel
+        )
+        return code, json.dumps(payload).encode()
+
+    def _list_refs(self, group, version, plural, namespace, label_sel, field_sel):
+        """Shared LIST body; ``items`` holds STORE REFERENCES (callers
+        must copy or serialize, never mutate). Serialization/copy happens
+        outside the lock — safe because EVERY write path (create/update/
+        patch/_mutate_stored/_delete_stored) REPLACES stored objects
+        copy-on-write instead of mutating them in place, so a reference
+        always denotes one immutable revision."""
         kind, namespaced = PLURAL_TABLE[plural]
         if plural == "events":
             self.expire_events()
@@ -630,7 +699,7 @@ class KubeSim:
                     continue
                 if field_sel and not _match_field_selector(obj, field_sel):
                     continue
-                items.append(copy.deepcopy(obj))
+                items.append(obj)
             return 200, {
                 "apiVersion": f"{group}/{version}" if group else version,
                 "kind": f"{kind}List",
@@ -672,20 +741,26 @@ class KubeSim:
                 # nobody lists — informers must see the DELETEDs
                 self.expire_events()
             batch: List[Tuple[str, dict]] = []
-            with self._cond:
+            with self._lock:
+                cond = self._cond_for(plural)
                 if cursor < self._min_event_rv:
                     # events between our cursor and the log head were
                     # compacted away while we waited: the client MUST
                     # re-list (the 410 Gone contract)
                     gone = True
                 else:
-                    for rv, etype, key, obj in self._events:
-                        if rv > cursor and relevant(key):
-                            batch.append((etype, copy.deepcopy(obj)))
+                    # bisect to the first event past the cursor: a wake
+                    # touches only NEW events, not the whole log. The
+                    # batch carries references — logged revisions are
+                    # immutable and the consumer only json-serializes
+                    start = bisect_right(self._event_rvs, cursor)
+                    for rv, etype, key, obj in self._events[start:]:
+                        if relevant(key):
+                            batch.append((etype, obj))
                     if self._events:
                         cursor = max(cursor, self._events[-1][0])
                     if not batch:
-                        self._cond.wait(0.2)
+                        cond.wait(0.2)
             if gone:
                 yield "ERROR", _status(410, "Expired", "history compacted")
                 return
@@ -759,7 +834,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- plumbing ---------------------------------------------------------
     def _json(self, code: int, obj: dict, headers: Optional[dict] = None) -> None:
-        data = json.dumps(obj).encode()
+        self._json_bytes(code, json.dumps(obj).encode(), headers)
+
+    def _json_bytes(
+        self, code: int, data: bytes, headers: Optional[dict] = None
+    ) -> None:
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
@@ -853,7 +932,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.sim.count_request("LIST")
         if self._maybe_fault("LIST", plural):
             return None
-        code, obj = self.sim.list(
+        # zero-copy serialization: the response is dumped straight from
+        # store references (fleet LISTs used to deepcopy every object
+        # just to discard the copies after serializing)
+        code, data = self.sim.list_json(
             group,
             version,
             plural,
@@ -861,7 +943,7 @@ class _Handler(BaseHTTPRequestHandler):
             label_sel=qs.get("labelSelector", [""])[0],
             field_sel=qs.get("fieldSelector", [""])[0],
         )
-        return self._json(code, obj)
+        return self._json_bytes(code, data)
 
     def _watch(self, group, version, plural, namespace, qs):
         since_rv = qs.get("resourceVersion", [""])[0]

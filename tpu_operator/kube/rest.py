@@ -17,8 +17,9 @@ from __future__ import annotations
 import json
 import os
 import ssl
+import threading
 import time
-from http.client import HTTPSConnection
+from http.client import HTTPException, HTTPSConnection
 from typing import Dict, List, Optional
 from urllib.parse import quote, urlencode
 
@@ -136,6 +137,60 @@ class RestClient(Client):
                     "insecure=True explicitly for dev setups"
                 )
             self._ctx = ssl.create_default_context(cafile=ca)
+        # keep-alive connection pool: one idle connection per recent
+        # in-flight worker instead of a TCP+TLS handshake per request —
+        # client-go's pooled http.Transport, stdlib edition. Watch
+        # streams deliberately bypass it (their sockets carry custom
+        # timeouts and live for the stream). Thread-safe: the write
+        # pipeline runs many workers over one client.
+        self.pool_max = int(os.environ.get("REST_CONN_POOL_MAX", "32"))
+        self._pool: List = []
+        self._pool_lock = threading.Lock()
+        self.pool_reuses = 0
+        self.pool_fresh = 0
+        self.pool_stale_drops = 0
+
+    # -- connection pool --------------------------------------------------
+    def _acquire_conn(self):
+        """An idle pooled connection (LIFO: the most recently used is
+        the least likely to have been closed by the server), or a fresh
+        one. Returns ``(conn, reused)``."""
+        with self._pool_lock:
+            if self._pool:
+                self.pool_reuses += 1
+                return self._pool.pop(), True
+            self.pool_fresh += 1
+        return self._make_conn(), False
+
+    def _release_conn(self, conn) -> None:
+        with self._pool_lock:
+            if len(self._pool) < self.pool_max:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def _discard_conn(self, conn) -> None:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def close_idle_connections(self) -> None:
+        """Drop every pooled idle connection (tests / shutdown)."""
+        with self._pool_lock:
+            pool, self._pool = self._pool, []
+        for conn in pool:
+            self._discard_conn(conn)
+
+    def pool_stats(self) -> Dict[str, int]:
+        with self._pool_lock:
+            return {
+                "idle": len(self._pool),
+                "max": self.pool_max,
+                "reuses": self.pool_reuses,
+                "fresh": self.pool_fresh,
+                "stale_drops": self.pool_stale_drops,
+            }
 
     def _token(self) -> str:
         if self._static_token is not None:
@@ -154,6 +209,11 @@ class RestClient(Client):
         return HTTPSConnection(
             self.host, self.port, context=self._ctx, timeout=timeout
         )
+
+    def fault_stats(self) -> Dict[str, object]:
+        out = super().fault_stats()
+        out["conn_pool"] = self.pool_stats()
+        return out
 
     # back-compat knobs: existing callers/tests tune the read retry
     # count/backoff through these names; they now alias the RetryPolicy
@@ -247,7 +307,6 @@ class RestClient(Client):
         body: Optional[Obj],
         content_type: str = "application/json",
     ) -> Obj:
-        conn = self._make_conn()
         headers = {
             "Accept": "application/json",
             "Content-Type": content_type,
@@ -256,10 +315,41 @@ class RestClient(Client):
         if token:
             headers["Authorization"] = f"Bearer {token}"
         payload = json.dumps(body) if body is not None else None
-        try:
-            conn.request(method, path, body=payload, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()
+        idempotent = method in ("GET", "HEAD")
+        while True:
+            conn, reused = self._acquire_conn()
+            sent = False
+            try:
+                conn.request(method, path, body=payload, headers=headers)
+                sent = True
+                resp = conn.getresponse()
+                data = resp.read()
+            except (OSError, HTTPException):
+                # the socket died. A REUSED keep-alive connection failing
+                # here overwhelmingly means the server closed it while it
+                # idled in the pool — housekeeping, not an apiserver
+                # failure: retry once on a FRESH connection without
+                # touching the breaker/retry counters. But a
+                # NON-idempotent write whose request DID go out may have
+                # been processed before the socket died — silently
+                # re-sending it could double-apply (urllib3/client-go
+                # restrict idle-connection auto-retry the same way), so
+                # that case surfaces to the retry policy, which counts
+                # and bounds the re-send it was already doing for
+                # transport errors. A fresh connection failing is a real
+                # transport error and always surfaces.
+                self._discard_conn(conn)
+                if reused and (idempotent or not sent):
+                    with self._pool_lock:
+                        self.pool_stale_drops += 1
+                    continue
+                raise
+            # response fully read: the connection is reusable unless the
+            # server asked to close (HTTP/1.0, Connection: close)
+            if getattr(resp, "will_close", True):
+                self._discard_conn(conn)
+            else:
+                self._release_conn(conn)
             if resp.status == 404:
                 raise NotFoundError(path)
             if resp.status == 409:
@@ -283,8 +373,6 @@ class RestClient(Client):
                     f"{method} {path} -> {resp.status}: {data[:512]!r}"
                 )
             return json.loads(data) if data else {}
-        finally:
-            conn.close()
 
     # -- Client interface -------------------------------------------------
     def get(self, api_version, kind, name, namespace="", copy=False):

@@ -7,8 +7,35 @@ updates identically in both).
 
 from __future__ import annotations
 
+import weakref
+
 from tpu_operator import consts
 from tpu_operator.kube.client import Client, Obj
+from tpu_operator.kube.write_pipeline import WritePipeline
+
+# per-client kubelet write pipeline: a 1000-node pool's kubelets are a
+# thousand PARALLEL actors on a real cluster — simulating them as one
+# serial RTT loop measured the simulator, not the operator. Keyed weakly
+# so a test's client takes its pipeline (and threads) with it.
+_kubelet_pipelines: "weakref.WeakKeyDictionary[Client, WritePipeline]" = (
+    weakref.WeakKeyDictionary()
+)
+
+
+def _kubelet_pipeline(client: Client) -> WritePipeline:
+    from tpu_operator.kube.write_pipeline import default_depth
+
+    pipe = _kubelet_pipelines.get(client)
+    if pipe is None:
+        # capped at 4: the simulated kubelets only ever talk to an
+        # IN-PROCESS apiserver (FakeClient or same-interpreter kubesim),
+        # where deeper fan-out buys no I/O overlap and pays GIL-convoy
+        # latency per write (see write_pipeline.default_depth)
+        pipe = _kubelet_pipelines.setdefault(
+            client,
+            WritePipeline(depth=min(4, default_depth()), name="kubelet-sim"),
+        )
+    return pipe
 
 
 def make_tpu_node(
@@ -76,10 +103,18 @@ def _ensure_operand_pod(
     revision_hash,
     node_name: str,
     refresh_stale: bool,
+    existing: Obj | None = None,
+    probed: bool = False,
 ) -> None:
     """Create (or, when ``refresh_stale``, hash-refresh) one Running operand
     pod — the single pod shape both kubelet simulators use so they can't
-    drift."""
+    drift.
+
+    ``existing``/``probed``: callers that already LISTed the namespace
+    pods pass the (possibly absent) stored pod with ``probed=True`` —
+    the fleet sweep used to re-GET every pod every 100 ms round, and
+    those reads were the single largest request volume on the
+    convergence bench (~9 DaemonSets × N nodes per sweep)."""
     pod = {
         "apiVersion": "v1",
         "kind": "Pod",
@@ -92,9 +127,18 @@ def _ensure_operand_pod(
         "spec": {"nodeName": node_name},
         "status": {"phase": "Running", "containerStatuses": [{"ready": True}]},
     }
-    existing = client.get_or_none("v1", "Pod", name, namespace)
+    if not probed:
+        existing = client.get_or_none("v1", "Pod", name, namespace)
     if existing is None:
-        client.create(pod)
+        from tpu_operator.kube.client import ConflictError
+
+        try:
+            client.create(pod)
+        except ConflictError:
+            if not probed:
+                raise
+            # the pre-sweep listing was stale about this pod (it exists)
+            # — the next sweep's fresh listing reconciles its hash
     elif refresh_stale and (
         existing["metadata"].get("annotations", {}).get(
             consts.LAST_APPLIED_HASH_ANNOTATION
@@ -207,6 +251,7 @@ def simulate_kubelet_nodes(
     # misses the apiserver's at-deletion cascade and would pin OnDelete
     # readiness NotReady forever; on a real cluster the DaemonSet
     # controller (and PodGC) clean exactly these.
+    pods_by_name: dict = {}
     for pod in client.list("v1", "Pod", namespace):
         bound = pod.get("spec", {}).get("nodeName")
         app = (pod["metadata"].get("labels") or {}).get("app")
@@ -214,6 +259,12 @@ def simulate_kubelet_nodes(
             client.delete_if_exists(
                 "v1", "Pod", pod["metadata"]["name"], namespace
             )
+            continue
+        # one listing serves the whole sweep's existence checks (the
+        # per-pod re-GETs this replaces were the top request volume on
+        # the fleet bench); a pod created/refreshed THIS sweep is keyed
+        # uniquely, so the snapshot can't go stale against ourselves
+        pods_by_name[pod["metadata"]["name"]] = pod
     for ds in client.list("apps/v1", "DaemonSet", namespace):
         selector = (
             ds["spec"]["template"]["spec"].get("nodeSelector", {}) or {}
@@ -234,13 +285,25 @@ def simulate_kubelet_nodes(
         _stamp_ds_status(client, ds, len(matching))
         on_delete = ds["spec"].get("updateStrategy", {}).get("type") == "OnDelete"
         app, h = _ds_app_and_hash(ds)
+        # per-node kubelets act in parallel, so the pod fan-out rides
+        # the write pipeline (keyed per pod: one node's create/refresh
+        # for a DS can never reorder against itself; different nodes
+        # overlap like the real fleet). Errors surface at the drain
+        # barrier below, matching the old raise-on-first-error shape.
+        pipe = _kubelet_pipeline(client)
+        halted = False
         for node in matching:
             if halt_event is not None and halt_event.is_set():
-                # a fleet-scale sweep takes minutes; callers that halt the
-                # kubelet (to measure a quiesced steady state) must be
-                # able to abort MID-sweep, not just between sweeps
-                return
-            _ensure_operand_pod(
+                # a fleet-scale sweep takes a while; callers that halt
+                # the kubelet (to measure a quiesced steady state) must
+                # be able to abort MID-sweep, not just between sweeps —
+                # the drain below keeps any in-flight write from
+                # outliving the halt
+                halted = True
+                break
+            pipe.submit(
+                ("Pod", namespace, f"{app}-{node}"),
+                _ensure_operand_pod,
                 client,
                 namespace,
                 f"{app}-{node}",
@@ -248,7 +311,14 @@ def simulate_kubelet_nodes(
                 h,
                 node,
                 refresh_stale=not on_delete,
+                existing=pods_by_name.get(f"{app}-{node}"),
+                probed=True,
             )
+        errors = pipe.drain()
+        if halted:
+            return  # quiescing: straggler errors are moot
+        if errors:
+            raise errors[0]
 
 
 def wait_for(what: str, pred, timeout_s: float = 60.0, poll_s: float = 0.2):

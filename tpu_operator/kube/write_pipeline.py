@@ -1,0 +1,290 @@
+"""Bounded-concurrency apiserver write pipeline with per-key ordering.
+
+The convergence hot path used to be RTT-serialized: one reconcile worker
+pushed every write (1000 node label patches, ~30 operand applies, the
+kubelet simulator's pod fan-out) one-at-a-time through a single
+synchronous connection. The reference operator overlaps independent
+writes through client-go's pooled transport and per-object workqueues;
+``WritePipeline`` is the same shape for this codebase:
+
+* a thread-pool executor of configurable ``depth`` (default 16,
+  ``WRITE_PIPELINE_DEPTH``) runs submitted write callables;
+* **per-key serialization**: tasks submitted under the same key (by
+  convention ``(kind, namespace, name)``) run strictly in submission
+  order — two revisions of the same object can NEVER apply out of
+  order, at any depth; tasks under different keys overlap freely;
+* ``drain()`` is the flush barrier: it blocks until every outstanding
+  task finished and returns (or raises, via ``PipelineError``) the
+  errors collected since the last drain;
+* error aggregation preserves the fault-tolerance semantics underneath
+  (kube/retry.py): retries, Retry-After and the circuit breaker all
+  live INSIDE the submitted client call — the pipeline only transports
+  the outcome. Exceptions propagate unwrapped through
+  ``WriteFuture.result()`` so per-task handlers (conflict recompute,
+  vanished-object tolerance) behave exactly as they did inline.
+
+``depth=1`` (or ``WRITE_PIPELINE_DEPTH=1``) is the escape hatch: every
+submit executes inline on the caller's thread, byte-for-byte the old
+serial behavior — no threads are ever created.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Deque, Dict, Hashable, List, Optional, Tuple
+
+DEFAULT_DEPTH = 16
+
+
+def default_depth() -> int:
+    """Resolve the depth at construction time (not import time) so a
+    harness can set ``WRITE_PIPELINE_DEPTH`` before building its
+    pipelines. 16 suits a real apiserver (network RTT to overlap, a
+    multi-core server); an IN-PROCESS kubesim shares the client's GIL,
+    where deep fan-out only adds thread-convoy latency — the fleet
+    bench runs depth 4 (measured: 1000 patches at depth 16 take ~2.3×
+    the wall of depth 1 against a same-interpreter server)."""
+    try:
+        return int(os.environ.get("WRITE_PIPELINE_DEPTH", DEFAULT_DEPTH))
+    except ValueError:
+        return DEFAULT_DEPTH
+
+
+class PipelineError(RuntimeError):
+    """Aggregate of task exceptions surfaced by ``drain(raise_errors=True)``.
+
+    Carries the original exceptions in ``errors`` (first one also chained
+    as ``__cause__``) so a caller can still dispatch on concrete types."""
+
+    def __init__(self, errors: List[BaseException]):
+        self.errors = list(errors)
+        super().__init__(
+            f"{len(errors)} pipeline write(s) failed: "
+            + "; ".join(f"{type(e).__name__}: {e}" for e in errors[:5])
+        )
+        if errors:
+            self.__cause__ = errors[0]
+
+
+class WriteFuture:
+    """Outcome of one submitted write. ``result()`` blocks until the
+    task ran and returns its value or re-raises its exception — the
+    exact exception the client call raised, unwrapped."""
+
+    __slots__ = ("key", "_done", "_value", "_error")
+
+    def __init__(self, key: Hashable):
+        self.key = key
+        self._done = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    def _finish(self, value: Any, error: Optional[BaseException]) -> None:
+        self._value = value
+        self._error = error
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"pipeline write {self.key!r} still pending")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+def _shutdown_executor(executor: ThreadPoolExecutor) -> None:
+    executor.shutdown(wait=False)
+
+
+# task tuple: (future, fn, args, kwargs, submit_monotonic)
+_Task = Tuple[WriteFuture, Callable[..., Any], tuple, dict, float]
+
+
+class WritePipeline:
+    """Thread-safe; one instance per writer (the ClusterPolicyController
+    owns one for the reconcile pass; the kubelet simulator builds its
+    own). The executor is created lazily on the first parallel submit
+    and reaped when the pipeline is garbage-collected, so unit tests
+    that never fan out never spawn a thread."""
+
+    def __init__(self, depth: Optional[int] = None, name: str = "write-pipeline"):
+        self.depth = max(1, int(depth if depth is not None else default_depth()))
+        self.name = name
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._executor: Optional[ThreadPoolExecutor] = None
+        # key -> queued tasks behind the one currently running for that
+        # key; key PRESENCE means a worker owns the key (per-key
+        # serialization: the owner drains its deque in FIFO order)
+        self._chains: Dict[Hashable, Deque[_Task]] = {}
+        self._outstanding = 0
+        # errors since the last drain() (bounded; full detail stays on
+        # the individual futures)
+        self._errors: List[BaseException] = []
+        # observability counters (exported via stats())
+        self.submitted_total = 0
+        self.completed_total = 0
+        self.errors_total = 0
+        self.inline_total = 0
+        self.queue_wait_s_total = 0.0
+        self.busy_s_total = 0.0
+        self.inflight = 0
+        self.inflight_peak = 0
+
+    # ------------------------------------------------------------------
+    def _ensure_executor(self) -> ThreadPoolExecutor:
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=self.depth, thread_name_prefix=self.name
+            )
+            # reap the worker threads when the owning controller goes
+            # away (test fixtures create many controllers per process)
+            weakref.finalize(self, _shutdown_executor, self._executor)
+        return self._executor
+
+    def submit(
+        self, key: Hashable, fn: Callable[..., Any], *args: Any, **kwargs: Any
+    ) -> WriteFuture:
+        """Queue ``fn(*args, **kwargs)`` under ``key``. Same-key tasks
+        run in submission order on one worker at a time; different keys
+        overlap up to ``depth``. With ``depth=1`` the call runs inline
+        immediately (serial escape hatch)."""
+        fut = WriteFuture(key)
+        if self.depth == 1:
+            with self._lock:
+                self.submitted_total += 1
+                self.inline_total += 1
+            self._run_one(fut, fn, args, kwargs, time.monotonic())
+            return fut
+        task: _Task = (fut, fn, args, kwargs, time.monotonic())
+        with self._lock:
+            self.submitted_total += 1
+            self._outstanding += 1
+            chain = self._chains.get(key)
+            if chain is not None:
+                chain.append(task)  # key busy: strictly ordered behind it
+                return fut
+            self._chains[key] = deque()
+            executor = self._ensure_executor()
+        executor.submit(self._work_key, key, task)
+        return fut
+
+    def _run_one(
+        self, fut: WriteFuture, fn, args, kwargs, submitted: float
+    ) -> None:
+        t0 = time.monotonic()
+        value, error = None, None
+        try:
+            value = fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 - transported, not handled
+            error = e
+        elapsed = time.monotonic() - t0
+        with self._lock:
+            self.queue_wait_s_total += max(0.0, t0 - submitted)
+            self.busy_s_total += elapsed
+            self.completed_total += 1
+            if error is not None:
+                self.errors_total += 1
+                if len(self._errors) < 256:
+                    self._errors.append(error)
+        fut._finish(value, error)
+
+    def _work_key(self, key: Hashable, task: _Task) -> None:
+        """Per-key worker: runs the task it was dispatched with, then
+        drains everything queued behind the key in FIFO order. The key's
+        chain entry exists for exactly the worker's lifetime — that
+        invariant IS the ordering guarantee."""
+        while True:
+            fut, fn, args, kwargs, submitted = task
+            with self._lock:
+                self.inflight += 1
+                self.inflight_peak = max(self.inflight_peak, self.inflight)
+            try:
+                self._run_one(fut, fn, args, kwargs, submitted)
+            finally:
+                with self._idle:
+                    self.inflight -= 1
+                    self._outstanding -= 1
+                    chain = self._chains[key]
+                    if chain:
+                        task = chain.popleft()
+                        next_task = True
+                    else:
+                        del self._chains[key]
+                        next_task = False
+                    if self._outstanding == 0:
+                        self._idle.notify_all()
+            if not next_task:
+                return
+
+    # ------------------------------------------------------------------
+    def drain(
+        self, timeout: Optional[float] = None, raise_errors: bool = False
+    ) -> List[BaseException]:
+        """Flush barrier: block until no task is queued or running, then
+        return (and clear) the errors collected since the last drain.
+        With ``raise_errors`` a non-empty error set raises
+        ``PipelineError`` instead. Individual futures keep their own
+        error regardless, so per-task handling and drain-level
+        aggregation compose."""
+        with self._idle:
+            deadline = (
+                time.monotonic() + timeout if timeout is not None else None
+            )
+            while self._outstanding:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"pipeline drain timed out with "
+                            f"{self._outstanding} task(s) outstanding"
+                        )
+                self._idle.wait(remaining)
+            errors, self._errors = self._errors, []
+        if raise_errors and errors:
+            raise PipelineError(errors)
+        return errors
+
+    # ------------------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """Observability payload for /debug/vars and the metrics gauges:
+        configured depth, live in-flight count, totals, and the average
+        queue wait a task saw before a worker picked it up."""
+        with self._lock:
+            completed = self.completed_total
+            return {
+                "depth": self.depth,
+                "inflight": self.inflight,
+                "inflight_peak": self.inflight_peak,
+                "outstanding": self._outstanding,
+                "submitted_total": self.submitted_total,
+                "completed_total": completed,
+                "errors_total": self.errors_total,
+                "inline_total": self.inline_total,
+                "queue_wait_ms_avg": (
+                    round(self.queue_wait_s_total * 1000.0 / completed, 3)
+                    if completed
+                    else 0.0
+                ),
+                "busy_s_total": round(self.busy_s_total, 6),
+            }
+
+    def utilization(self, wall_s: float) -> float:
+        """Fraction of ``depth × wall_s`` worker capacity spent running
+        tasks — the headline the fleet bench prints next to the render
+        cache hit rate."""
+        if wall_s <= 0:
+            return 0.0
+        with self._lock:
+            return round(
+                min(1.0, self.busy_s_total / (self.depth * wall_s)), 4
+            )

@@ -145,6 +145,11 @@ def build_manager(
     # node-health remediation: last pass's verdicts + lifetime counters
     # (attempts, PDB vetoes, budget deferrals, breaker opens)
     mgr.register_debug_vars("remediation", reconciler.remediation.stats)
+    # concurrent write pipeline: depth, in-flight, queue wait, errors —
+    # one curl answers "are the convergence fan-outs actually wide?"
+    mgr.register_debug_vars(
+        "write_pipeline", reconciler.ctrl.writes.stats
+    )
     upgrade = UpgradeReconciler(client, namespace)
     mgr.add_reconciler(UPGRADE_KEY, lambda _key: upgrade.reconcile())
     return mgr, reconciler, upgrade

@@ -27,7 +27,8 @@ DOCKER_PUSH_TARGETS = $(patsubst %,docker-push-%,$(IMAGES))
 # declared AFTER the target lists exist: a .PHONY on an undefined
 # variable expands to nothing and silently un-phonies the fan-out
 .PHONY: all native test crd bundle release-bundle validate lint clean \
-	dev-run dev-run-kubesim soak bench bench-gate bench-converge chaos-fast \
+	dev-run dev-run-kubesim soak bench bench-gate bench-converge \
+	bench-alloc chaos-fast \
 	builder docker-build \
 	docker-push $(DOCKER_BUILD_TARGETS) $(DOCKER_PUSH_TARGETS)
 
@@ -64,6 +65,7 @@ validate:
 	python -m tpu_operator.cfg.main validate csv --input bundle/manifests/tpu-operator.clusterserviceversion.yaml
 	python -m tpu_operator.cfg.main validate bundle --dir bundle
 	$(MAKE) bench-converge
+	$(MAKE) bench-alloc
 
 # per-image build/push fan-out; `make docker-build DIST=multi-arch
 # PUSH_ON_BUILD=true` is the release pipeline
@@ -93,6 +95,14 @@ bench-gate:
 # bench box) — trips when the convergence write path re-serializes
 bench-converge:
 	python -m pytest tests/test_converge_bench.py -q -m slow -p no:cacheprovider
+
+# CI allocation gate: 1000-node scheduling churn through the real
+# device-plugin path, concurrent with convergence and a remediation
+# wave — min-of-rounds p99 allocate latency under a fixed ceiling,
+# best-of-rounds rate >= 1k allocations/min, zero double-allocated
+# chips / partially-placed gangs / leaked reservations every round
+bench-alloc:
+	python -m pytest tests/test_alloc_bench.py -q -m slow -p no:cacheprovider
 
 # CI fault gate: the deterministic fault matrix (injected 429/500/503/
 # latency on every write verb, a full partition window, a raising state)

@@ -512,6 +512,47 @@ def run_fleet_convergence(
     return out
 
 
+def run_alloc_churn(n_nodes: int = 1000, timeout_s: int = 1500) -> dict:
+    """Allocation-traffic axis (ISSUE 6): sustained scheduling churn
+    through the real device-plugin path at ``n_nodes``, concurrent with
+    convergence and a remediation wave (``tests/scripts/alloc_churn.py``)
+    — allocations/min, p50/p99 allocate latency, gang admission stats,
+    fragmentation, and the zero-double-allocation / zero-partial-gang /
+    zero-leak invariants. The strict ≥1k/min floor is ``make
+    bench-alloc``'s min-of-rounds job; this single-round axis uses a
+    generous floor so one loaded bench round records its numbers instead
+    of failing the whole bench. ``timeout_s`` must cover the script's
+    own worst-case internal budget (two 420 s convergence phases + the
+    wave + the churn floor + drain — the same 1500 s the gate allows)."""
+    args = [
+        sys.executable,
+        os.path.join(REPO, "tests", "scripts", "alloc_churn.py"),
+        "--nodes", str(n_nodes),
+        "--min-rate", "500",
+    ]
+    try:
+        proc = subprocess.run(
+            args,
+            cwd=REPO,
+            env=dict(os.environ, OPERATOR_NAMESPACE="tpu-operator"),
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {
+            "ok": False,
+            "error": f"alloc churn timed out after {timeout_s}s",
+        }
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception:
+        return {
+            "ok": False,
+            "error": (proc.stderr or proc.stdout)[-512:],
+        }
+
+
 def main() -> int:
     # the validator CLI chain runs FIRST: its jax/membw/flashattn
     # components each need the chip, and the TPU runtime is single-client
@@ -718,6 +759,10 @@ def main() -> int:
     fleet_populated = run_fleet_convergence(
         n_nodes=100, bulk_pods=20000, timeout_s=540
     )
+    # the workload axis the device plugin exists to serve (ISSUE 6):
+    # 1000-node scheduling churn through GetPreferredAllocation →
+    # Allocate, concurrent with convergence + a remediation wave
+    alloc_churn = run_alloc_churn()
 
     # ICI axis last: it re-binds JAX to the CPU mesh
     ici = run_ici_on_cpu_mesh()
@@ -762,6 +807,7 @@ def main() -> int:
         "convergence_fleet_200": fleet_200,
         "convergence_fleet_1000": fleet_1000,
         "fleet_populated_20k_pods": fleet_populated,
+        "alloc_churn_1000": alloc_churn,
         "validator_cli": validator_cli,
         "flashattn": {
             "ok": bool(fa.ok),
@@ -845,6 +891,7 @@ def main() -> int:
         and fleet_1000.get("ok")
         and pass_gate_ok
         and fleet_populated.get("ok")
+        and alloc_churn.get("ok")
         and validator_cli.get("ok")
         and fa.ok
         and fa_gate_ok

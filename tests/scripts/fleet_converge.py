@@ -112,6 +112,17 @@ def main(argv=None) -> int:
         help="unrelated non-TPU pods to pre-seed (populated-cluster variant)",
     )
     p.add_argument("--pod-namespaces", type=int, default=8)
+    p.add_argument(
+        "--alloc-churn",
+        action="store_true",
+        help="run the scheduling-churn engine (tpu_operator/schedsim) "
+        "concurrently: short-lived TPU pods through the real "
+        "device-plugin path while the fleet converges; allocation "
+        "stats join the output line",
+    )
+    p.add_argument("--alloc-rate", type=float, default=1200.0)
+    p.add_argument("--alloc-workers", type=int, default=6)
+    p.add_argument("--alloc-gang-frac", type=float, default=0.15)
     args = p.parse_args(argv)
 
     nodes = tuple(f"fleet-{i}" for i in range(args.nodes))
@@ -155,6 +166,25 @@ def main(argv=None) -> int:
     kubelet_thread.start()
     mgr.enqueue("clusterpolicy")
 
+    # optional foreground allocation traffic (its own client: churn must
+    # not share the operator's connection pool or circuit breaker)
+    engine = None
+    if args.alloc_churn:
+        from tpu_operator.schedsim.engine import ChurnEngine
+
+        churn_client = make_client(server.port)
+        churn_client.GET_RETRY_BACKOFF_S = 0.05
+        engine = ChurnEngine(
+            churn_client,
+            nodes,
+            workers=args.alloc_workers,
+            rate_per_min=args.alloc_rate,
+            gang_fraction=args.alloc_gang_frac,
+            seed=11,
+        )
+        mgr.register_debug_vars("allocation", engine.stats)
+        engine.start()
+
     ok = False
     deadline = time.monotonic() + args.timeout
     while time.monotonic() < deadline:
@@ -179,6 +209,22 @@ def main(argv=None) -> int:
     # pipeline; the kubelet sim runs its own)
     pipeline_stats = reconciler.ctrl.writes.stats()
     pipeline_utilization = reconciler.ctrl.writes.utilization(elapsed)
+
+    # the churn engine quiesces with the kubelet: its writes must not
+    # pollute the per-reconcile steady-state request measurement
+    alloc_stats = None
+    alloc_ok = True
+    if engine is not None:
+        engine.stop()
+        verdict = engine.drain_check()
+        alloc_stats = engine.stats()
+        alloc_ok = (
+            verdict["chips_held"] == 0
+            and verdict["pods_holding"] == 0
+            and verdict["double_allocations"] == 0
+            and verdict["invariant_violations"] == 0
+            and alloc_stats["errors_total"] == 0
+        )
 
     # steady-state apiserver cost: quiesce (stop the manager worker and
     # the kubelet), then pump the reconciler directly against the warm
@@ -222,37 +268,47 @@ def main(argv=None) -> int:
 
     stop.set()
     server.stop()
-    print(
-        json.dumps(
+    out = {
+        "ok": ok and steady_ok and cache_ok and alloc_ok,
+        "nodes": args.nodes,
+        "bulk_pods": args.pods,
+        "time_to_ready_s": round(elapsed, 2),
+        "converge_requests": converge_requests,
+        "converge_writes": converge_writes,
+        "converge_wall_per_write_us": converge_wall_per_write_us,
+        "write_pipeline_depth": pipeline_stats["depth"],
+        "write_pipeline_submitted": pipeline_stats["submitted_total"],
+        "write_pipeline_errors": pipeline_stats["errors_total"],
+        "write_pipeline_queue_wait_ms_avg": pipeline_stats[
+            "queue_wait_ms_avg"
+        ],
+        "write_pipeline_utilization": pipeline_utilization,
+        "apiserver_requests_per_reconcile": per_reconcile,
+        "reconcile_pass_ms": round(reconcile_pass_ms, 1),
+        # fastest round: the noise-robust comparator (a scheduler
+        # hiccup inflates the mean; nothing deflates the min)
+        "reconcile_pass_ms_min": round(min(round_ms), 1),
+        "render_cache_hit_rate": render_stats["last_pass"]["hit_rate"],
+        "render_cache_renders_total": render_stats["renders_total"],
+        "render_cache_fingerprint": render_stats["fingerprint"],
+        "peak_rss_mib": _peak_rss_mib(),
+        "pod_informer_objects": pod_informer_objects,
+    }
+    if alloc_stats is not None:
+        out.update(
             {
-                "ok": ok and steady_ok and cache_ok,
-                "nodes": args.nodes,
-                "bulk_pods": args.pods,
-                "time_to_ready_s": round(elapsed, 2),
-                "converge_requests": converge_requests,
-                "converge_writes": converge_writes,
-                "converge_wall_per_write_us": converge_wall_per_write_us,
-                "write_pipeline_depth": pipeline_stats["depth"],
-                "write_pipeline_submitted": pipeline_stats["submitted_total"],
-                "write_pipeline_errors": pipeline_stats["errors_total"],
-                "write_pipeline_queue_wait_ms_avg": pipeline_stats[
-                    "queue_wait_ms_avg"
-                ],
-                "write_pipeline_utilization": pipeline_utilization,
-                "apiserver_requests_per_reconcile": per_reconcile,
-                "reconcile_pass_ms": round(reconcile_pass_ms, 1),
-                # fastest round: the noise-robust comparator (a scheduler
-                # hiccup inflates the mean; nothing deflates the min)
-                "reconcile_pass_ms_min": round(min(round_ms), 1),
-                "render_cache_hit_rate": render_stats["last_pass"]["hit_rate"],
-                "render_cache_renders_total": render_stats["renders_total"],
-                "render_cache_fingerprint": render_stats["fingerprint"],
-                "peak_rss_mib": _peak_rss_mib(),
-                "pod_informer_objects": pod_informer_objects,
+                "alloc_total": alloc_stats["allocations_total"],
+                "alloc_per_min": alloc_stats["alloc_per_min"],
+                "alloc_p50_ms": alloc_stats["latency_ms"]["p50_ms"],
+                "alloc_p99_ms": alloc_stats["latency_ms"]["p99_ms"],
+                "alloc_failures": alloc_stats["failures_total"],
+                "alloc_gangs_admitted": alloc_stats["gangs"]["admitted"],
+                "alloc_fragmentation_pct": alloc_stats["fragmentation_pct"],
+                "alloc_invariants_ok": alloc_ok,
             }
         )
-    )
-    return 0 if ok and steady_ok and cache_ok else 1
+    print(json.dumps(out))
+    return 0 if ok and steady_ok and cache_ok and alloc_ok else 1
 
 
 if __name__ == "__main__":

@@ -272,6 +272,121 @@ def test_preferred_allocation_non_tiling_sizes(plugin):
         assert len(ids) == size and len(set(ids)) == size, (size, ids)
 
 
+def test_preferred_allocation_zero_and_negative_size(plugin):
+    """Zero- and negative-size requests answer a well-formed EMPTY
+    preference (a negative size used to slice the fill pool from the
+    wrong end), and a zero-size request carrying must-include ids keeps
+    the existing contract-violation posture (must > size returns every
+    must id unranked rather than truncating)."""
+    _, _, stub = plugin
+    for size in (0, -1, -8):
+        req = pb2.GetPreferredAllocationRequest()
+        creq = req.container_requests.add()
+        creq.available_deviceIDs.extend([str(i) for i in range(8)])
+        creq.allocation_size = size
+        resp = stub.GetPreferredAllocation(req)
+        assert list(resp.container_responses[0].deviceIDs) == [], size
+    req = pb2.GetPreferredAllocationRequest()
+    creq = req.container_requests.add()
+    creq.available_deviceIDs.extend([str(i) for i in range(8)])
+    creq.must_include_deviceIDs.extend(["2"])
+    creq.allocation_size = 0
+    resp = stub.GetPreferredAllocation(req)
+    assert list(resp.container_responses[0].deviceIDs) == ["2"]
+
+
+def test_preferred_allocation_size_beyond_any_contiguous_group(plugin):
+    """A request larger than any contiguous group — and larger than the
+    whole offer — returns the honest partial answer, never an error."""
+    _, _, stub = plugin
+    # 6 of 8 chips offered, split so no 6-chip connected block exists
+    req = pb2.GetPreferredAllocationRequest()
+    creq = req.container_requests.add()
+    creq.available_deviceIDs.extend(["0", "1", "2", "5", "6", "7"])
+    creq.allocation_size = 6
+    resp = stub.GetPreferredAllocation(req)
+    ids = sorted(int(i) for i in resp.container_responses[0].deviceIDs)
+    assert ids == [0, 1, 2, 5, 6, 7]
+    # size beyond the offer entirely: partial, well-formed
+    req = pb2.GetPreferredAllocationRequest()
+    creq = req.container_requests.add()
+    creq.available_deviceIDs.extend(["0", "3"])
+    creq.allocation_size = 16
+    resp = stub.GetPreferredAllocation(req)
+    ids = sorted(int(i) for i in resp.container_responses[0].deviceIDs)
+    assert ids == [0, 3]
+
+
+def test_preferred_allocation_must_include_gone_from_registry(
+    plugin, dev_root
+):
+    """must-include devices already gone from the device registry (chip
+    vanished between the kubelet's snapshot and this RPC): the RPC
+    answers well-formed — the stale id is dropped when it also left the
+    offer, and admission's fail-closed checks decide — instead of
+    raising mid-RPC."""
+    servicer, _, stub = plugin
+    os.unlink(os.path.join(dev_root, "accel3"))
+    servicer.refresh_devices()
+    # stale kubelet view still offers (and requires) the vanished chip
+    req = pb2.GetPreferredAllocationRequest()
+    creq = req.container_requests.add()
+    creq.available_deviceIDs.extend([str(i) for i in range(8)])
+    creq.must_include_deviceIDs.extend(["3"])
+    creq.allocation_size = 2
+    resp = stub.GetPreferredAllocation(req)
+    ids = [int(i) for i in resp.container_responses[0].deviceIDs]
+    assert len(ids) == 2 and len(set(ids)) == 2 and 3 in ids
+    # the must id gone from the OFFER as well: dropped, partial fill
+    req = pb2.GetPreferredAllocationRequest()
+    creq = req.container_requests.add()
+    creq.available_deviceIDs.extend(["0", "1"])
+    creq.must_include_deviceIDs.extend(["3"])
+    creq.allocation_size = 2
+    resp = stub.GetPreferredAllocation(req)
+    ids = sorted(int(i) for i in resp.container_responses[0].deviceIDs)
+    assert ids == [0, 1]
+
+
+def test_preferred_allocation_non_numeric_ids_fall_back_naive(plugin):
+    """Non-numeric device ids (a fallback registry naming devices, not
+    indexing chips) must take the naive must-first fill, not crash the
+    RPC with a ValueError."""
+    _, _, stub = plugin
+    req = pb2.GetPreferredAllocationRequest()
+    creq = req.container_requests.add()
+    creq.available_deviceIDs.extend(["alpha", "beta", "gamma"])
+    creq.must_include_deviceIDs.extend(["gamma"])
+    creq.allocation_size = 2
+    resp = stub.GetPreferredAllocation(req)
+    ids = list(resp.container_responses[0].deviceIDs)
+    assert len(ids) == 2 and "gamma" in ids
+    assert set(ids) <= {"alpha", "beta", "gamma"}
+    # ...and Allocate must survive the same id class (TPU_CHIPS_VISIBLE
+    # used to sort with key=int and crash one RPC later)
+    areq = pb2.AllocateRequest()
+    areq.container_requests.add().devicesIDs.extend(["gamma", "alpha", "7"])
+    aresp = stub.Allocate(areq)
+    assert (
+        aresp.container_responses[0].envs["TPU_CHIPS_VISIBLE"]
+        == "7,alpha,gamma"
+    )
+
+
+def test_servicer_snapshot_reflects_health(plugin):
+    """snapshot() hands in-process embedders the advertisement without a
+    ListAndWatch stream, health flips included."""
+    servicer, _, _ = plugin
+    snap = servicer.snapshot()
+    assert sorted(snap) == [str(i) for i in range(8)]
+    assert set(snap.values()) == {"Healthy"}
+    servicer.mark_unhealthy("5")
+    assert servicer.snapshot()["5"] == "Unhealthy"
+    # a private copy: mutating it must not touch the advertisement
+    servicer.snapshot()["0"] = "Unhealthy"
+    assert servicer.snapshot()["0"] == "Healthy"
+
+
 def test_list_and_watch_releases_dead_peer(dev_root):
     """A stream whose peer vanished (kubelet redial) must exit on the
     next poll tick instead of pinning a gRPC worker thread forever."""

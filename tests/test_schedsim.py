@@ -1,0 +1,489 @@
+"""Scheduling-churn engine (tpu_operator/schedsim): allocation ledger,
+gang hold-and-release coordination, fragmentation math, the in-process
+churn engine's invariants, and the kubelet-sim registry integration over
+real gRPC — the tier-1 fast face of the ``make bench-alloc`` axis."""
+
+import os
+import threading
+import time
+
+import pytest
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+from tpu_operator import consts
+from tpu_operator.kube import FakeClient
+from tpu_operator.kube.kubelet_sim import (
+    KubeletDeviceManager,
+    PodGoneError,
+    StaleGenerationError,
+)
+from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+from tpu_operator.kube.testing import seed_cluster
+from tpu_operator.plugin.server import DevicePluginServer, TPUDevicePluginServicer
+from tpu_operator.schedsim.engine import ChurnEngine, SyntheticChipServicer
+from tpu_operator.schedsim.gang import GangCoordinator
+from tpu_operator.schedsim.registry import (
+    AllocationRegistry,
+    DoubleAllocationError,
+    fragmentation_pct,
+    largest_contiguous_block,
+)
+
+NS = "tpu-operator"
+CHURN_NS = "alloc-churn"
+
+
+def wait_until(pred, timeout_s=30.0, poll_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll_s)
+    return False
+
+
+# -- AllocationRegistry ------------------------------------------------------
+
+
+def test_registry_hold_release_and_double_allocation():
+    reg = AllocationRegistry()
+    reg.hold("n1", "google.com/tpu", "pod-a", ["0", "1"])
+    reg.hold("n1", "google.com/tpu", "pod-b", ["2"])
+    reg.hold("n2", "google.com/tpu", "pod-a", ["0"])  # other node: fine
+    assert reg.total_held() == 4
+    assert reg.held_ids("n1", "google.com/tpu") == {"0", "1", "2"}
+    assert reg.holder_of("n1", "google.com/tpu", "1") == "pod-a"
+    with pytest.raises(DoubleAllocationError, match="already held"):
+        reg.hold("n1", "google.com/tpu", "pod-c", ["1", "3"])
+    assert reg.double_allocation_attempts == 1
+    # the refused hold must not have landed chip 3
+    assert "3" not in reg.held_ids("n1", "google.com/tpu")
+    # a self-overlapping hold is refused too
+    with pytest.raises(DoubleAllocationError):
+        reg.hold("n1", "google.com/tpu", "pod-d", ["4", "4"])
+    assert reg.release_pod("pod-a") == 3  # both nodes freed
+    assert reg.release_pod("pod-a") == 0  # idempotent
+    assert reg.total_held() == 1
+    reg.release_pod("pod-b")
+    assert reg.total_held() == 0 and reg.pods_holding() == 0
+    s = reg.stats()
+    assert s["holds_total"] == 3 and s["chips_held_peak"] == 4
+
+
+def test_registry_gang_tracking():
+    reg = AllocationRegistry()
+    reg.hold("n1", "google.com/tpu", "g1-m0", ["0"], gang_id="g1")
+    reg.hold("n2", "google.com/tpu", "g1-m1", ["0"], gang_id="g1")
+    reg.hold("n3", "google.com/tpu", "solo", ["0"])
+    assert reg.pods_of_gang("g1") == ["g1-m0", "g1-m1"]
+    reg.release_pod("g1-m0")
+    assert reg.pods_of_gang("g1") == ["g1-m1"]
+
+
+# -- fragmentation math ------------------------------------------------------
+
+
+def test_largest_contiguous_block_2x4():
+    # 2x4 row-major: idx = row*4 + col
+    assert largest_contiguous_block(range(8), "2x4", "v5e") == 8
+    # {0,1,2} one row-run, {7} a lone corner
+    assert largest_contiguous_block([0, 1, 2, 7], "2x4", "v5e") == 3
+    # fully shredded: opposite corners
+    assert largest_contiguous_block([0, 7], "2x4", "v5e") == 1
+    assert largest_contiguous_block([], "2x4", "v5e") == 0
+    # stray/non-numeric ids count as singleton blocks, never crash
+    assert largest_contiguous_block(["0", "1", "weird"], "2x4", "v5e") == 2
+
+
+def test_fragmentation_pct():
+    # every host fully free and connected -> 0
+    assert fragmentation_pct([set(range(8))] * 4, "2x4", "v5e") == 0.0
+    # nothing free anywhere -> 0 (nothing to fragment)
+    assert fragmentation_pct([set(), set()], "2x4", "v5e") == 0.0
+    # one host shredded into {0} + {3}: largest block 1 of 2 free -> 50%
+    assert fragmentation_pct([{0, 3}], "2x4", "v5e") == 50.0
+    # mixed fleet: (8 contiguous) + (2 free, 1 contiguous) = 9/10 -> 10%
+    assert (
+        fragmentation_pct([set(range(8)), {0, 3}], "2x4", "v5e") == 10.0
+    )
+
+
+# -- GangCoordinator ---------------------------------------------------------
+
+
+def test_gang_holds_block_and_release():
+    c = GangCoordinator(hold_ttl_s=5.0)
+    assert c.acquire("g1", ["n1", "n2"])
+    assert c.holder("n1") == "g1"
+    assert not c.acquire("g2", ["n2", "n3"], timeout_s=0.05)
+    assert c.holder("n3") is None, "failed admission must hold nothing"
+    c.release("g1", ["n1", "n2"])
+    assert c.acquire("g2", ["n2", "n3"], timeout_s=0.5)
+    c.release("g2", ["n2", "n3"])
+    assert c.active_holds() == 0
+
+
+def test_gang_hold_ttl_reclaims_wedged_admitter():
+    c = GangCoordinator(hold_ttl_s=0.1)
+    assert c.acquire("wedged", ["n1"])
+    time.sleep(0.15)
+    assert c.acquire("fresh", ["n1"], timeout_s=0.5)
+    assert c.expired_reclaims_total == 1
+    c.release("fresh", ["n1"])
+
+
+def test_gang_contention_no_deadlock():
+    """Two gangs over overlapping hosts, acquired from worker threads in
+    OPPOSITE naming orders, many rounds: both must always make progress
+    (the canonical-order + release-on-conflict protocol), with conflicts
+    actually observed."""
+    c = GangCoordinator(hold_ttl_s=5.0, backoff_s=0.0005)
+    rounds = 60
+    done = [0, 0]
+    errs = []
+
+    def gang(idx, nodes):
+        try:
+            for r in range(rounds):
+                gid = f"g{idx}-{r}"
+                assert c.acquire(gid, nodes, timeout_s=10.0), gid
+                time.sleep(0.0005)
+                c.release(gid, nodes)
+                done[idx] += 1
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(e)
+
+    t1 = threading.Thread(target=gang, args=(0, ["a", "b", "c"]))
+    t2 = threading.Thread(target=gang, args=(1, ["c", "b", "a"]))
+    t1.start()
+    t2.start()
+    t1.join(timeout=30)
+    t2.join(timeout=30)
+    assert not errs, errs
+    assert done == [rounds, rounds]
+    assert c.active_holds() == 0
+    assert c.timeouts_total == 0
+
+
+# -- ChurnEngine (in-process, FakeClient) -----------------------------------
+
+
+def _fake_cluster_client():
+    return FakeClient(
+        [
+            {
+                "apiVersion": "v1",
+                "kind": "Namespace",
+                "metadata": {"name": NS},
+            }
+        ]
+    )
+
+
+def test_engine_fast_churn_invariants():
+    """The tier-1 face of the bench: a short unlimited-rate churn on a
+    small fleet sustains allocations through the real plugin admission
+    path with zero double-allocations, zero partially-placed gangs, and
+    a clean drain (zero held chips, zero leftover pods)."""
+    client = _fake_cluster_client()
+    nodes = [f"churn-node-{i}" for i in range(24)]
+    eng = ChurnEngine(
+        client,
+        nodes,
+        workers=6,
+        gang_fraction=0.2,
+        gang_hosts=2,
+        sizes=(1, 2, 4),
+        lifetime_s=(0.05, 0.2),
+        cancel_prob=0.05,
+        seed=7,
+    )
+    eng.start()
+    deadline = time.monotonic() + 6.0
+    while time.monotonic() < deadline and eng.allocations_total < 150:
+        time.sleep(0.05)
+    eng.stop()
+    stats = eng.stats()
+    assert eng.allocations_total >= 150, stats
+    assert eng.invariant_violations == 0, stats
+    assert eng.errors_total == 0, stats
+    assert eng.registry.double_allocation_attempts == 0, stats
+    verdict = eng.drain_check()
+    assert verdict["chips_held"] == 0, verdict
+    assert verdict["pods_holding"] == 0, verdict
+    assert client.list("v1", "Pod", CHURN_NS) == [], "leftover churn pods"
+    # latency percentiles are reported
+    assert stats["latency_ms"]["p50_ms"] is not None
+    assert stats["latency_ms"]["p99_ms"] is not None
+    # gangs actually ran and the coordinator saw traffic
+    assert eng.gangs_admitted > 0, stats
+    assert stats["coordinator"]["acquires_total"] > 0
+
+
+def test_engine_cancellation_releases_reservations():
+    """Pods deleted mid-allocation (cancel_prob=1: every pod is deleted
+    between create and allocate) must release their chips — the no-leak
+    half of the churn contract."""
+    client = _fake_cluster_client()
+    eng = ChurnEngine(
+        client,
+        [f"c-{i}" for i in range(4)],
+        workers=2,
+        gang_fraction=0.0,
+        cancel_prob=1.0,
+        lifetime_s=(0.05, 0.1),
+        seed=3,
+    )
+    eng.start()
+    assert wait_until(lambda: eng.cancelled_total >= 20, timeout_s=10)
+    eng.stop()
+    assert eng.allocations_total == 0
+    assert eng.registry.total_held() == 0
+    assert eng.invariant_violations == 0
+
+
+def test_engine_gang_all_or_nothing_rollback():
+    """A gang whose second member fails MID-ADMISSION — after the first
+    member already placed its pod and holds its chips — rolls back
+    completely: no member keeps chips, no member pod survives, zero
+    partially-placed gangs. (Killing the host before _run_gang would be
+    vacuous: placement scoring would skip it and nothing would ever be
+    placed, so the failure is injected at the second member's allocate.)"""
+    from tpu_operator.schedsim.engine import InsufficientChipsError
+
+    client = _fake_cluster_client()
+    eng = ChurnEngine(
+        client,
+        ["ga", "gb"],
+        workers=1,
+        gang_fraction=1.0,
+        gang_hosts=2,
+        seed=1,
+    )
+    eng.ensure_namespace()
+    import random
+
+    rng = random.Random(0)
+    orig_allocate = eng.agents["gb"].allocate
+
+    def fail_mid_admission(*a, **kw):
+        # member ga has already placed by the time gb (second in the
+        # scored order) admits — the genuine rollback scenario
+        assert eng.registry.total_held() == eng.chips_per_host
+        raise InsufficientChipsError("injected mid-admission failure")
+
+    eng.agents["gb"].allocate = fail_mid_admission
+    eng._run_gang(rng)
+    assert eng.pods_created == 2, "both member pods must have been placed"
+    assert eng.gangs_admitted == 0
+    assert eng.gangs_failed == 1
+    assert eng.invariant_violations == 0
+    assert eng.partial_gang_violations == 0
+    assert eng.registry.total_held() == 0, "rollback leaked chips"
+    assert client.list("v1", "Pod", CHURN_NS) == [], "rollback leaked pods"
+    # recovery: the member heals and the same gang shape admits
+    eng.agents["gb"].allocate = orig_allocate
+    eng._run_gang(rng)
+    assert eng.gangs_admitted == 1
+    assert eng.registry.total_held() == 2 * eng.chips_per_host
+
+
+def test_engine_scoring_prefers_contiguous_fit():
+    """Placement scoring: a host whose free chips hold a contiguous
+    block for the request beats a fragmented host with more free
+    chips."""
+    client = _fake_cluster_client()
+    eng = ChurnEngine(client, ["frag", "tight"], workers=1, seed=5)
+    # frag: 3 free chips, pairwise disconnected in 2x4 ({0,3,5} =
+    # (0,0)/(0,3)/(1,1)); tight: 2 free chips forming a contiguous pair
+    eng.registry.hold("frag", eng.resource, "x1", ["1", "2", "4", "6", "7"])
+    eng.registry.hold(
+        "tight", eng.resource, "x2", ["0", "1", "2", "3", "4", "5"]
+    )
+    import random
+
+    assert eng._score("frag", 2)[0] == 1  # no contiguous pair
+    assert eng._score("tight", 2)[0] == 0
+    # contiguity beats the bigger free count
+    assert eng._pick_hosts(2, 1, random.Random(0)) == ["tight"]
+
+
+# -- kubelet sim registry integration (real gRPC) ---------------------------
+
+
+NODE = "sched-node-1"
+
+
+@pytest.fixture()
+def rig(tmp_path):
+    """kubesim + kubelet device manager (with ledger) + real plugin."""
+    server = KubeSimServer(KubeSim(bookmark_interval_s=1.0)).start()
+    client = make_client(server.port)
+    client.GET_RETRY_BACKOFF_S = 0.05
+    seed_cluster(client, NS, node_names=(NODE,))
+    registry = AllocationRegistry()
+    socket_dir = str(tmp_path / "kubelet")
+    kubelet = KubeletDeviceManager(client, NODE, socket_dir, registry=registry)
+    kubelet.start()
+    servicer = SyntheticChipServicer(
+        chips=4,
+        generation="v5e",
+        host_topology="2x2",
+        cdi_enabled=True,
+        poll_interval_s=0.2,
+        health_probe_interval_s=3600,
+    )
+    plugin = DevicePluginServer(servicer, socket_dir=socket_dir)
+    plugin.start()
+    plugin.register_with_kubelet(kubelet.kubelet_socket)
+    assert wait_until(
+        lambda: (
+            client.get("v1", "Node", NODE)
+            .get("status", {})
+            .get("allocatable", {})
+            .get(consts.TPU_RESOURCE)
+        )
+        == "4"
+    )
+    yield client, kubelet, servicer, plugin, registry, socket_dir
+    plugin.stop()
+    kubelet.stop()
+    server.stop()
+
+
+def _mk_pod(client, name, ns=NS):
+    client.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"nodeName": NODE},
+        }
+    )
+    return {"uid": f"{ns}/{name}", "namespace": ns, "name": name}
+
+
+def test_kubelet_allocate_records_and_releases(rig):
+    client, kubelet, servicer, plugin, registry, _ = rig
+    pod = _mk_pod(client, "alloc-a")
+    kubelet.allocate(consts.TPU_RESOURCE, 2, pod=pod)
+    assert registry.total_held() == 2
+    # held chips leave the next offer: 3 more would exceed free
+    pod_b = _mk_pod(client, "alloc-b")
+    with pytest.raises(RuntimeError, match="only 2 allocatable"):
+        kubelet.allocate(consts.TPU_RESOURCE, 3, pod=pod_b)
+    kubelet.allocate(consts.TPU_RESOURCE, 2, pod=pod_b)
+    assert registry.total_held() == 4
+    with pytest.raises(RuntimeError, match="only 0 allocatable"):
+        kubelet.allocate(consts.TPU_RESOURCE, 1, pod=_mk_pod(client, "alloc-c"))
+    # termination releases; steady state returns to zero held
+    assert kubelet.release_pod(pod["uid"]) == 2
+    assert kubelet.release_pod(pod_b["uid"]) == 2
+    assert registry.total_held() == 0
+
+
+def test_kubelet_allocate_releases_pod_deleted_mid_allocation(rig):
+    """Satellite contract: a pod deleted while its allocation is in
+    flight must not leak a reservation — the kubelet sim releases on
+    detection and fails the admission cleanly."""
+    client, kubelet, servicer, plugin, registry, _ = rig
+    pod = _mk_pod(client, "doomed")
+    client.delete_if_exists("v1", "Pod", "doomed", NS)
+    with pytest.raises(PodGoneError, match="released 2"):
+        kubelet.allocate(consts.TPU_RESOURCE, 2, pod=pod)
+    assert registry.total_held() == 0, "deleted pod leaked its reservation"
+    # the registry steady-state-zero assertion the churn wave relies on
+    survivor = _mk_pod(client, "survivor")
+    kubelet.allocate(consts.TPU_RESOURCE, 1, pod=survivor)
+    client.delete_if_exists("v1", "Pod", "survivor", NS)
+    kubelet.release_pod(survivor["uid"])
+    assert registry.total_held() == 0
+
+
+def test_reregistration_mid_churn_completes_or_fails_cleanly(rig, tmp_path):
+    """Satellite contract: plugin re-registration and a kubelet-sim
+    restart mid-churn. Every in-flight allocation either completes (and
+    its chips are held under the live generation) or fails cleanly
+    (StaleGenerationError / transport error, nothing recorded); chips
+    are never marked held on a plugin generation that no longer
+    exists."""
+    client, kubelet, servicer, plugin, registry, socket_dir = rig
+    stop = threading.Event()
+    succeeded = []
+    clean_failures = []
+    bad = []
+
+    def hammer(widx):
+        import grpc as _grpc
+
+        i = 0
+        while not stop.is_set():
+            key = f"h-{widx}-{i}"
+            i += 1
+            try:
+                kubelet.allocate(
+                    consts.TPU_RESOURCE, 1, pod={"uid": key}
+                )
+                succeeded.append(key)
+                time.sleep(0.002)
+                kubelet.release_pod(key)
+            except StaleGenerationError:
+                clean_failures.append(key)
+            except (RuntimeError, _grpc.RpcError):
+                clean_failures.append(key)
+            except Exception as e:  # pragma: no cover - failure detail
+                bad.append((key, repr(e)))
+
+    threads = [
+        threading.Thread(target=hammer, args=(w,), daemon=True)
+        for w in range(3)
+    ]
+    for t in threads:
+        t.start()
+    # re-register the plugin (same socket name, fresh generation) twice
+    for _ in range(2):
+        time.sleep(0.3)
+        plugin.register_with_kubelet(kubelet.kubelet_socket)
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not bad, bad
+    assert len(succeeded) > 0
+    for key in succeeded:
+        kubelet.release_pod(key)  # idempotent for already-released
+    assert registry.total_held() == 0, (
+        "chips held under a dead plugin generation"
+    )
+
+    # kubelet-sim restart mid-churn: a NEW device manager binds the
+    # socket, the plugin re-dials ListAndWatch via re-registration, and
+    # capacity is re-derived from the fresh advertisement
+    kubelet.stop()
+    kubelet2 = KubeletDeviceManager(
+        client, NODE, socket_dir, registry=registry
+    )
+    kubelet2.start()
+    try:
+        plugin.register_with_kubelet(kubelet2.kubelet_socket)
+        # wait on the NEW kubelet's own advertisement mirror (the node
+        # status already reads "4" from the old kubelet's last write)
+        assert wait_until(
+            lambda: sum(
+                1
+                for h in kubelet2.resources.get(
+                    consts.TPU_RESOURCE, {}
+                ).values()
+                if h == "Healthy"
+            )
+            == 4
+        )
+        pod = _mk_pod(client, "post-restart")
+        kubelet2.allocate(consts.TPU_RESOURCE, 2, pod=pod)
+        assert registry.total_held() == 2
+        kubelet2.release_pod(pod["uid"])
+        assert registry.total_held() == 0
+    finally:
+        kubelet2.stop()

@@ -169,6 +169,37 @@ class OperatorMetrics:
             "Escalation steps executed by the remediation FSM "
             "(operand restarts + cordon-drains) since process start",
         )
+        # allocation traffic (schedsim churn engine, the device-plugin
+        # path's foreground workload): admission volume/outcomes, gang
+        # holds taken, fleet fragmentation, and the p99 the bench-alloc
+        # gate rides. Gauges fed from the engine's own counters (the
+        # render_cache_invalidations convention) whenever it runs.
+        self.alloc_requests = g(
+            "alloc_requests",
+            "Allocation requests admitted through the device-plugin path "
+            "(successes + failures + cancellations) by the churn engine",
+        )
+        self.alloc_failures = g(
+            "alloc_failures",
+            "Allocation requests that failed admission (no host with "
+            "enough free chips, gang admission timeout, insufficient "
+            "chips at allocate time)",
+        )
+        self.alloc_gang_holds = g(
+            "alloc_gang_holds",
+            "Gang-admission hold sets acquired (all member hosts held "
+            "atomically) by the hold-and-release coordinator",
+        )
+        self.alloc_fragmentation_pct = g(
+            "alloc_fragmentation_pct",
+            "Fleet fragmentation: percent of free chips outside their "
+            "host's largest ICI-contiguous free block (last sample)",
+        )
+        self.alloc_latency_ms_p99 = g(
+            "alloc_latency_ms_p99",
+            "p99 device-plugin allocation latency (GetPreferredAllocation "
+            "-> Allocate -> ledger hold) in milliseconds",
+        )
         # informer health (client-go reflector resync analogue): nonzero
         # means a watch stream silently swallowed an event and the
         # periodic re-list repaired the cache

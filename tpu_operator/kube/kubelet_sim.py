@@ -44,15 +44,115 @@ log = logging.getLogger("tpu-kubelet-sim")
 HEALTHY = "Healthy"
 
 
+class StaleGenerationError(RuntimeError):
+    """The plugin re-registered while an allocation was in flight: the
+    admission was answered by a plugin generation that no longer exists,
+    so the chips are NOT recorded as held — the caller retries against
+    the fresh registration."""
+
+
+class PodGoneError(RuntimeError):
+    """The pod was deleted mid-allocation: its chips were released the
+    moment the race was detected (a dead pod must never leak a
+    reservation through a churn wave)."""
+
+
+class InProcessPluginStub:
+    """The ``DevicePluginStub`` call surface over an in-process servicer
+    — the real RPC handlers invoked as direct calls, no socket. The
+    scheduling-churn engine runs one real ``TPUDevicePluginServicer``
+    per simulated host at fleet scale, where a thousand gRPC servers
+    (8 worker threads each) would measure the transport, not the
+    allocator."""
+
+    def __init__(self, servicer):
+        self._servicer = servicer
+
+    def GetDevicePluginOptions(self, request, timeout=None):
+        return self._servicer.GetDevicePluginOptions(request, None)
+
+    def GetPreferredAllocation(self, request, timeout=None):
+        return self._servicer.GetPreferredAllocation(request, None)
+
+    def Allocate(self, request, timeout=None):
+        return self._servicer.Allocate(request, None)
+
+
+def admit_and_allocate(stub, resource: str, available, count: int, must):
+    """The kubelet device-manager admission sequence against one plugin
+    endpoint: GetDevicePluginOptions → GetPreferredAllocation (when
+    offered, with the fail-closed preference checks a real kubelet
+    applies) → Allocate. ``available`` is the allocatable-and-unheld id
+    list the caller computed; ``must`` ⊆ available is the caller's
+    contract. Returns ``(chosen_ids, AllocateResponse)``.
+
+    Shared by the gRPC :class:`KubeletDeviceManager` and the churn
+    engine's in-process host agents so the two admission paths cannot
+    drift."""
+    opts = stub.GetDevicePluginOptions(pb2.Empty())
+    # default (no preference): must-include devices first, like the
+    # kubelet's allocator — the non-preference path must not silently
+    # drop them either
+    chosen = (list(must) + [i for i in available if i not in must])[:count]
+    if opts.get_preferred_allocation_available:
+        req = pb2.GetPreferredAllocationRequest()
+        creq = req.container_requests.add()
+        creq.available_deviceIDs.extend(available)
+        creq.must_include_deviceIDs.extend(must)
+        creq.allocation_size = count
+        pref = stub.GetPreferredAllocation(req)
+        if pref.container_responses:
+            ids = list(pref.container_responses[0].deviceIDs)
+            if ids:
+                # fail closed, like the kubelet's device manager: a
+                # preference outside the offered available set, one
+                # that drops a must-include device, or one of the
+                # wrong size is a plugin bug — "admitting" it would
+                # hide exactly the class of bug this sim exists to
+                # catch (round-3 verdict weak #5)
+                bad = [i for i in ids if i not in available]
+                if bad:
+                    raise RuntimeError(
+                        f"{resource}: plugin preferred unavailable "
+                        f"device(s) {bad} (available: {available})"
+                    )
+                missing = [m for m in must if m not in ids]
+                if missing:
+                    raise RuntimeError(
+                        f"{resource}: plugin preference dropped "
+                        f"must-include device(s) {missing}"
+                    )
+                if len(ids) != count:
+                    raise RuntimeError(
+                        f"{resource}: plugin preferred {len(ids)} "
+                        f"device(s), asked for {count}"
+                    )
+                chosen = ids
+    areq = pb2.AllocateRequest()
+    acreq = areq.container_requests.add()
+    acreq.devicesIDs.extend(chosen)
+    return chosen, stub.Allocate(areq)
+
+
 class KubeletDeviceManager:
     """Registration server + per-resource ListAndWatch consumers +
     capacity writer for ONE node."""
 
-    def __init__(self, client: Client, node_name: str, socket_dir: str):
+    def __init__(
+        self, client: Client, node_name: str, socket_dir: str, registry=None
+    ):
         self.client = client
         self.node_name = node_name
         self.socket_dir = socket_dir
         self.kubelet_socket = os.path.join(socket_dir, "kubelet.sock")
+        # optional schedsim.AllocationRegistry: when attached, allocate()
+        # subtracts held chips from the offer and records admitted chips
+        # under the requesting pod (the kubelet's podDevices ledger)
+        self.registry = registry
+        # the real kubelet serializes pod admission per node; without
+        # this two concurrent allocate() calls would both be offered the
+        # same free chips and the second would double-allocate
+        self._admission_lock = threading.Lock()
         # resource -> {device_id: health}
         self.resources: Dict[str, Dict[str, str]] = {}
         # resource -> generation of the latest registration. Consumers
@@ -184,8 +284,12 @@ class KubeletDeviceManager:
                             d.ID: d.health for d in resp.devices
                         }
                     self._write_node_status()
-            except grpc.RpcError:
-                pass  # fall through to the shared retry/death logic
+            except (grpc.RpcError, ValueError):
+                # RpcError: broken stream/endpoint. ValueError: grpc's
+                # "Cannot invoke RPC: Channel closed!" when stop() or a
+                # supersession closed this channel mid-dial — same
+                # disposition, fall through to the retry/death logic
+                pass
             if self._stop.is_set():
                 return
             with self._lock:
@@ -252,19 +356,41 @@ class KubeletDeviceManager:
 
     # -- admission-time allocation (what placing a pod does) -------------
     def allocate(
-        self, resource: str, count: int, must_include=()
+        self, resource: str, count: int, must_include=(), pod=None
     ) -> pb2.AllocateResponse:
         """GetPreferredAllocation (when the plugin offers it) → Allocate,
-        the kubelet's pod-admission sequence."""
+        the kubelet's pod-admission sequence.
+
+        ``pod`` (optional, requires an attached registry): a mapping with
+        ``uid`` (ledger key) and optionally ``namespace``/``name``; the
+        admitted chips are recorded under it, held chips leave the offer,
+        and two races fail *cleanly*: a plugin re-registration mid-flight
+        raises :class:`StaleGenerationError` with nothing recorded (no
+        chip may be marked held under a plugin generation that no longer
+        exists), and a pod deleted mid-allocation raises
+        :class:`PodGoneError` with its chips already released."""
+        with self._admission_lock:
+            resp = self._allocate_locked(resource, count, must_include, pod)
+        # the pod-gone probe is apiserver I/O: OUTSIDE the admission
+        # lock (same reasoning as the churn HostAgent) so one slow GET
+        # can't serialize every admission on this node behind it
+        self._probe_pod_gone(pod)
+        return resp
+
+    def _allocate_locked(self, resource, count, must_include, pod):
         with self._lock:
             channel = self._channels.get(resource)
             devices = dict(self.resources.get(resource, {}))
+            gen = self._generations.get(resource)
         if channel is None:
             raise RuntimeError(f"no registered plugin for {resource}")
         stub = grpc_glue.DevicePluginStub(channel)
         healthy = sorted(
             (i for i, h in devices.items() if h == HEALTHY), key=str
         )
+        if self.registry is not None:
+            held = self.registry.held_ids(self.node_name, resource)
+            healthy = [i for i in healthy if i not in held]
         if len(healthy) < count:
             raise RuntimeError(
                 f"{resource}: want {count}, only {len(healthy)} allocatable"
@@ -285,46 +411,73 @@ class KubeletDeviceManager:
                 f"{resource}: must_include lists {len(must)} device(s) "
                 f"but only {count} requested"
             )
-        opts = stub.GetDevicePluginOptions(pb2.Empty())
-        # default (no preference): must-include devices first, like the
-        # kubelet's allocator — the non-preference path must not silently
-        # drop them either
-        chosen = (must + [i for i in healthy if i not in must])[:count]
-        if opts.get_preferred_allocation_available:
-            req = pb2.GetPreferredAllocationRequest()
-            creq = req.container_requests.add()
-            creq.available_deviceIDs.extend(healthy)
-            creq.must_include_deviceIDs.extend(must)
-            creq.allocation_size = count
-            pref = stub.GetPreferredAllocation(req)
-            if pref.container_responses:
-                ids = list(pref.container_responses[0].deviceIDs)
-                if ids:
-                    # fail closed, like the kubelet's device manager: a
-                    # preference outside the offered available set, one
-                    # that drops a must-include device, or one of the
-                    # wrong size is a plugin bug — "admitting" it would
-                    # hide exactly the class of bug this sim exists to
-                    # catch (round-3 verdict weak #5)
-                    bad = [i for i in ids if i not in healthy]
-                    if bad:
-                        raise RuntimeError(
-                            f"{resource}: plugin preferred unavailable "
-                            f"device(s) {bad} (available: {healthy})"
-                        )
-                    missing = [m for m in must if m not in ids]
-                    if missing:
-                        raise RuntimeError(
-                            f"{resource}: plugin preference dropped "
-                            f"must-include device(s) {missing}"
-                        )
-                    if len(ids) != count:
-                        raise RuntimeError(
-                            f"{resource}: plugin preferred {len(ids)} "
-                            f"device(s), asked for {count}"
-                        )
-                    chosen = ids
-        areq = pb2.AllocateRequest()
-        acreq = areq.container_requests.add()
-        acreq.devicesIDs.extend(chosen)
-        return stub.Allocate(areq)
+        try:
+            chosen, resp = admit_and_allocate(
+                stub, resource, healthy, count, must
+            )
+        except ValueError as e:
+            # grpc raises ValueError (not RpcError) when a re-registration
+            # closed this channel between our snapshot and the call: the
+            # generation we admitted against is gone — same clean-failure
+            # contract as the post-allocate fence below
+            raise StaleGenerationError(
+                f"{resource}: plugin channel closed mid-allocation ({e})"
+            ) from e
+        self._record_allocation(resource, chosen, gen, pod)
+        return resp
+
+    def _record_allocation(self, resource, chosen, gen, pod) -> None:
+        if self.registry is None or pod is None:
+            return
+        pod_key = pod["uid"]
+        with self._lock:
+            if self._generations.get(resource) != gen:
+                # the plugin re-registered while this allocation was in
+                # flight: the Allocate answer came from a generation
+                # that no longer exists — recording it would mark chips
+                # held on a dead plugin. Fail cleanly instead.
+                raise StaleGenerationError(
+                    f"{resource}: plugin re-registered mid-allocation "
+                    f"(generation {gen} superseded); not recorded"
+                )
+            self.registry.hold(
+                self.node_name,
+                resource,
+                pod_key,
+                chosen,
+                gang_id=pod.get("gang_id") if hasattr(pod, "get") else None,
+                generation=gen,
+            )
+
+    def _probe_pod_gone(self, pod) -> None:
+        """Pod deleted mid-allocation: a dead pod must not leak its
+        reservation through a churn wave — release on detection. A
+        FAILED probe reads as alive (the hold stands; the normal
+        termination path releases it)."""
+        if self.registry is None or pod is None:
+            return
+        name = pod.get("name") if hasattr(pod, "get") else None
+        if not name:
+            return
+        try:
+            gone = (
+                self.client.get_or_none(
+                    "v1", "Pod", name, pod.get("namespace", "")
+                )
+                is None
+            )
+        except Exception:
+            return
+        if gone:
+            freed = self.registry.release_pod(pod["uid"])
+            raise PodGoneError(
+                f"pod {pod.get('namespace', '')}/{name} deleted "
+                f"mid-allocation; released {freed} chip(s)"
+            )
+
+    def release_pod(self, pod_key: str) -> int:
+        """Pod-termination hook: free the pod's chips from the ledger
+        (idempotent; 0 when nothing was held)."""
+        if self.registry is None:
+            return 0
+        return self.registry.release_pod(pod_key)

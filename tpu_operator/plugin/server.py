@@ -46,6 +46,15 @@ HEALTHY = "Healthy"
 UNHEALTHY = "Unhealthy"
 
 
+def _chip_id_sort_key(dev_id: str):
+    """Order device ids numerically when they are numbers, lexically
+    after them otherwise — a total order that never raises."""
+    try:
+        return (0, int(dev_id), "")
+    except (TypeError, ValueError):
+        return (1, 0, str(dev_id))
+
+
 class TPUDevicePluginServicer:
     """DevicePlugin service implementation."""
 
@@ -191,6 +200,14 @@ class TPUDevicePluginServicer:
             except Exception:
                 log.exception("device re-enumeration failed")
 
+    def snapshot(self) -> Dict[str, str]:
+        """Advertisement snapshot ``{device_id: health}`` for in-process
+        embedders (the scheduling-churn engine's host agents drive the
+        real RPC handlers without a gRPC stream; they read the device
+        set here instead of holding a ListAndWatch per host)."""
+        with self._cond:
+            return {i: d.health for i, d in self._devices.items()}
+
     def device_probe(self, dev_id: str) -> bool:
         """Open-probe one advertised device at the path recorded when it
         was discovered; existence is not liveness, and a fresh positional
@@ -263,9 +280,63 @@ class TPUDevicePluginServicer:
             last_sent = ver
 
     def GetPreferredAllocation(self, request, context):
+        """Defensive contract: this RPC sits on the kubelet's pod-admission
+        path, so a malformed or stale request must get a well-formed —
+        possibly partial or empty — response, never a mid-RPC exception
+        that fails admission for reasons the kubelet can't distinguish
+        from a dead plugin. Specifically: zero/negative sizes answer an
+        empty preference; ids that aren't integers (fallback registries)
+        skip chip-coordinate topology and take the naive must-first fill;
+        a size no contiguous group (or even the whole offer) can cover
+        returns the honest short answer; and must-include devices that
+        have since vanished from both the offer and the device registry
+        are dropped rather than crashing the selection — the kubelet's
+        own fail-closed checks then decide the allocation's fate."""
         resp = pb2.GetPreferredAllocationResponse()
         for creq in request.container_requests:
-            avail_set = {int(i) for i in creq.available_deviceIDs}
+            cresp = resp.container_responses.add()
+            try:
+                chosen = self._preferred_one(creq)
+            except Exception:
+                # last-resort guard: degrade to the naive fill rather
+                # than poison the RPC (and with it every allocation the
+                # kubelet routes here)
+                log.exception(
+                    "GetPreferredAllocation degraded to naive selection"
+                )
+                chosen = self._naive_fill(creq)
+            cresp.deviceIDs.extend(chosen)
+        return resp
+
+    @staticmethod
+    def _naive_fill(creq) -> List[str]:
+        """must-first best-fill on raw string ids — the selection that
+        cannot fail, shared by the non-numeric-id path and the defensive
+        catch-all."""
+        size = max(creq.allocation_size, 0)
+        offered = list(dict.fromkeys(str(i) for i in creq.available_deviceIDs))
+        offered_set = set(offered)
+        must = [
+            i
+            for i in dict.fromkeys(
+                str(i) for i in creq.must_include_deviceIDs
+            )
+            if i in offered_set
+        ]
+        if len(must) > size:
+            # contract violation (must > size): a preferred set must
+            # contain every must id, so return them all unranked rather
+            # than silently truncating
+            return must
+        must_set = set(must)
+        return (must + [i for i in offered if i not in must_set])[:size]
+
+    def _preferred_one(self, creq) -> List[str]:
+        """Preference for one container request; returns string ids."""
+        size = max(creq.allocation_size, 0)
+        offered = {str(i) for i in creq.available_deviceIDs}
+        try:
+            avail_set = {int(i) for i in offered}
             # the kubelet contract guarantees must ⊆ available; enforce it
             # defensively — never recommend a device we weren't offered
             must = [
@@ -273,49 +344,50 @@ class TPUDevicePluginServicer:
                 for i in (int(i) for i in creq.must_include_deviceIDs)
                 if i in avail_set
             ]
-            use_topology = bool(self.host_topology)
-            if use_topology:
-                # drop ids outside the labeled topology on EVERY path (the
-                # fallback too) — never recommend a device that can't
-                # exist; host_topology was validated in __init__. But ids
-                # the plugin itself advertised must survive: if a
-                # must-include id (or the whole set) falls outside the
-                # mesh, these ids aren't chip coordinates (e.g. vfio
-                # group numbers) — degrade to naive instead of dropping
-                # kubelet-required devices.
-                n_total = topo.chip_count(self.host_topology)
-                filtered = {i for i in avail_set if 0 <= i < n_total}
-                if filtered and set(must) <= filtered:
-                    avail_set = filtered
-                else:
-                    use_topology = False
-            available = sorted(avail_set)
-            size = creq.allocation_size
-            chosen = None
-            if use_topology:
-                chosen = topo.pick_chips(
-                    self.host_topology,
-                    self.generation or "v5e",
-                    size,
-                    available,
-                    must_include=must,
-                )
-            if chosen is None:
-                must_set = set(must)
-                if len(must_set) > size:
-                    # contract violation (must > size): a preferred set
-                    # must contain every must id, so return them all
-                    # unranked rather than silently truncating
-                    chosen = sorted(must_set)
-                else:
-                    # must ∪ best-fill, deduped, when topology can't help
-                    pool = sorted(must_set) + [
-                        i for i in sorted(avail_set) if i not in must_set
-                    ]
-                    chosen = pool[:size]
-            cresp = resp.container_responses.add()
-            cresp.deviceIDs.extend(str(i) for i in sorted(chosen))
-        return resp
+        except ValueError:
+            # non-numeric ids (a fallback registry naming devices, not
+            # indexing chips): no geometry to reason about
+            return self._naive_fill(creq)
+        if size == 0 and not must:
+            return []
+        use_topology = bool(self.host_topology)
+        if use_topology:
+            # drop ids outside the labeled topology on EVERY path (the
+            # fallback too) — never recommend a device that can't
+            # exist; host_topology was validated in __init__. But ids
+            # the plugin itself advertised must survive: if a
+            # must-include id (or the whole set) falls outside the
+            # mesh, these ids aren't chip coordinates (e.g. vfio
+            # group numbers) — degrade to naive instead of dropping
+            # kubelet-required devices.
+            n_total = topo.chip_count(self.host_topology)
+            filtered = {i for i in avail_set if 0 <= i < n_total}
+            if filtered and set(must) <= filtered:
+                avail_set = filtered
+            else:
+                use_topology = False
+        available = sorted(avail_set)
+        chosen = None
+        if use_topology and size > 0:
+            chosen = topo.pick_chips(
+                self.host_topology,
+                self.generation or "v5e",
+                size,
+                available,
+                must_include=must,
+            )
+        if chosen is None:
+            must_set = set(must)
+            if len(must_set) > size:
+                # contract violation: see _naive_fill
+                chosen = sorted(must_set)
+            else:
+                # must ∪ best-fill, deduped, when topology can't help
+                pool = sorted(must_set) + [
+                    i for i in sorted(avail_set) if i not in must_set
+                ]
+                chosen = pool[:size]
+        return [str(i) for i in sorted(chosen)]
 
     def Allocate(self, request, context):
         resp = pb2.AllocateResponse()
@@ -353,7 +425,13 @@ class TPUDevicePluginServicer:
                 mount.container_path = "/usr/lib/tpu"
                 mount.read_only = True
             env = dict(self.slice_env)
-            env["TPU_CHIPS_VISIBLE"] = ",".join(sorted(ids, key=int))
+            # numeric ids sort numerically; non-numeric ids (fallback
+            # registries) sort after them lexically — int() alone would
+            # crash Allocate for exactly the id class
+            # GetPreferredAllocation just learned to tolerate
+            env["TPU_CHIPS_VISIBLE"] = ",".join(
+                sorted(ids, key=_chip_id_sort_key)
+            )
             if self.host_topology:
                 env["TPU_HOST_TOPOLOGY"] = self.host_topology
             if self.generation:

@@ -1,0 +1,22 @@
+"""Scheduling-churn engine — allocation traffic through the device-plugin path.
+
+The subsystem the convergence/remediation stack never exercised: sustained
+foreground *allocation* traffic (short-lived pods requesting
+``google.com/tpu`` chips) driven through the real device-plugin admission
+sequence, with gang admission for multi-host slice jobs, ICI-topology-aware
+placement scoring, and fleet fragmentation accounting. See
+``docs/allocation.md``.
+
+Layout:
+
+* ``registry``  — fleet-wide chip ledger (double-allocation detection,
+  leak accounting, fragmentation math);
+* ``gang``      — bounded hold-and-release gang admission coordinator;
+* ``engine``    — the load generator: per-host agents over real plugin
+  servicers, placement scoring, latency percentiles, reaper.
+"""
+
+from tpu_operator.schedsim.registry import (  # noqa: F401
+    AllocationRegistry,
+    DoubleAllocationError,
+)

@@ -1,0 +1,241 @@
+"""Fleet-wide chip-allocation ledger + fragmentation math.
+
+The correctness spine of the scheduling-churn engine: every admitted
+allocation records its chips here, every pod termination releases them,
+and the two invariants the churn harness exists to prove are enforced at
+the ledger, not asserted after the fact —
+
+* **no chip double-allocated**: a hold naming a chip another pod already
+  holds raises :class:`DoubleAllocationError` (and counts, so a bench
+  can assert the counter stayed zero);
+* **no leaked reservations**: once every pod of a churn wave terminates,
+  ``total_held()`` must read zero — the steady-state check both the
+  tier-1 engine test and the 1000-node bench gate on.
+
+Fragmentation is defined over this ledger too (``fragmentation_pct``):
+the share of free chips NOT inside their host's largest ICI-connected
+free block — 0 when every host's free chips form one connected region,
+growing as churn shreds hosts into disconnected leftovers that can only
+serve small or non-contiguous requests. See ``docs/allocation.md``.
+
+No k8s imports here: the ledger is shared by the kubelet device-manager
+simulator (``kube/kubelet_sim.py``) and the in-process churn agents.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import lru_cache
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tpu_operator.workloads import topology as topo
+
+
+class DoubleAllocationError(AssertionError):
+    """A chip was offered/held twice — the invariant violation the churn
+    harness exists to catch. AssertionError subclass on purpose: this is
+    a bug in the admission path, never a load condition to retry."""
+
+
+class AllocationRegistry:
+    """Thread-safe ledger of (node, resource) → chip → holder."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (node, resource) -> {device_id: pod_key}
+        self._held: Dict[Tuple[str, str], Dict[str, str]] = {}
+        # pod_key -> [(node, resource, ids)]
+        self._pods: Dict[str, List[Tuple[str, str, Tuple[str, ...]]]] = {}
+        self._gang_of: Dict[str, str] = {}
+        # holder generation at record time (observability: a hold must
+        # only ever be recorded under the plugin generation that
+        # admitted it — the fence lives in the kubelet sim)
+        self._gen_of: Dict[str, object] = {}
+        self.holds_total = 0
+        self.releases_total = 0
+        self.chips_held_peak = 0
+        self.double_allocation_attempts = 0
+
+    # -- hold / release --------------------------------------------------
+    def hold(
+        self,
+        node: str,
+        resource: str,
+        pod_key: str,
+        device_ids: Iterable[str],
+        gang_id: Optional[str] = None,
+        generation: object = None,
+    ) -> None:
+        ids = tuple(str(i) for i in device_ids)
+        with self._lock:
+            slot = self._held.setdefault((node, resource), {})
+            clash = [i for i in ids if i in slot]
+            if clash or len(set(ids)) != len(ids):
+                self.double_allocation_attempts += 1
+                holders = sorted({slot[i] for i in clash}) or [pod_key]
+                raise DoubleAllocationError(
+                    f"chip(s) {clash or sorted(ids)} on {node} already "
+                    f"held by {holders}; refused for {pod_key}"
+                )
+            for i in ids:
+                slot[i] = pod_key
+            self._pods.setdefault(pod_key, []).append((node, resource, ids))
+            if gang_id:
+                self._gang_of[pod_key] = gang_id
+            if generation is not None:
+                self._gen_of[pod_key] = generation
+            self.holds_total += 1
+            self.chips_held_peak = max(
+                self.chips_held_peak, self._total_held_locked()
+            )
+
+    def release_pod(self, pod_key: str) -> int:
+        """Free every chip ``pod_key`` holds; returns chips freed (0 when
+        the pod held nothing — release is idempotent, termination paths
+        race)."""
+        with self._lock:
+            entries = self._pods.pop(pod_key, [])
+            self._gang_of.pop(pod_key, None)
+            self._gen_of.pop(pod_key, None)
+            freed = 0
+            for node, resource, ids in entries:
+                slot = self._held.get((node, resource), {})
+                for i in ids:
+                    if slot.get(i) == pod_key:
+                        del slot[i]
+                        freed += 1
+                if not slot:
+                    self._held.pop((node, resource), None)
+            if entries:
+                self.releases_total += 1
+            return freed
+
+    # -- views -----------------------------------------------------------
+    def held_ids(self, node: str, resource: str) -> Set[str]:
+        with self._lock:
+            return set(self._held.get((node, resource), {}))
+
+    def holder_of(self, node: str, resource: str, dev_id: str):
+        with self._lock:
+            return self._held.get((node, resource), {}).get(str(dev_id))
+
+    def _total_held_locked(self) -> int:
+        return sum(len(s) for s in self._held.values())
+
+    def total_held(self) -> int:
+        with self._lock:
+            return self._total_held_locked()
+
+    def pods_holding(self) -> int:
+        with self._lock:
+            return len(self._pods)
+
+    def holding_pod_keys(self) -> List[str]:
+        """Every pod key currently holding chips (the drain sweep's
+        worklist)."""
+        with self._lock:
+            return sorted(self._pods)
+
+    def pods_of_gang(self, gang_id: str) -> List[str]:
+        with self._lock:
+            return sorted(
+                p for p, g in self._gang_of.items() if g == gang_id
+            )
+
+    def generation_of(self, pod_key: str):
+        with self._lock:
+            return self._gen_of.get(pod_key)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "chips_held": self._total_held_locked(),
+                "chips_held_peak": self.chips_held_peak,
+                "pods_holding": len(self._pods),
+                "holds_total": self.holds_total,
+                "releases_total": self.releases_total,
+                "double_allocation_attempts": self.double_allocation_attempts,
+            }
+
+
+# -- fragmentation math ----------------------------------------------------
+
+
+@lru_cache(maxsize=32)
+def _adjacency(topology: str, generation: str) -> Tuple[Tuple[int, ...], ...]:
+    """Chip-index adjacency list for one host mesh, memoized: the BFS
+    below runs per candidate host per placement AND per host per
+    fragmentation sample — re-parsing the topology string inside every
+    neighbors() call was tens of thousands of redundant parses per
+    second on the allocation hot path."""
+    dims = topo.parse_topology(topology)
+    return tuple(
+        tuple(
+            topo.coord_to_index(nb, dims)
+            for nb in topo.neighbors(
+                topo.index_to_coord(i, dims), topology, generation
+            )
+        )
+        for i in range(topo.chip_count(topology))
+    )
+
+
+def largest_contiguous_block(
+    free_ids: Iterable, topology: str, generation: str
+) -> int:
+    """Size of the biggest ICI-connected component of ``free_ids`` in the
+    host mesh. Ids outside the mesh (fallback registries) count as
+    singleton blocks — no geometry means no contiguity to lose."""
+    adjacency = _adjacency(topology, generation)
+    n_total = len(adjacency)
+    free: Set[int] = set()
+    strays = 0
+    for i in free_ids:
+        try:
+            idx = int(i)
+        except (TypeError, ValueError):
+            strays += 1
+            continue
+        if 0 <= idx < n_total:
+            free.add(idx)
+        else:
+            strays += 1
+    best = 1 if strays else 0
+    seen: Set[int] = set()
+    for seed in free:
+        if seed in seen:
+            continue
+        comp = {seed}
+        frontier = [seed]
+        while frontier:
+            cur = frontier.pop()
+            for nb_idx in adjacency[cur]:
+                if nb_idx in free and nb_idx not in comp:
+                    comp.add(nb_idx)
+                    frontier.append(nb_idx)
+        seen |= comp
+        best = max(best, len(comp))
+    return best
+
+
+def fragmentation_pct(
+    free_sets: Iterable[Iterable], topology: str, generation: str
+) -> float:
+    """Fleet fragmentation over per-host free-chip sets: ``100 × (1 −
+    Σ largest_block / Σ free)``. 0.0 when every host's free chips form
+    one connected block (an empty fleet reads 0.0 too — nothing free
+    means nothing fragmented); approaches 100 as churn strands free
+    chips in disconnected singletons."""
+    free_total = 0
+    contiguous_total = 0
+    for free in free_sets:
+        free = list(free)
+        if not free:
+            continue
+        free_total += len(free)
+        contiguous_total += largest_contiguous_block(
+            free, topology, generation
+        )
+    if free_total == 0:
+        return 0.0
+    return round(100.0 * (1.0 - contiguous_total / free_total), 2)

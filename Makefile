@@ -28,7 +28,7 @@ DOCKER_PUSH_TARGETS = $(patsubst %,docker-push-%,$(IMAGES))
 # variable expands to nothing and silently un-phonies the fan-out
 .PHONY: all native test crd bundle release-bundle validate lint clean \
 	dev-run dev-run-kubesim soak bench bench-gate bench-converge \
-	bench-alloc chaos-fast \
+	bench-alloc chaos-fast chaos-soak-fast chaos-soak \
 	builder docker-build \
 	docker-push $(DOCKER_BUILD_TARGETS) $(DOCKER_PUSH_TARGETS)
 
@@ -64,8 +64,11 @@ validate:
 	python -m tpu_operator.cfg.main validate chart --dir deployments/tpu-operator
 	python -m tpu_operator.cfg.main validate csv --input bundle/manifests/tpu-operator.clusterserviceversion.yaml
 	python -m tpu_operator.cfg.main validate bundle --dir bundle
+	$(MAKE) bench-gate
 	$(MAKE) bench-converge
 	$(MAKE) bench-alloc
+	$(MAKE) chaos-fast
+	$(MAKE) chaos-soak-fast
 
 # per-image build/push fan-out; `make docker-build DIST=multi-arch
 # PUSH_ON_BUILD=true` is the release pipeline
@@ -111,6 +114,17 @@ bench-alloc:
 # fast enough for every PR, unlike the randomized soak
 chaos-fast:
 	python -m pytest tests/test_fault_matrix.py tests/test_remediation_matrix.py -q -p no:cacheprovider
+
+# CI lifecycle gate: short fixed-seed chaos soaks (joins, preemptions,
+# chip faults, apiserver faults, one live re-partition, schedsim churn)
+# with the invariant checker on, plus the seed-replay regression — the
+# same seed must reproduce the identical event schedule
+chaos-soak-fast:
+	python -m pytest tests/test_chaos_soak.py tests/test_lifecycle.py tests/test_repartition.py -q -m 'not slow' -p no:cacheprovider
+
+# the 1000-node acceptance soak (slow; not part of validate)
+chaos-soak:
+	python -m pytest tests/test_chaos_soak.py -q -m slow -p no:cacheprovider
 
 # run the operator against the in-memory cluster and converge to Ready
 dev-run:

@@ -470,7 +470,11 @@ def run_convergence() -> dict:
 
 
 def run_fleet_convergence(
-    n_nodes: int = 16, bulk_pods: int = 0, timeout_s: int = 180
+    n_nodes: int = 16,
+    bulk_pods: int = 0,
+    timeout_s: int = 180,
+    join_storm: int = 0,
+    preempt_pct: float = 0.0,
 ) -> dict:
     """Fleet-scale time-to-Ready: an ``n_nodes`` pool converged by the
     full Manager against the kubesim apiserver with a faithful per-node
@@ -488,6 +492,16 @@ def run_fleet_convergence(
     ]
     if bulk_pods:
         args += ["--pods", str(bulk_pods)]
+    if join_storm:
+        args += ["--join-storm", str(join_storm)]
+    if preempt_pct:
+        args += ["--preempt-pct", str(preempt_pct)]
+    # the script applies --timeout PER PHASE (initial converge, join
+    # storm, preemption recovery each get their own deadline), so the
+    # subprocess wall budget must cover every enabled phase — a single
+    # timeout_s here would kill a run whose phases are each legal
+    phases = 1 + (1 if join_storm else 0) + (1 if preempt_pct else 0)
+    wall_timeout_s = timeout_s * phases + 60
     try:
         proc = subprocess.run(
             args,
@@ -495,12 +509,12 @@ def run_fleet_convergence(
             env=dict(os.environ, OPERATOR_NAMESPACE="tpu-operator"),
             capture_output=True,
             text=True,
-            timeout=timeout_s,
+            timeout=wall_timeout_s,
         )
     except subprocess.TimeoutExpired:
         return {
             "ok": False,
-            "error": f"fleet converge timed out after {timeout_s}s",
+            "error": f"fleet converge timed out after {wall_timeout_s}s",
         }
     try:
         out = json.loads(proc.stdout.strip().splitlines()[-1])
@@ -763,6 +777,13 @@ def main() -> int:
     # 1000-node scheduling churn through GetPreferredAllocation →
     # Allocate, concurrent with convergence + a remediation wave
     alloc_churn = run_alloc_churn()
+    # fleet-lifecycle axis (ISSUE 7): converge a small seed fleet, then
+    # join a 1000-node autoscale storm in ONE wave (labeling, validation
+    # and slice formation must pipeline) and preempt 10% of the result —
+    # join_time_to_ready_s / preempt_recover_s are the tracked metrics
+    fleet_join_storm = run_fleet_convergence(
+        n_nodes=16, join_storm=1000, preempt_pct=10.0, timeout_s=600
+    )
 
     # ICI axis last: it re-binds JAX to the CPU mesh
     ici = run_ici_on_cpu_mesh()
@@ -808,6 +829,7 @@ def main() -> int:
         "convergence_fleet_1000": fleet_1000,
         "fleet_populated_20k_pods": fleet_populated,
         "alloc_churn_1000": alloc_churn,
+        "fleet_join_storm_1000": fleet_join_storm,
         "validator_cli": validator_cli,
         "flashattn": {
             "ok": bool(fa.ok),
@@ -892,6 +914,7 @@ def main() -> int:
         and pass_gate_ok
         and fleet_populated.get("ok")
         and alloc_churn.get("ok")
+        and fleet_join_storm.get("ok")
         and validator_cli.get("ok")
         and fa.ok
         and fa_gate_ok

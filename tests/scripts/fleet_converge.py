@@ -123,9 +123,27 @@ def main(argv=None) -> int:
     p.add_argument("--alloc-rate", type=float, default=1200.0)
     p.add_argument("--alloc-workers", type=int, default=6)
     p.add_argument("--alloc-gang-frac", type=float, default=0.15)
+    p.add_argument(
+        "--join-storm",
+        type=int,
+        default=0,
+        help="after the initial fleet converges, join N more nodes in "
+        "one autoscale wave and report join_time_to_ready_s (labeling, "
+        "validation and slice formation must pipeline, not serialize)",
+    )
+    p.add_argument(
+        "--preempt-pct",
+        type=float,
+        default=0.0,
+        help="after convergence (and any join storm), delete this "
+        "percentage of the fleet in one spot-preemption wave and report "
+        "preempt_recover_s (orphaned state must reconcile)",
+    )
     args = p.parse_args(argv)
 
-    nodes = tuple(f"fleet-{i}" for i in range(args.nodes))
+    # a list, not a tuple: the join storm grows it mid-run and the
+    # kubelet sweep reads the latest membership each pass
+    nodes = [f"fleet-{i}" for i in range(args.nodes)]
     server = KubeSimServer(KubeSim()).start()
     client = make_client(server.port)
     client.GET_RETRY_BACKOFF_S = 0.05
@@ -183,6 +201,7 @@ def main(argv=None) -> int:
             seed=11,
         )
         mgr.register_debug_vars("allocation", engine.stats)
+        engine.wire_lifecycle(server.sim)
         engine.start()
 
     ok = False
@@ -194,6 +213,91 @@ def main(argv=None) -> int:
             break
         time.sleep(0.1)
     elapsed = time.monotonic() - t0
+
+    def _labels_by_name():
+        # ONE node LIST per poll: a per-node GET loop at join-storm
+        # scale would issue ~N requests every 0.2 s against the same
+        # apiserver whose convergence traffic this script measures,
+        # drowning converge_requests in harness noise
+        return {
+            n["metadata"]["name"]: (n["metadata"].get("labels") or {})
+            for n in client.list("v1", "Node")
+        }
+
+    # -- optional lifecycle axes (join storm, preemption wave) ---------
+    from tpu_operator import consts as _c
+
+    join_time_to_ready = None
+    if ok and args.join_storm > 0:
+        t_join = time.monotonic()
+        joined = server.sim.add_nodes(
+            args.join_storm, name_prefix="storm", chips=8
+        )
+        nodes.extend(joined)
+        deadline_j = time.monotonic() + args.timeout
+
+        def join_ready():
+            cp = (
+                client.get_or_none(CPV, "ClusterPolicy", "cluster-policy")
+                or {}
+            )
+            if cp.get("status", {}).get("state") != "ready":
+                return False
+            # every joined node labeled, validated, and slice-ready —
+            # the full label/validate/slice-form pipeline completed
+            labels = _labels_by_name()
+            return all(
+                labels.get(n, {}).get(_c.SLICE_READY_LABEL) == "true"
+                for n in joined
+            )
+
+        while time.monotonic() < deadline_j:
+            if join_ready():
+                join_time_to_ready = round(time.monotonic() - t_join, 2)
+                break
+            time.sleep(0.2)
+        ok = ok and join_time_to_ready is not None
+
+    preempt_recover = None
+    if ok and args.preempt_pct > 0:
+        import random as _random
+
+        t_pre = time.monotonic()
+        victims = server.sim.preemption_wave(
+            args.preempt_pct / 100.0, rng=_random.Random(4242)
+        )
+        for v in victims:
+            try:
+                nodes.remove(v)
+            except ValueError:
+                pass
+        deadline_p = time.monotonic() + args.timeout
+
+        def recovered():
+            cp = (
+                client.get_or_none(CPV, "ClusterPolicy", "cluster-policy")
+                or {}
+            )
+            status = cp.get("status", {})
+            if status.get("state") != "ready":
+                return False
+            # the status aggregate reflects the shrunken fleet and every
+            # survivor is back to slice-ready (orphaned state reconciled)
+            if status.get("slices", {}).get("total") != len(nodes):
+                return False
+            labels = _labels_by_name()
+            return all(
+                labels.get(n, {}).get(_c.SLICE_READY_LABEL) == "true"
+                for n in nodes
+            )
+
+        while time.monotonic() < deadline_p:
+            if recovered():
+                preempt_recover = round(time.monotonic() - t_pre, 2)
+                break
+            time.sleep(0.2)
+        ok = ok and preempt_recover is not None
+
     converge_requests = server.sim.requests_total()
     # write-volume view of the same converge: how many mutations it
     # took and what each one cost in wall time — the number the write
@@ -273,6 +377,10 @@ def main(argv=None) -> int:
         "nodes": args.nodes,
         "bulk_pods": args.pods,
         "time_to_ready_s": round(elapsed, 2),
+        "join_storm_nodes": args.join_storm,
+        "join_time_to_ready_s": join_time_to_ready,
+        "preempt_pct": args.preempt_pct,
+        "preempt_recover_s": preempt_recover,
         "converge_requests": converge_requests,
         "converge_writes": converge_writes,
         "converge_wall_per_write_us": converge_wall_per_write_us,

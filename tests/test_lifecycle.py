@@ -1,0 +1,289 @@
+"""Fleet-lifecycle storms: kubesim node add/delete/preemption semantics,
+and the budget-hold releases every consumer owes a vanished node — a
+node deleted mid-upgrade or mid-remediation must free its slice-unit
+disruption hold, its pods must cascade with real DELETED events, and the
+schedsim registry must drop its chips (no zombie holds)."""
+
+import os
+import random
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+from tests.conftest import make_tpu_node
+from tests.test_upgrade import driver_ds, driver_pod, validator_pod
+from tpu_operator import consts
+from tpu_operator.api.v1.clusterpolicy_types import (
+    RemediationSpec,
+    UpgradePolicySpec,
+)
+from tpu_operator.controllers.remediation import NodeRemediationController
+from tpu_operator.controllers.state_manager import has_tpu_labels
+from tpu_operator.kube import FakeClient
+from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+from tpu_operator.kube.testing import make_validator_pod, seed_cluster
+from tpu_operator.upgrade import upgrade_state as us
+
+NS = "tpu-operator"
+
+
+# ---------------------------------------------------------------------------
+# kubesim lifecycle primitives
+# ---------------------------------------------------------------------------
+
+
+def test_delete_node_cascades_pods_with_events():
+    """delete_node: one DELETED event for the node, one per bound pod
+    (the pod-GC/node-lifecycle cascade), lifecycle hooks fired — the
+    exact wire shape an informer-backed operator reconciles from."""
+    server = KubeSimServer(KubeSim()).start()
+    sim, client = server.sim, make_client(server.port)
+    try:
+        seed_cluster(client, NS, node_names=("lc-1", "lc-2"))
+        for i in range(3):
+            client.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {"name": f"lc-pod-{i}", "namespace": NS},
+                    "spec": {"nodeName": "lc-1"},
+                }
+            )
+        hooks = []
+        sim.add_lifecycle_hook(lambda e, n: hooks.append((e, n)))
+        rv_before = sim._rv
+
+        assert sim.delete_node("lc-1") is True
+        assert sim.delete_node("lc-1") is False  # idempotent verdict
+
+        deleted = [
+            (key[2], key[4])
+            for rv, etype, key, _ in sim._events
+            if rv > rv_before and etype == "DELETED"
+        ]
+        assert ("nodes", "lc-1") in deleted
+        assert {("pods", f"lc-pod-{i}") for i in range(3)} <= set(deleted)
+        assert client.get_or_none("v1", "Pod", "lc-pod-0", NS) is None
+        assert hooks == [("DELETED", "lc-1")]
+        assert sim.nodes_deleted == 1
+    finally:
+        server.stop()
+
+
+def test_join_and_preemption_wave_are_deterministic():
+    """Same seed → same join names and same preemption victims: the
+    property the chaos trace's replayability stands on."""
+
+    def build():
+        server = KubeSimServer(KubeSim()).start()
+        client = make_client(server.port)
+        seed_cluster(
+            client, NS, node_names=tuple(f"det-{i}" for i in range(6))
+        )
+        return server
+
+    a, b = build(), build()
+    try:
+        names_a = a.sim.add_nodes(3, name_prefix="wave")
+        names_b = b.sim.add_nodes(3, name_prefix="wave")
+        assert names_a == names_b == ["wave-1", "wave-2", "wave-3"]
+        va = a.sim.preemption_wave(0.25, rng=random.Random(42))
+        vb = b.sim.preemption_wave(0.25, rng=random.Random(42))
+        assert va == vb and len(va) == 3  # ceil(9 * 0.25)
+    finally:
+        a.stop()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# budget-hold release: upgrade FSM
+# ---------------------------------------------------------------------------
+
+
+def _slice_node(name, sid, hosts=2):
+    node = make_tpu_node(
+        name,
+        extra_labels={
+            consts.TFD_SLICE_ID_LABEL: sid,
+            consts.TFD_SLICE_HOSTS_LABEL: str(hosts),
+        },
+    )
+    node["metadata"]["labels"][
+        consts.DEPLOY_LABEL_PREFIX + consts.COMPONENT_LIBTPU
+    ] = "true"
+    return node
+
+
+def test_upgrade_budget_released_when_slice_vanishes_mid_roll():
+    """maxUnavailable=1 slice: slice-a holds the whole pool mid-roll;
+    a preemption wave deletes slice-a's hosts — the next build pass
+    must admit slice-b (the vanished hold released itself), and the
+    per-node drain bookkeeping for the dead hosts must be pruned."""
+    client = FakeClient(
+        [{"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}]
+    )
+    members = {
+        "slice-a": ["a-1", "a-2"],
+        "slice-b": ["b-1", "b-2"],
+    }
+    for sid, names in members.items():
+        for n in names:
+            client.create(_slice_node(n, sid))
+            client.create(driver_pod(n, "stale-hash"))
+            client.create(validator_pod(n))
+    client.create(driver_ds())
+
+    mgr = us.ClusterUpgradeStateManager(client, NS)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=8, max_unavailable=1
+    )
+    mgr.apply_state(mgr.build_state(), policy)
+    state = mgr.build_state()
+    budget = us.slice_budget(state, policy)
+    assert budget.active_sids == {"slice-a"}
+    assert budget.admit == 0  # slice-b starved behind the cap
+
+    # fake a PDB-veto record for a doomed host, then vanish the slice
+    mgr.drain.last_block_reason["a-1"] = "pdb veto"
+    for n in members["slice-a"]:
+        client.delete("v1", "Node", n)
+        for pod in client.list(
+            "v1", "Pod", NS, field_selector={"spec.nodeName": n}
+        ):
+            client.delete_if_exists(
+                "v1", "Pod", pod["metadata"]["name"], NS
+            )
+
+    state = mgr.build_state()
+    budget = us.slice_budget(state, policy)
+    assert "slice-a" not in budget.groups  # FSM entries retired
+    assert budget.admit == 1, "the vanished slice must release its hold"
+    mgr.apply_state(state, policy)
+    assert "a-1" not in mgr.drain.last_block_reason  # bookkeeping pruned
+    assert us.slice_budget(mgr.build_state(), policy).active_sids == {
+        "slice-b"
+    }
+
+
+# ---------------------------------------------------------------------------
+# budget-hold release: remediation FSM
+# ---------------------------------------------------------------------------
+
+
+def _remediation_node(name, chips="8"):
+    node = make_tpu_node(name)
+    node["status"]["capacity"]["google.com/tpu"] = "8"
+    node["status"]["allocatable"]["google.com/tpu"] = chips
+    node["metadata"]["labels"][
+        consts.DEPLOY_LABEL_PREFIX + consts.COMPONENT_OPERATOR_VALIDATOR
+    ] = "true"
+    return node
+
+
+def test_remediation_hold_released_when_node_vanishes():
+    """cap=1 slice: node-1's quarantine consumes the pool, node-2's
+    escalation defers; deleting node-1 mid-quarantine must free the
+    pool so node-2 proceeds — and the vanished node's log-once state
+    must be pruned."""
+    client = FakeClient(
+        [{"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}]
+    )
+    for i in (1, 2, 3, 4):
+        client.create(_remediation_node(f"rn-{i}"))
+        client.create(make_validator_pod(f"rn-{i}", True, NS))
+    ctrl = NodeRemediationController(client)
+    sp = RemediationSpec(
+        enabled=True,
+        max_attempts=4,
+        backoff_seconds=0,
+        max_unavailable="25%",  # 1 of 4 slices
+        systemic_threshold="90%",
+    )
+
+    def sicken(name):
+        n = client.get("v1", "Node", name)
+        n["status"]["allocatable"]["google.com/tpu"] = "0"
+        client.update(n)
+
+    def run_pass():
+        nodes = [n for n in client.list("v1", "Node") if has_tpu_labels(n)]
+        return ctrl.reconcile(nodes, sp, NS)
+
+    def state_of(name):
+        return (
+            client.get("v1", "Node", name)["metadata"].get("labels") or {}
+        ).get(consts.REMEDIATION_STATE_LABEL)
+
+    sicken("rn-1")
+    for _ in range(4):
+        run_pass()
+    assert state_of("rn-1") in (
+        consts.REMEDIATION_STATE_CORDON_DRAIN,
+        consts.REMEDIATION_STATE_QUARANTINED,
+    )
+
+    sicken("rn-2")
+    deferred = 0
+    for _ in range(4):
+        summary = run_pass()
+        deferred += summary.budget_deferred
+        assert summary.disrupted_slices <= summary.budget_cap == 1
+    assert deferred > 0
+    assert state_of("rn-2") == consts.REMEDIATION_STATE_REVALIDATE
+
+    # the quarantined host is preempted: its hold must release
+    client.delete("v1", "Node", "rn-1")
+    client.delete_if_exists("v1", "Pod", "val-rn-1", NS)
+    summary = run_pass()
+    assert summary.disrupted_slices <= 1
+    assert ("rn-1", "budget") not in ctrl._logged
+    for _ in range(3):
+        summary = run_pass()
+        assert summary.disrupted_slices <= summary.budget_cap == 1
+    assert state_of("rn-2") in (
+        consts.REMEDIATION_STATE_CORDON_DRAIN,
+        consts.REMEDIATION_STATE_QUARANTINED,
+    ), "freed budget must let the deferred node escalate"
+
+
+# ---------------------------------------------------------------------------
+# schedsim: no zombie holds, gangs terminated whole
+# ---------------------------------------------------------------------------
+
+
+def test_engine_detach_releases_chips_and_terminates_gangs_whole():
+    from tpu_operator.schedsim.engine import ChurnEngine
+
+    client = FakeClient(
+        [
+            {
+                "apiVersion": "v1",
+                "kind": "Namespace",
+                "metadata": {"name": "alloc-churn"},
+            }
+        ]
+    )
+    engine = ChurnEngine(client, ["h-1", "h-2", "h-3"], workers=0, seed=3)
+    engine.ensure_namespace()
+
+    # a single-host job on h-3, and a 2-host gang across h-1/h-2
+    single = engine._make_pod("h-3", 2, "job-s")
+    engine.agents["h-3"].allocate(2, single)
+    for node in ("h-1", "h-2"):
+        pod = engine._make_pod(node, 8, "gang-x")
+        engine.agents[node].allocate(8, pod, gang_id="gang-x")
+    assert engine.registry.pods_holding() == 3
+    assert engine.registry.nodes_holding() == {"h-1", "h-2", "h-3"}
+
+    freed = engine.detach_host("h-1")
+    assert freed >= 0
+    # the gang died whole: its h-2 member must not survive as a stub
+    assert engine.registry.pods_of_gang("gang-x") == []
+    assert engine.registry.nodes_holding() == {"h-3"}  # the single lives
+    assert engine.registry.total_held() == 2
+    assert "h-1" not in engine.agents and "h-1" not in engine.node_names
+    assert engine.detach_host("h-1") == 0  # idempotent
+
+    # and a detached fleet member no longer takes placements
+    assert engine._pick_hosts(8, 3, random.Random(1)) != []
+    assert "h-1" not in engine._pick_hosts(8, 3, random.Random(1))

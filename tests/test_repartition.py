@@ -1,0 +1,389 @@
+"""Live slice re-partition roll units (``controllers/repartition.py``)
+plus the THREE-consumer shared-budget arithmetic: upgrades + remediation
++ re-partition contending for one maxUnavailable cap must never jointly
+exceed it, from any side's admission."""
+
+import os
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+from tests.conftest import make_tpu_node
+from tpu_operator import consts
+from tpu_operator.api.v1.clusterpolicy_types import (
+    DevicePluginConfig,
+    RemediationSpec,
+    SliceManagerSpec,
+)
+from tpu_operator.controllers.remediation import NodeRemediationController
+from tpu_operator.controllers.repartition import SliceRepartitionController
+from tpu_operator.kube import FakeClient
+from tpu_operator.kube.testing import make_validator_pod
+from tpu_operator.sliceman.slice_manager import STATE_SUCCESS
+
+NS = "tpu-operator"
+SLICE_ID = "rp-slice-a"
+
+
+def sm_spec(default="balanced-2x2", max_unavailable="1"):
+    return SliceManagerSpec(
+        config=DevicePluginConfig(name="layouts", default=default),
+        max_unavailable=max_unavailable,
+    )
+
+
+def tpu_node(name, extra=None):
+    node = make_tpu_node(name, extra_labels=extra)
+    node["status"]["capacity"]["google.com/tpu"] = "8"
+    node["status"]["allocatable"]["google.com/tpu"] = "8"
+    return node
+
+
+def seeded():
+    """A 2-host slice plus two single-host slices (3 slice units)."""
+    client = FakeClient(
+        [{"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}]
+    )
+    slice_extra = {
+        consts.TFD_SLICE_ID_LABEL: SLICE_ID,
+        consts.TFD_SLICE_HOSTS_LABEL: "2",
+    }
+    for name in ("rp-1", "rp-2"):
+        client.create(tpu_node(name, slice_extra))
+    for name in ("solo-1", "solo-2"):
+        client.create(tpu_node(name))
+    return client
+
+
+def nodes(client):
+    return client.list("v1", "Node")
+
+
+def labels_of(client, name):
+    return client.get("v1", "Node", name)["metadata"].get("labels") or {}
+
+
+def rolling(client, name):
+    return (
+        labels_of(client, name).get(consts.REPARTITION_STATE_LABEL)
+        == consts.REPARTITION_STATE_ROLLING
+    )
+
+
+def apply_layout(client, name):
+    """Play the per-node slice-manager daemon: layout applied, success."""
+    client.patch_labels(
+        "v1",
+        "Node",
+        name,
+        labels={consts.SLICE_CONFIG_STATE_LABEL: STATE_SUCCESS},
+    )
+
+
+# ---------------------------------------------------------------------------
+# the roll
+# ---------------------------------------------------------------------------
+
+
+def test_roll_is_slice_by_slice_under_the_cap():
+    """cap=1: exactly one slice unit rolls at a time; the whole fleet
+    converges to the new layout as each slice completes; the rolling
+    label (the budget hold) is released on completion."""
+    client = seeded()
+    ctrl = SliceRepartitionController(client)
+    sp = sm_spec(max_unavailable="1")
+
+    seen_rolling = set()
+    for _round in range(10):
+        summary = ctrl.reconcile(nodes(client), sp, NS)
+        # invariant: joint in-flight disruptions never exceed the cap
+        assert summary.disrupted_slices <= summary.budget_cap == 1
+        now_rolling = {
+            n["metadata"]["name"]
+            for n in nodes(client)
+            if rolling(client, n["metadata"]["name"])
+        }
+        seen_rolling |= now_rolling
+        # the 2-host slice rolls as ONE unit: never a lone member
+        assert now_rolling.intersection({"rp-1", "rp-2"}) in (
+            set(),
+            {"rp-1", "rp-2"},
+        )
+        for name in now_rolling:
+            apply_layout(client, name)
+        if not summary.active and _round > 0:
+            break
+    assert seen_rolling == {"rp-1", "rp-2", "solo-1", "solo-2"}
+    for n in nodes(client):
+        lab = n["metadata"]["labels"]
+        assert lab.get(consts.SLICE_CONFIG_LABEL) == "balanced-2x2"
+        assert lab.get(consts.SLICE_CONFIG_STATE_LABEL) == STATE_SUCCESS
+        assert consts.REPARTITION_STATE_LABEL not in lab  # hold released
+    assert ctrl.rolls_completed_total == 4
+    assert ctrl.budget_deferred_total > 0  # the cap actually bit
+
+
+def test_stale_success_from_previous_layout_is_not_done():
+    """A node already reporting success under the OLD layout must be
+    re-rolled (state reset to pending at admission)."""
+    client = seeded()
+    for name in ("solo-1",):
+        client.patch_labels(
+            "v1",
+            "Node",
+            name,
+            labels={
+                consts.SLICE_CONFIG_LABEL: "old-layout",
+                consts.SLICE_CONFIG_STATE_LABEL: STATE_SUCCESS,
+            },
+        )
+    ctrl = SliceRepartitionController(client)
+    ctrl.reconcile(nodes(client), sm_spec(max_unavailable="4"), NS)
+    lab = labels_of(client, "solo-1")
+    assert lab[consts.SLICE_CONFIG_LABEL] == "balanced-2x2"
+    assert lab[consts.SLICE_CONFIG_STATE_LABEL] == "pending"
+    assert rolling(client, "solo-1")
+
+
+def test_no_desired_layout_is_free_and_releases_abandoned_holds():
+    client = seeded()
+    # a leftover hold from an aborted roll
+    client.patch_labels(
+        "v1",
+        "Node",
+        "solo-1",
+        labels={
+            consts.REPARTITION_STATE_LABEL: consts.REPARTITION_STATE_ROLLING
+        },
+    )
+    ctrl = SliceRepartitionController(client)
+    summary = ctrl.reconcile(nodes(client), SliceManagerSpec(), NS)
+    assert not summary.active and summary.desired == ""
+    assert not rolling(client, "solo-1")
+
+
+def test_partial_admission_resumes_without_new_budget():
+    """A slice with one member already rolling (operator crashed
+    mid-wave) finishes its batch even with zero headroom left."""
+    client = seeded()
+    # slice rp-a half-admitted; solo-1 quarantined consumes the cap
+    client.patch_labels(
+        "v1",
+        "Node",
+        "rp-1",
+        labels={
+            consts.SLICE_CONFIG_LABEL: "balanced-2x2",
+            consts.SLICE_CONFIG_STATE_LABEL: "pending",
+            consts.REPARTITION_STATE_LABEL: consts.REPARTITION_STATE_ROLLING,
+        },
+    )
+    client.patch_labels(
+        "v1",
+        "Node",
+        "solo-1",
+        labels={
+            consts.REMEDIATION_STATE_LABEL: (
+                consts.REMEDIATION_STATE_QUARANTINED
+            )
+        },
+    )
+    ctrl = SliceRepartitionController(client)
+    summary = ctrl.reconcile(nodes(client), sm_spec(max_unavailable="1"), NS)
+    assert rolling(client, "rp-2"), "sibling must join the in-flight batch"
+    # but NO fresh slice was admitted (cap exhausted by quarantine+roll)
+    assert summary.admitted_slices == 0
+    assert not rolling(client, "solo-2")
+
+
+# ---------------------------------------------------------------------------
+# three-consumer budget arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_repartition_defers_to_upgrade_and_remediation_holds():
+    """cap=1 with a mid-upgrade slice: the roll admits nothing; when the
+    upgrade completes the roll proceeds."""
+    client = seeded()
+    client.patch_labels(
+        "v1",
+        "Node",
+        "solo-1",
+        labels={consts.UPGRADE_STATE_LABEL: "drain-required"},
+    )
+    ctrl = SliceRepartitionController(client)
+    sp = sm_spec(max_unavailable="1")
+    summary = ctrl.reconcile(nodes(client), sp, NS)
+    assert summary.admitted_slices == 0 and summary.deferred_slices > 0
+    assert summary.disrupted_slices <= summary.budget_cap == 1
+
+    client.patch_labels(
+        "v1",
+        "Node",
+        "solo-1",
+        labels={consts.UPGRADE_STATE_LABEL: "upgrade-done"},
+    )
+    summary = ctrl.reconcile(nodes(client), sp, NS)
+    assert summary.admitted_slices == 1
+
+
+def test_repartition_counts_same_pass_remediation_writes():
+    """Cross-consumer same-pass blindness: remediation's quarantine
+    labels land on the server AFTER the pass-start node snapshot was
+    taken, so the roll admission cannot see them in its node list — the
+    reconciler threads remediation's in-pass disrupted set through
+    ``extra_disrupted`` instead. cap=1 with one slice remediation just
+    disrupted (stale snapshot shows it healthy): the roll must admit
+    nothing; dropping the hand-off would jointly admit 2 > 1."""
+    client = seeded()
+    snapshot = nodes(client)  # pass-start view: nothing disrupted
+    ctrl = SliceRepartitionController(client)
+    sp = sm_spec(max_unavailable="1")
+    summary = ctrl.reconcile(
+        snapshot, sp, NS, extra_disrupted={"solo-1"}
+    )
+    assert summary.admitted_slices == 0 and summary.deferred_slices > 0
+    assert summary.disrupted_slices <= summary.budget_cap == 1
+    for name in ("rp-1", "rp-2", "solo-2"):
+        assert not rolling(client, name)
+
+    # remediation released its hold: the next pass proceeds normally
+    summary = ctrl.reconcile(nodes(client), sp, NS, extra_disrupted=set())
+    assert summary.admitted_slices == 1
+
+
+def test_upgrade_budget_counts_repartition_slices():
+    """``slice_budget`` subtracts mid-roll slices from upgrade admission
+    and excludes them from pending."""
+    from tpu_operator.api.v1.clusterpolicy_types import UpgradePolicySpec
+    from tpu_operator.controllers.slice_status import group_slices
+    from tpu_operator.upgrade import upgrade_state as us
+
+    client = seeded()
+    client.patch_labels(
+        "v1",
+        "Node",
+        "solo-1",
+        labels={
+            consts.REPARTITION_STATE_LABEL: consts.REPARTITION_STATE_ROLLING
+        },
+    )
+    all_nodes = nodes(client)
+    state = us.ClusterUpgradeState()
+    for n in all_nodes:
+        state.node_states.setdefault(
+            us.STATE_UPGRADE_REQUIRED, []
+        ).append(us.NodeUpgradeState(node=n, state=us.STATE_UPGRADE_REQUIRED))
+    state.slices = group_slices(all_nodes)
+    for sid, info in state.slices.items():
+        for member in info.member_nodes:
+            state.slice_of[member] = sid
+
+    pol = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=8, max_unavailable=1
+    )
+    budget = us.slice_budget(state, pol)
+    assert budget.repartition_sids == {"solo-1"}
+    assert "solo-1" not in budget.pending_sids
+    assert budget.admit == 0, "the rolling slice consumed the whole cap"
+
+    pol = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=8, max_unavailable=2
+    )
+    assert us.slice_budget(state, pol).admit == 1
+
+
+def test_slice_status_degrades_honestly_while_rolling():
+    """A mid-roll member (chip clients paused on purpose) must take its
+    slice out of Ready — proactively, like a maintenance window — and
+    the degradation must name the host."""
+    from tpu_operator.controllers import slice_status
+
+    client = seeded()
+    # the slice starts labeled ready (a prior pass published it)
+    for name in ("rp-1", "rp-2"):
+        client.patch_labels(
+            "v1",
+            "Node",
+            name,
+            labels={consts.SLICE_READY_LABEL: "true"},
+        )
+    client.patch_labels(
+        "v1",
+        "Node",
+        "rp-2",
+        labels={
+            consts.REPARTITION_STATE_LABEL: consts.REPARTITION_STATE_ROLLING
+        },
+    )
+    summary = slice_status.aggregate(
+        client,
+        NS,
+        nodes(client),
+        validated={"rp-1", "rp-2", "solo-1", "solo-2"},
+    )
+    info = summary.slices[SLICE_ID]
+    assert info.repartitioning_hosts == ["rp-2"]
+    assert not info.ready
+    # the published verdict flipped on both members
+    for name in ("rp-1", "rp-2"):
+        assert (
+            labels_of(client, name).get(consts.SLICE_READY_LABEL) == "false"
+        )
+    # the single-host slices are untouched
+    assert summary.slices["solo-1"].ready
+
+
+def test_remediation_defers_and_skips_under_repartition():
+    """A node mid-roll is interlocked (its outage is self-inflicted),
+    and a rolling slice consumes remediation's admission headroom."""
+    client = seeded()
+    for name in ("rp-1", "rp-2", "solo-1", "solo-2"):
+        client.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": f"plugin-{name}",
+                    "namespace": NS,
+                    "labels": {"app": "tpu-device-plugin"},
+                },
+                "spec": {"nodeName": name},
+                "status": {
+                    "phase": "Running",
+                    "containerStatuses": [{"ready": True}],
+                },
+            }
+        )
+        client.create(make_validator_pod(name, True, NS))
+    # the 2-host slice is mid-roll; its chips read dead (clients paused)
+    for name in ("rp-1", "rp-2"):
+        client.patch_labels(
+            "v1",
+            "Node",
+            name,
+            labels={
+                consts.REPARTITION_STATE_LABEL: (
+                    consts.REPARTITION_STATE_ROLLING
+                )
+            },
+        )
+        n = client.get("v1", "Node", name)
+        n["status"]["allocatable"]["google.com/tpu"] = "0"
+        client.update(n)
+
+    ctrl = NodeRemediationController(client)
+    sp = RemediationSpec(
+        enabled=True,
+        max_attempts=3,
+        backoff_seconds=0,
+        max_unavailable="1",
+        systemic_threshold="90%",
+    )
+    rnodes = nodes(client)
+    summary = ctrl.reconcile(rnodes, sp, NS)
+    # the rolling hosts are interlocked: no FSM entry, no quarantine
+    assert summary.skipped == 2
+    for name in ("rp-1", "rp-2"):
+        assert consts.REMEDIATION_STATE_LABEL not in labels_of(client, name)
+    # and the rolling slice counts against remediation's joint set
+    assert summary.disrupted_slices == 1

@@ -550,7 +550,16 @@ class SliceSpec(SpecBase):
 @dataclass
 class SliceManagerSpec(_ImageSpec):
     """TPU slice/partition manager — the reference's ``MIGManagerSpec`` slot
-    (``assets/state-mig-manager/``, named layouts ConfigMap, node-label FSM)."""
+    (``assets/state-mig-manager/``, named layouts ConfigMap, node-label FSM).
+
+    ``config.default`` (the reference's ``mig.config`` default profile)
+    doubles as the FLEET-WIDE desired layout: when set, the live
+    re-partition controller (``controllers/repartition.py``) rolls every
+    TPU node whose applied layout differs, slice-by-slice, through the
+    shared disruption budget. ``maxUnavailable`` is that roll's cap over
+    the JOINT disrupted set (upgrades + remediation + re-partition draw
+    on one pool; with the three knobs equal — all default "25%" — it is
+    exactly one budget)."""
 
     enabled: Optional[bool] = None
     repository: str = ""
@@ -561,6 +570,7 @@ class SliceManagerSpec(_ImageSpec):
     env: List[EnvVar] = field(default_factory=list)
     config: Optional[DevicePluginConfig] = None
     chip_clients_config: Optional[MetricsConfig] = None
+    max_unavailable: str = "25%"
 
     ENV_VAR = "TPU_SLICE_MANAGER_IMAGE"
 

@@ -1,0 +1,7 @@
+"""Fleet-lifecycle chaos: seeded replayable event schedules, a global
+invariant checker, and the soak runner that drives them against a
+converging kubesim fleet. See ``docs/robustness.md`` ("Lifecycle storms
+& chaos soak")."""
+
+from tpu_operator.chaos.schedule import ChaosEvent, ChaosSchedule  # noqa: F401
+from tpu_operator.chaos.soak import InvariantChecker, SoakRunner  # noqa: F401

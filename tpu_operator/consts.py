@@ -165,6 +165,14 @@ REMEDIATION_DISRUPTED_STATES = (
 # slice partitioning label FSM (reference nvidia.com/mig.config[.state])
 SLICE_CONFIG_LABEL = f"{GROUP}/tpu.slice.config"
 SLICE_CONFIG_STATE_LABEL = f"{GROUP}/tpu.slice.config.state"
+# fleet-level live re-partition roll (controllers/repartition.py): set on
+# a node while the operator is rolling it to a changed named-slice layout
+# — the THIRD consumer of the shared slice-unit disruption budget
+# (upgrades + remediation + re-partition draw on one maxUnavailable pool,
+# kube/disruption.py joint accounting). Cleared when the node's
+# slice-manager reports the new layout applied.
+REPARTITION_STATE_LABEL = f"{GROUP}/repartition-state"
+REPARTITION_STATE_ROLLING = "rolling"
 
 # per-node device-plugin config override (reference nvidia.com/device-plugin.config)
 DEVICE_PLUGIN_CONFIG_LABEL = f"{GROUP}/device-plugin.config"

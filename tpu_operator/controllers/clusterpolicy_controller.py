@@ -66,6 +66,13 @@ class ClusterPolicyReconciler:
         )
 
         self.remediation = NodeRemediationController(client)
+        # live slice re-partition roll (third consumer of the shared
+        # disruption budget; no-op without spec.sliceManager.config.default)
+        from tpu_operator.controllers.repartition import (
+            SliceRepartitionController,
+        )
+
+        self.repartition = SliceRepartitionController(client)
         # (Node, Pod) store versions of the last clean slice aggregation
         # — while both hold, the per-node slice grouping and readiness
         # math is a pure recomputation over an unchanged world, so the
@@ -75,6 +82,12 @@ class ClusterPolicyReconciler:
         # state_render_ms label values currently exported (so series for
         # states gone from the render cost map can be removed)
         self._render_ms_states = set()
+        # completed reconcile passes (plain int, no prometheus needed):
+        # external health/invariant checkers use this to reason in
+        # operator-pass units instead of wall time — "stale for N
+        # passes" is meaningful on any box, "stale for N seconds" only
+        # on an idle one
+        self.passes_total = 0
 
     def reconcile(self, name: str = "") -> Result:
         # copy=True: the CR objects are mutated below (_set_status writes
@@ -95,6 +108,7 @@ class ClusterPolicyReconciler:
             return self._reconcile_pass(policies)
         finally:
             self.ctrl.end_pass()
+            self.passes_total += 1
             self._update_snapshot_metrics()
 
     def _reconcile_pass(self, policies) -> Result:
@@ -169,6 +183,13 @@ class ClusterPolicyReconciler:
         # pass's node list — level-triggered, like every other writer)
         remediation_summary = self._run_remediation()
 
+        # live slice re-partition roll (after remediation, and handed
+        # remediation's in-pass disrupted set: the quarantine labels it
+        # just wrote are on the wire but NOT in this pass's node
+        # snapshot, and the label-derived joint set alone would let the
+        # two consumers jointly over-admit past the one cap)
+        repartition_summary = self._run_repartition(remediation_summary)
+
         slice_summary = self._aggregate_slices()
 
         was_ready = (primary.get("status", {}) or {}).get("state") == State.READY
@@ -221,6 +242,11 @@ class ClusterPolicyReconciler:
             # without any cluster event to wake the reconciler, so the
             # level-triggered requeue is the remediation clock
             return Result(ready=True, requeue_after=REQUEUE_NOT_READY_S)
+        if repartition_summary is not None and repartition_summary.active:
+            # an in-flight/pending layout roll: budget headroom opens
+            # when ANOTHER consumer releases a slice — no cluster event
+            # of ours fires for that, so the requeue is the roll's clock
+            return Result(ready=True, requeue_after=REQUEUE_NOT_READY_S)
         return Result(ready=True)
 
     # ------------------------------------------------------------------
@@ -255,6 +281,34 @@ class ClusterPolicyReconciler:
             return RemediationSummary(errored=True)
         self._update_remediation_metrics(summary)
         return summary
+
+    def _run_repartition(self, remediation_summary=None):
+        """Live slice re-partition pass (third shared-budget consumer).
+        Failure-isolated like remediation: a roll exception must not
+        abort the reconcile; the 5s requeue retries it."""
+        from tpu_operator.controllers.state_manager import has_tpu_labels
+
+        try:
+            tpu_nodes = [
+                n for n in (self.ctrl._nodes_cache or ()) if has_tpu_labels(n)
+            ]
+            return self.repartition.reconcile(
+                tpu_nodes,
+                self.ctrl.cp.spec.slice_manager,
+                self.ctrl.namespace,
+                extra_disrupted=getattr(
+                    remediation_summary, "disrupted_sids", None
+                ),
+            )
+        except Exception:
+            log.exception("slice re-partition pass failed")
+            from tpu_operator.controllers.repartition import (
+                RepartitionSummary,
+            )
+
+            # rolling_slices=1 keeps .active truthy so the 5s requeue
+            # retries the errored pass (any held slices stay honest)
+            return RepartitionSummary(rolling_slices=1)
 
     def _update_remediation_metrics(self, summary) -> None:
         m = self.metrics

@@ -189,6 +189,11 @@ class RemediationSummary:
     disrupted_slices: int = 0  # upgrades + repairs jointly
     budget_deferred: int = 0  # drains the budget refused this pass
     unhealthy_hosts: List[str] = field(default_factory=list)
+    # the slice ids behind disrupted_slices, INCLUDING escalations this
+    # pass wrote — the same-pass repartition roll reads these so its
+    # admission is not blind to quarantine labels still on the wire
+    # (the pass-start node snapshot predates them)
+    disrupted_sids: Set[str] = field(default_factory=set)
 
     @property
     def active(self) -> bool:
@@ -321,6 +326,8 @@ class NodeRemediationController:
             for sid, info in slices.items()
             for member in info.member_nodes
         }
+        from tpu_operator.kube.disruption import repartition_disrupted
+
         disrupted: Set[str] = set()
         for v in verdicts:
             labels = v.node.get("metadata", {}).get("labels", {}) or {}
@@ -329,6 +336,9 @@ class NodeRemediationController:
                 v.state in consts.REMEDIATION_DISRUPTED_STATES
                 or ustate in UPGRADE_ACTIVE
                 or ustate == STATE_FAILED
+                # third consumer of the one pool: a slice mid live
+                # re-partition roll consumes remediation headroom too
+                or repartition_disrupted(v.node)
             ):
                 disrupted.add(slice_of.get(v.name, v.name))
         max_unavailable = getattr(spec, "max_unavailable", None)
@@ -349,6 +359,13 @@ class NodeRemediationController:
                     v.name,
                 )
         summary.disrupted_slices = len(disrupted)
+        summary.disrupted_sids = set(disrupted)
+        # retire log-once state for vanished nodes: lifecycle churn
+        # (preemption waves deleting quarantined hosts) would otherwise
+        # grow the set without bound, and a rejoin under the same name
+        # would inherit the old suppression
+        live = {v.name for v in verdicts}
+        self._logged = {k for k in self._logged if k[0] in live}
         self._finish(summary, verdicts)
         return summary
 
@@ -464,6 +481,14 @@ class NodeRemediationController:
             v.skip_reason = f"{consts.REMEDIATION_SKIP_LABEL}=true"
         elif labels.get(consts.MAINTENANCE_STATE_LABEL):
             v.skip_reason = "active host-maintenance window"
+        elif (
+            labels.get(consts.REPARTITION_STATE_LABEL)
+            == consts.REPARTITION_STATE_ROLLING
+        ):
+            # a live re-partition pauses the node's chip clients on
+            # purpose — the resulting zero-allocatable / validator-down
+            # window is self-inflicted, not a node-health incident
+            v.skip_reason = "in-flight slice re-partition roll"
         else:
             ustate = labels.get(consts.UPGRADE_STATE_LABEL, "")
             if ustate in UPGRADE_ACTIVE or ustate == STATE_FAILED:

@@ -60,6 +60,12 @@ class SliceInfo:
     # degradation reason — an exhausted flapping host can look healthy
     # moment-to-moment yet must keep its slice out of service
     quarantined_hosts: List[str] = field(default_factory=list)
+    # members mid live slice re-partition (controllers/repartition.py):
+    # the roll pauses the host's chip clients on purpose, so the slice
+    # verdict flips ahead of the outage — same proactive rule as
+    # maintenance windows (a gang job must not land on a slice whose
+    # layout is changing under it)
+    repartitioning_hosts: List[str] = field(default_factory=list)
 
     @property
     def ready(self) -> bool:
@@ -260,11 +266,20 @@ def aggregate(
             ).get(consts.REMEDIATION_STATE_LABEL)
             in consts.REMEDIATION_DISRUPTED_STATES
         )
+        info.repartitioning_hosts = sorted(
+            n
+            for n in info.member_nodes
+            if (
+                cached[n].get("metadata", {}).get("labels", {}) or {}
+            ).get(consts.REPARTITION_STATE_LABEL)
+            == consts.REPARTITION_STATE_ROLLING
+        )
         # a member counts only when validated AND not advertising zero
         # allocatable chips (kubelet-derived health can sour a host long
         # after its validator initContainer chain passed) AND not inside
         # a maintenance window (the chips are about to vanish) AND not
-        # held by the remediation FSM (quarantined/exhausted)
+        # held by the remediation FSM (quarantined/exhausted) AND not
+        # mid layout roll (its chip clients are paused on purpose)
         info.ready_nodes = sum(
             1
             for n in info.member_nodes
@@ -272,6 +287,7 @@ def aggregate(
             and n not in info.unhealthy_hosts
             and n not in info.maintenance_hosts
             and n not in info.quarantined_hosts
+            and n not in info.repartitioning_hosts
         )
         verdict = "true" if info.ready else "false"
         was_ready = any(
@@ -378,6 +394,11 @@ def _record_degradation(client: Client, namespace: str, info: SliceInfo) -> None
             f"host(s) {', '.join(info.quarantined_hosts)} are "
             f"quarantined for repair "
             f"({c.REPAIR_TAINT_KEY}={c.REPAIR_PENDING} taint)"
+        )
+    elif info.repartitioning_hosts:
+        detail = (
+            f"host(s) {', '.join(info.repartitioning_hosts)} are mid "
+            f"slice re-partition (chip clients paused for a layout roll)"
         )
     elif info.maintenance_hosts:
         detail = (

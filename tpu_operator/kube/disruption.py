@@ -130,3 +130,84 @@ def eviction_blocked_by(
                 f"({healthy} healthy of {total} matching)"
             )
     return None
+
+
+# ---------------------------------------------------------------------------
+# Shared slice-unit disruption accounting (operator-side, not PDB).
+#
+# THREE actors issue fleet disruptions, each at slice granularity: the
+# rolling libtpu upgrade FSM, the node-health remediation FSM, and the
+# live slice re-partition roll. They draw on ONE maxUnavailable pool —
+# each side's admission counts the JOINT disrupted set — and every
+# consumer derives that set through the predicates below so the three
+# arithmetics cannot drift. All signals are durable node labels, so the
+# accounting survives operator restarts and a vanished node releases its
+# hold the moment it leaves the node listing (nothing retires by hand).
+# ---------------------------------------------------------------------------
+
+OWNER_UPGRADE = "upgrade"
+OWNER_REMEDIATION = "remediation"
+OWNER_REPARTITION = "repartition"
+
+
+def repartition_disrupted(node: Obj) -> bool:
+    """Whether the live re-partition roll currently holds this node
+    disrupted (its chip clients are paused while the layout changes)."""
+    from tpu_operator import consts
+
+    labels = node.get("metadata", {}).get("labels", {}) or {}
+    return (
+        labels.get(consts.REPARTITION_STATE_LABEL)
+        == consts.REPARTITION_STATE_ROLLING
+    )
+
+
+def disruption_owner(node: Obj) -> Optional[str]:
+    """Which actor currently holds this node disrupted — ``"upgrade"``
+    (FSM active or failed), ``"remediation"`` (cordon-drain/quarantined/
+    exhausted), ``"repartition"`` (mid layout roll) — or None. Checked in
+    interlock order: the upgrade FSM outranks remediation (remediation
+    defers to it), which outranks a re-partition roll."""
+    from tpu_operator import consts
+    from tpu_operator.upgrade.upgrade_state import (
+        ACTIVE_STATES,
+        STATE_FAILED,
+    )
+
+    labels = node.get("metadata", {}).get("labels", {}) or {}
+    ustate = labels.get(consts.UPGRADE_STATE_LABEL, "")
+    if ustate in ACTIVE_STATES or ustate == STATE_FAILED:
+        return OWNER_UPGRADE
+    if (
+        labels.get(consts.REMEDIATION_STATE_LABEL)
+        in consts.REMEDIATION_DISRUPTED_STATES
+    ):
+        return OWNER_REMEDIATION
+    if repartition_disrupted(node):
+        return OWNER_REPARTITION
+    return None
+
+
+def joint_disrupted_slices(
+    nodes: List[Obj], slice_of: Dict[str, str]
+) -> Dict[str, set]:
+    """The joint disrupted set in SLICE units, split by owner. Returns
+    ``{"upgrade": sids, "remediation": sids, "repartition": sids,
+    "all": union}`` — a slice is disrupted when ANY member host is.
+    ``slice_of`` maps node name → slice id (missing names are slices of
+    one, the same fallback every consumer uses)."""
+    out: Dict[str, set] = {
+        OWNER_UPGRADE: set(),
+        OWNER_REMEDIATION: set(),
+        OWNER_REPARTITION: set(),
+    }
+    for node in nodes:
+        owner = disruption_owner(node)
+        if owner is None:
+            continue
+        name = node.get("metadata", {}).get("name", "")
+        out[owner].add(slice_of.get(name, name))
+    out["all"] = out[OWNER_UPGRADE] | out[OWNER_REMEDIATION] | out[
+        OWNER_REPARTITION
+    ]
+    return out

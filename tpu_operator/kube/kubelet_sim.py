@@ -224,6 +224,14 @@ class KubeletDeviceManager:
                 pass
         if self._server is not None:
             self._server.stop(grace=1)
+        # node lifecycle: a stopped kubelet sim means the host left the
+        # fleet (spot preemption / scale-down in the lifecycle chaos) —
+        # its chips must leave the shared ledger, or the registry holds
+        # reservations on hardware that no longer exists (zombie holds)
+        if self.registry is not None and hasattr(
+            self.registry, "release_node"
+        ):
+            self.registry.release_node(self.node_name)
 
     # -- ListAndWatch consumption ---------------------------------------
     def _dial(self, resource: str, endpoint: str, gen: int):

@@ -115,6 +115,16 @@ class KubeSim:
         # touch, matching apiserver behavior).
         self.event_ttl_s = float(os.environ.get("KUBESIM_EVENT_TTL_S", "3600"))
         self._event_touch: Dict[Tuple, float] = {}
+        # fleet-lifecycle hooks: fn(event, node_name) with event in
+        # ("ADDED", "DELETED"), fired OUTSIDE the store lock by the
+        # lifecycle helpers (add_nodes/delete_node/preemption_wave) so
+        # co-resident simulators (kubelet device manager, schedsim churn
+        # agents) can attach/detach with the node — a deleted host's
+        # chips must leave the allocation registry, not zombie-hold
+        self._lifecycle_hooks: List = []
+        self._join_seq = 0
+        self.nodes_added = 0
+        self.nodes_deleted = 0
 
     def inject_watch_drop(self, plural: str, count: int = 1) -> None:
         """Arrange for the next ``count`` watch event lines for ``plural``
@@ -285,6 +295,115 @@ class KubeSim:
             }
 
         return self._mutate_stored("pods", namespace, name, fn)
+
+    # -- fleet lifecycle --------------------------------------------------
+    def add_lifecycle_hook(self, fn) -> None:
+        """Register ``fn(event, node_name)`` for node ADDED/DELETED
+        lifecycle transitions driven through the helpers below. Hooks run
+        outside the store lock and are failure-isolated (a broken sim
+        detach must not wedge the apiserver)."""
+        self._lifecycle_hooks.append(fn)
+
+    def _fire_lifecycle(self, event: str, name: str) -> None:
+        for fn in list(self._lifecycle_hooks):
+            try:
+                fn(event, name)
+            except Exception:
+                pass  # hooks are observers, never load-bearing
+
+    def add_nodes(
+        self,
+        count: int,
+        template: Optional[dict] = None,
+        name_prefix: str = "join",
+        chips: int = 8,
+        extra_labels: Optional[dict] = None,
+        names: Optional[List[str]] = None,
+    ) -> List[str]:
+        """Autoscale join: create ``count`` TPU nodes, each through the
+        normal admission path (real ADDED watch events, monotonically
+        named ``{name_prefix}-{seq}`` unless ``names`` pins them — the
+        chaos generator pins names so a replay is byte-identical), then
+        advertise ``chips`` allocatable chips the way a booting kubelet
+        would. ``template`` overrides the default GKE-style TPU node
+        shape; ``extra_labels`` ride on top (slice-id labels make a join
+        wave form NEW multi-host slices)."""
+        created: List[str] = []
+        for i in range(count):
+            if names is not None:
+                name = names[i]
+            else:
+                with self._lock:
+                    self._join_seq += 1
+                    name = f"{name_prefix}-{self._join_seq}"
+            if template is not None:
+                node = copy.deepcopy(template)
+                node.setdefault("metadata", {})["name"] = name
+                node["metadata"].setdefault("labels", {})[
+                    "kubernetes.io/hostname"
+                ] = name
+            else:
+                from tpu_operator.kube.testing import make_tpu_node
+
+                node = make_tpu_node(name, extra_labels=extra_labels)
+            if extra_labels and template is not None:
+                node["metadata"].setdefault("labels", {}).update(extra_labels)
+            code, body = self.create("", "v1", "nodes", "", node)
+            if code == 409:
+                continue  # name collision with a live node: skip, no retry
+            if code >= 400:
+                raise RuntimeError(f"add_nodes: {body.get('message')}")
+            if chips > 0:
+                self.set_node_chips(name, chips, capacity=chips)
+            created.append(name)
+            with self._lock:
+                self.nodes_added += 1
+        for name in created:
+            self._fire_lifecycle("ADDED", name)
+        return created
+
+    def delete_node(self, name: str) -> bool:
+        """Spot preemption / scale-down of ONE node: the DELETED watch
+        event, the apiserver's at-deletion pod cascade (every bound pod
+        deleted with its own DELETED event — ``_gc_node_pods``), and the
+        lifecycle hooks that detach the node's kubelet/plugin simulators
+        (releasing its chips from the schedsim registry). Returns False
+        when the node was already gone."""
+        code, _ = self.delete("", "v1", "nodes", "", name)
+        if code != 200:
+            return False
+        with self._lock:
+            self.nodes_deleted += 1
+        self._fire_lifecycle("DELETED", name)
+        return True
+
+    def preemption_wave(
+        self,
+        fraction: float,
+        rng=None,
+        name_filter=None,
+    ) -> List[str]:
+        """Spot-preemption wave: delete ``ceil(fraction × fleet)`` nodes
+        picked by ``rng`` (a ``random.Random``; pass a seeded one for a
+        replayable wave) from the sorted live node list — mid-upgrade,
+        mid-remediation, mid-repartition nodes are all fair game, which
+        is the point. ``name_filter(name) -> bool`` scopes the candidate
+        pool (e.g. spare the operator's seed slice)."""
+        import math
+        import random as _random
+
+        rng = rng or _random.Random()
+        with self._lock:
+            live = sorted(
+                key[4] for key in self._objs if key[2] == "nodes"
+            )
+        if name_filter is not None:
+            live = [n for n in live if name_filter(n)]
+        if not live or fraction <= 0:
+            return []
+        count = min(len(live), max(1, math.ceil(len(live) * fraction)))
+        victims = rng.sample(live, count)
+        return [v for v in victims if self.delete_node(v)]
 
     def faults_pending(self) -> int:
         """Injected (queued) faults not yet consumed — the fault-matrix

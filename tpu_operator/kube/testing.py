@@ -319,6 +319,36 @@ def simulate_kubelet_nodes(
             return  # quiescing: straggler errors are moot
         if errors:
             raise errors[0]
+    # slice-manager daemon role: a node whose desired slice config label
+    # changed (the live re-partition controller admitted it) gets the
+    # layout "applied" and reports success — the per-node daemon's
+    # contract (sliceman/slice_manager.py reconcile_once), one sweep
+    # late so the roll holds its budget unit for at least one interval
+    _simulate_slice_manager(client, node_labels)
+
+
+def _simulate_slice_manager(client: Client, node_labels: dict) -> None:
+    """Flip ``tpu.k8s.io/tpu.slice.config.state`` to ``success`` for
+    nodes carrying a desired config whose state isn't success yet — the
+    sim fleet's stand-in for the per-node slice-manager daemon (which in
+    production pauses chip clients, partitions, and reports)."""
+    from tpu_operator.kube.client import NotFoundError
+    from tpu_operator.sliceman.slice_manager import STATE_SUCCESS
+
+    for name, labels in node_labels.items():
+        if not labels.get(consts.SLICE_CONFIG_LABEL):
+            continue
+        if labels.get(consts.SLICE_CONFIG_STATE_LABEL) == STATE_SUCCESS:
+            continue
+        try:
+            client.patch_labels(
+                "v1",
+                "Node",
+                name,
+                labels={consts.SLICE_CONFIG_STATE_LABEL: STATE_SUCCESS},
+            )
+        except NotFoundError:
+            continue  # preempted mid-sweep: normal lifecycle churn
 
 
 def wait_for(what: str, pred, timeout_s: float = 60.0, poll_s: float = 0.2):

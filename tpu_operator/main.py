@@ -145,6 +145,9 @@ def build_manager(
     # node-health remediation: last pass's verdicts + lifetime counters
     # (attempts, PDB vetoes, budget deferrals, breaker opens)
     mgr.register_debug_vars("remediation", reconciler.remediation.stats)
+    # live slice re-partition roll: desired layout, rolling/pending
+    # slices, budget deferrals (third shared-budget consumer)
+    mgr.register_debug_vars("repartition", reconciler.repartition.stats)
     # concurrent write pipeline: depth, in-flight, queue wait, errors —
     # one curl answers "are the convergence fan-outs actually wide?"
     mgr.register_debug_vars(
@@ -176,7 +179,18 @@ def wire_event_sources(mgr, client, namespace: str, stop_event=None) -> None:
         elif kind == "Node":
             name = obj["metadata"]["name"]
             old = node_cache.get(name)
-            node_cache[name] = None if event == "DELETED" else obj
+            if event == "DELETED":
+                # drop the entry entirely: a tombstone-per-name under
+                # join/preemption storms of unique node names grew this
+                # cache without bound
+                node_cache.pop(name, None)
+                # a node vanishing mid-upgrade must wake the upgrade
+                # reconciler too: its slice's budget hold releases on
+                # the next build_state, and waiting out the 120 s
+                # requeue starves pending sibling slices meanwhile
+                mgr.enqueue(UPGRADE_KEY)
+            else:
+                node_cache[name] = obj
             if node_event_needs_reconcile(event, old, obj):
                 mgr.enqueue(CP_KEY)
         elif kind == "Pod":

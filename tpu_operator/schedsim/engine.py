@@ -245,19 +245,13 @@ class ChurnEngine:
                 is None
             )
 
+        self._pod_gone = pod_gone
+        # guards fleet membership (node_names + agents) against the
+        # lifecycle hooks: joins/preemptions mutate the fleet while the
+        # workers place against it
+        self._fleet_lock = threading.Lock()
         self.agents: Dict[str, HostAgent] = {
-            node: HostAgent(
-                node,
-                SyntheticChipServicer(
-                    chips=chips_per_host,
-                    generation=generation,
-                    host_topology=host_topology,
-                    cdi_enabled=True,
-                ),
-                self.registry,
-                pod_gone=pod_gone,
-            )
-            for node in self.node_names
+            node: self._make_agent(node) for node in self.node_names
         }
 
         # shared counters: updated via _bump() only — a plain `+=` from
@@ -281,6 +275,11 @@ class ChurnEngine:
         self.gangs_timed_out = 0
         self.pods_created = 0
         self.pods_reaped = 0
+        # fleet lifecycle (joins/preemptions/layout shifts mid-churn)
+        self.hosts_attached = 0
+        self.hosts_detached = 0
+        self.pods_evicted_lifecycle = 0
+        self.gangs_rescheduled = 0
         self.fragmentation_last_pct = 0.0
         self.fragmentation_max_pct = 0.0
 
@@ -302,6 +301,80 @@ class ChurnEngine:
     def _bump(self, attr: str, n: int = 1) -> None:
         with self._count_lock:
             setattr(self, attr, getattr(self, attr) + n)
+
+    def _make_agent(self, node: str) -> HostAgent:
+        return HostAgent(
+            node,
+            SyntheticChipServicer(
+                chips=self.chips_per_host,
+                generation=self.generation,
+                host_topology=self.host_topology,
+                cdi_enabled=True,
+            ),
+            self.registry,
+            pod_gone=self._pod_gone,
+        )
+
+    # -- fleet lifecycle --------------------------------------------------
+    def attach_host(self, node: str) -> None:
+        """Autoscale join: a fresh host (real servicer, empty ledger)
+        enters the placement pool. Idempotent."""
+        with self._fleet_lock:
+            if node in self.agents:
+                return
+            self.agents[node] = self._make_agent(node)
+            self.node_names.append(node)
+        self._bump("hosts_attached")
+
+    def detach_host(self, node: str) -> int:
+        """Spot preemption / scale-down: the host leaves the placement
+        pool, every gang with a member on it is terminated whole (a
+        slice job without one member host is dead, not degraded), and
+        the registry drops the node's chips — a hold on vanished
+        hardware is a zombie. Returns chips freed. Idempotent."""
+        with self._fleet_lock:
+            if self.agents.pop(node, None) is None:
+                return 0
+            try:
+                self.node_names.remove(node)
+            except ValueError:
+                pass
+        self._evict_holders(node, reschedule=False)
+        freed = self.registry.release_node(node)
+        self._bump("hosts_detached")
+        return freed
+
+    def evict_host(self, node: str) -> int:
+        """Layout shift (live re-partition): the host stays in the
+        fleet, but every job holding chips on it is terminated — gangs
+        whole — so the churn workers re-admit the demand against the new
+        layout (gang rescheduling). Returns pods evicted."""
+        evicted = self._evict_holders(node, reschedule=True)
+        return evicted
+
+    def _evict_holders(self, node: str, reschedule: bool) -> int:
+        evicted = 0
+        gangs: Set[str] = set()
+        for pod_key in self.registry.pods_on_node(node):
+            gang = self.registry.gang_of(pod_key)
+            if gang is not None:
+                gangs.add(gang)
+                continue
+            ns, _, name = pod_key.partition("/")
+            self._terminate({"uid": pod_key, "namespace": ns, "name": name})
+            evicted += 1
+        for gang in gangs:
+            for pod_key in self.registry.pods_of_gang(gang):
+                ns, _, name = pod_key.partition("/")
+                self._terminate(
+                    {"uid": pod_key, "namespace": ns, "name": name}
+                )
+                evicted += 1
+            if reschedule:
+                self._bump("gangs_rescheduled")
+        if evicted:
+            self._bump("pods_evicted_lifecycle", evicted)
+        return evicted
 
     # -- lifecycle --------------------------------------------------------
     def ensure_namespace(self) -> None:
@@ -404,6 +477,20 @@ class ChurnEngine:
             "double_allocations": self.registry.double_allocation_attempts,
             "invariant_violations": self.invariant_violations,
         }
+
+    def wire_lifecycle(self, sim) -> None:
+        """Attach to a kubesim's fleet-lifecycle hooks: node ADDED joins
+        the placement pool, node DELETED detaches (gangs terminated
+        whole, chips released) — the plugin/kubelet half of a lifecycle
+        event the apiserver half already emitted watch events for."""
+
+        def hook(event: str, name: str) -> None:
+            if event == "ADDED":
+                self.attach_host(name)
+            elif event == "DELETED":
+                self.detach_host(name)
+
+        sim.add_lifecycle_hook(hook)
 
     # -- rate control -----------------------------------------------------
     def _take_token(self) -> bool:
@@ -518,7 +605,10 @@ class ChurnEngine:
         """ICI-aware best-fit score (lower is better): prefer hosts whose
         free chips still hold a contiguous block covering the request,
         then the tightest fit — churn packs instead of shredding."""
-        free = self.agents[node].free_ids()
+        agent = self.agents.get(node)
+        if agent is None:
+            return None  # detached between snapshot and scoring
+        free = agent.free_ids()
         if len(free) < size:
             return None
         fits = (
@@ -535,18 +625,20 @@ class ChurnEngine:
         """Up to ``count`` distinct hosts by score, sampled
         power-of-k-choices first (O(sample) per job at any fleet size),
         full scan only when the sample comes up short."""
-        sample_n = min(
-            max(self.sample_k, count * 4), len(self.node_names)
-        )
-        candidates = rng.sample(self.node_names, sample_n)
+        with self._fleet_lock:
+            fleet = list(self.node_names)
+        if not fleet:
+            return []
+        sample_n = min(max(self.sample_k, count * 4), len(fleet))
+        candidates = rng.sample(fleet, sample_n)
         scored = []
         for node in candidates:
             s = self._score(node, size)
             if s is not None:
                 scored.append((s, node))
-        if len(scored) < count and sample_n < len(self.node_names):
+        if len(scored) < count and sample_n < len(fleet):
             scored = []
-            for node in self.node_names:
+            for node in fleet:
                 s = self._score(node, size)
                 if s is not None:
                     scored.append((s, node))
@@ -584,6 +676,13 @@ class ChurnEngine:
             try:
                 if self._stop.is_set():
                     return  # shutting down: don't admit into the drain
+                agent = self.agents.get(node)
+                if agent is None:
+                    # host preempted between pick and admission: a load
+                    # condition of a churning fleet, not an error
+                    self._bump("failures_total")
+                    self._bump("failures_no_host")
+                    return
                 pod = self._make_pod(node, size, job_id)
                 if pod is None:
                     self._bump("failures_total")
@@ -599,7 +698,7 @@ class ChurnEngine:
                         pass
                 t0 = time.perf_counter()
                 try:
-                    self.agents[node].allocate(size, pod)
+                    agent.allocate(size, pod)
                 except PodGoneError:
                     self._bump("cancelled_total")
                     return
@@ -641,12 +740,17 @@ class ChurnEngine:
             if self._stop.is_set():
                 return  # shutting down: don't admit into the drain
             for node in nodes:
+                agent = self.agents.get(node)
+                if agent is None:
+                    # member host preempted mid-admission: the gang
+                    # rolls back whole (all-or-nothing)
+                    raise InsufficientChipsError(f"{node}: host vanished")
                 pod = self._make_pod(node, size, gang_id)
                 if pod is None:
                     raise InsufficientChipsError(f"{node}: pod create failed")
                 placed.append(pod)
                 t_alloc = time.perf_counter()
-                self.agents[node].allocate(size, pod, gang_id=gang_id)
+                agent.allocate(size, pod, gang_id=gang_id)
                 self.alloc_latency.add(
                     (time.perf_counter() - t_alloc) * 1000.0
                 )
@@ -688,7 +792,9 @@ class ChurnEngine:
         """Flip every chip on one simulated host (the churn half of a
         chip-death injection — kubesim's ``kill_node_chips`` covers the
         operator's view; this covers the plugin's)."""
-        agent = self.agents[node]
+        agent = self.agents.get(node)
+        if agent is None:
+            return  # host left the fleet: nothing to flip
         for dev in list(agent.servicer.snapshot()):
             if healthy:
                 agent.servicer.mark_healthy(dev)
@@ -696,8 +802,10 @@ class ChurnEngine:
                 agent.servicer.mark_unhealthy(dev)
 
     def sample_fragmentation(self) -> float:
+        with self._fleet_lock:
+            agents = list(self.agents.values())
         pct = fragmentation_pct(
-            (self.agents[n].free_ids() for n in self.node_names),
+            (a.free_ids() for a in agents),
             self.host_topology,
             self.generation,
         )
@@ -745,6 +853,10 @@ class ChurnEngine:
         """The ``/debug/vars`` "allocation" payload."""
         return {
             "nodes": len(self.node_names),
+            "hosts_attached": self.hosts_attached,
+            "hosts_detached": self.hosts_detached,
+            "pods_evicted_lifecycle": self.pods_evicted_lifecycle,
+            "gangs_rescheduled": self.gangs_rescheduled,
             "allocations_total": self.allocations_total,
             "alloc_per_min": self.rate_per_min_observed(),
             "failures_total": self.failures_total,

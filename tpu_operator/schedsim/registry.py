@@ -110,6 +110,36 @@ class AllocationRegistry:
                 self.releases_total += 1
             return freed
 
+    def release_node(self, node: str) -> int:
+        """Free every chip held on ``node`` — the host vanished (spot
+        preemption, scale-down): its kubelet/plugin sim is being
+        detached and a hold on hardware that no longer exists is a
+        zombie. Pods left holding chips ONLY on other nodes keep those
+        holds (their gang is the engine's problem — it terminates the
+        whole job); pods whose last hold this was leave the ledger.
+        Returns chips freed."""
+        with self._lock:
+            freed = 0
+            for (n, resource) in [
+                k for k in self._held if k[0] == node
+            ]:
+                slot = self._held.pop((n, resource))
+                freed += len(slot)
+            if not freed:
+                return 0
+            for pod_key in list(self._pods):
+                kept = [
+                    e for e in self._pods[pod_key] if e[0] != node
+                ]
+                if kept:
+                    self._pods[pod_key] = kept
+                else:
+                    del self._pods[pod_key]
+                    self._gang_of.pop(pod_key, None)
+                    self._gen_of.pop(pod_key, None)
+            self.releases_total += 1
+            return freed
+
     # -- views -----------------------------------------------------------
     def held_ids(self, node: str, resource: str) -> Set[str]:
         with self._lock:
@@ -141,6 +171,27 @@ class AllocationRegistry:
             return sorted(
                 p for p, g in self._gang_of.items() if g == gang_id
             )
+
+    def pods_on_node(self, node: str) -> List[str]:
+        """Pod keys holding any chip on ``node`` — the worklist a
+        lifecycle/repartition eviction sweeps (gang-aware: the caller
+        expands each pod to its whole gang)."""
+        with self._lock:
+            return sorted(
+                pod_key
+                for pod_key, entries in self._pods.items()
+                if any(e[0] == node for e in entries)
+            )
+
+    def gang_of(self, pod_key: str) -> Optional[str]:
+        with self._lock:
+            return self._gang_of.get(pod_key)
+
+    def nodes_holding(self) -> Set[str]:
+        """Every node with at least one held chip — the zombie-hold
+        invariant check compares this against the live fleet."""
+        with self._lock:
+            return {n for (n, _r), s in self._held.items() if s}
 
     def generation_of(self, pod_key: str):
         with self._lock:

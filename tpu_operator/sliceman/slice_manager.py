@@ -18,6 +18,18 @@ applies a named mig-parted layout, and reports via
   restoring them afterwards;
 * reports through ``tpu.k8s.io/tpu.slice.config.state`` ∈
   pending|success|failed.
+
+**Fleet rolls**: this daemon is deliberately per-node and level-
+triggered — a CHANGED desired config label re-enters the apply path on
+the next pass (the ``want == applied and state == success`` early
+return only holds while both match), so the fleet-level re-partition
+controller (``controllers/repartition.py``) can roll a new named layout
+across a busy fleet by rewriting ``tpu.k8s.io/tpu.slice.config`` node
+by node under the shared disruption budget, resetting the state label
+to ``pending`` at admission (a stale ``success`` from the PREVIOUS
+layout must not read as done). The ``STATE_*`` values here are that
+controller's contract; see docs/robustness.md "Live slice
+re-partitioning".
 """
 
 from __future__ import annotations

@@ -507,6 +507,10 @@ class SliceBudget:
     # cordon-drain/quarantined/exhausted): upgrades and repairs share ONE
     # maxUnavailable pool, so these consume upgrade admission too
     repair_sids: set = field(default_factory=set)
+    # slices mid live re-partition roll (controllers/repartition.py) —
+    # the THIRD consumer of the same pool: a host whose chip clients are
+    # paused for a layout change is just as unavailable as one draining
+    repartition_sids: set = field(default_factory=set)
 
 
 def remediation_disrupted(node: Obj) -> bool:
@@ -538,27 +542,39 @@ def slice_budget(state: ClusterUpgradeState, policy) -> SliceBudget:
         for sid, entries in groups.items()
         if any(remediation_disrupted(e.node) for e in entries)
     }
-    # repair slices are excluded from PENDING too, not just subtracted
-    # from headroom: admitting a quarantined slice would cordon/drain a
-    # chips-dead host into a guaranteed validation failure, landing it
-    # upgrade-failed — which the remediator then defers to, freezing the
-    # quarantine until a human unpicks both FSMs
+    from tpu_operator.kube.disruption import repartition_disrupted
+
+    repartition = {
+        sid
+        for sid, entries in groups.items()
+        if any(repartition_disrupted(e.node) for e in entries)
+    }
+    # repair/repartition slices are excluded from PENDING too, not just
+    # subtracted from headroom: admitting a quarantined slice would
+    # cordon/drain a chips-dead host into a guaranteed validation
+    # failure, landing it upgrade-failed — which the remediator then
+    # defers to, freezing the quarantine until a human unpicks both FSMs;
+    # a mid-repartition slice's chip clients are paused and its validator
+    # would fail the roll the same way
     pending = {
         sid
         for sid, entries in groups.items()
         if any(e.state == STATE_UPGRADE_REQUIRED for e in entries)
-    } - active - failed - repair
+    } - active - failed - repair - repartition
     max_unavailable = parse_max_unavailable(policy.max_unavailable, len(groups))
     admit = max(
         0,
         min(
             (policy.max_parallel_upgrades or 1) - len(active),
-            # upgrades + repairs draw on ONE pool: a slice quarantined by
-            # the remediator is just as unavailable as one mid-upgrade
-            max_unavailable - len(active | failed | repair),
+            # upgrades + repairs + re-partitions draw on ONE pool: a
+            # slice quarantined by the remediator or mid layout roll is
+            # just as unavailable as one mid-upgrade
+            max_unavailable - len(active | failed | repair | repartition),
         ),
     )
-    return SliceBudget(groups, active, failed, pending, admit, repair)
+    return SliceBudget(
+        groups, active, failed, pending, admit, repair, repartition
+    )
 
 
 class ClusterUpgradeStateManager:
@@ -949,6 +965,16 @@ class ClusterUpgradeStateManager:
                         + (f". Last eviction veto: {veto}" if veto else ""),
                     )
         self.pinned_slices = pinned
+        # retire per-node drain bookkeeping for nodes no longer in the
+        # FSM (deleted mid-roll by a preemption wave, completed, or
+        # skip-labeled): under lifecycle churn the map would otherwise
+        # grow without bound — node names are never reused-safe — and a
+        # stale veto string could misattribute a later stall
+        live_names = {ns.node["metadata"]["name"] for ns in state.all()}
+        for gone in [
+            n for n in self.drain.last_block_reason if n not in live_names
+        ]:
+            del self.drain.last_block_reason[gone]
 
         def pod_restart_step(ns):
             # delete the operand pod; the OnDelete DaemonSet restarts

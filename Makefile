@@ -66,6 +66,7 @@ validate:
 	python -m tpu_operator.cfg.main validate bundle --dir bundle
 	$(MAKE) bench-gate
 	$(MAKE) bench-converge
+	$(MAKE) bench-warm
 	$(MAKE) bench-alloc
 	$(MAKE) chaos-fast
 	$(MAKE) chaos-soak-fast
@@ -98,6 +99,13 @@ bench-gate:
 # bench box) — trips when the convergence write path re-serializes
 bench-converge:
 	python -m pytest tests/test_converge_bench.py -q -m slow -p no:cacheprovider
+
+# CI warm-restart gate: converge a 1000-node fleet cold, save the warm
+# journal, restart against the unchanged world — the first warm pass
+# must issue ZERO writes and ZERO LISTs with the journal actually
+# loaded (a silent cold-start fallback trips the re-list assertion)
+bench-warm:
+	python -m pytest tests/test_warm_bench.py -q -m slow -p no:cacheprovider
 
 # CI allocation gate: 1000-node scheduling churn through the real
 # device-plugin path, concurrent with convergence and a remediation

@@ -475,6 +475,7 @@ def run_fleet_convergence(
     timeout_s: int = 180,
     join_storm: int = 0,
     preempt_pct: float = 0.0,
+    warm_restart: bool = False,
 ) -> dict:
     """Fleet-scale time-to-Ready: an ``n_nodes`` pool converged by the
     full Manager against the kubesim apiserver with a faithful per-node
@@ -496,11 +497,19 @@ def run_fleet_convergence(
         args += ["--join-storm", str(join_storm)]
     if preempt_pct:
         args += ["--preempt-pct", str(preempt_pct)]
+    if warm_restart:
+        args += ["--warm-restart"]
     # the script applies --timeout PER PHASE (initial converge, join
-    # storm, preemption recovery each get their own deadline), so the
-    # subprocess wall budget must cover every enabled phase — a single
-    # timeout_s here would kill a run whose phases are each legal
-    phases = 1 + (1 if join_storm else 0) + (1 if preempt_pct else 0)
+    # storm, preemption recovery and warm restart each get their own
+    # deadline), so the subprocess wall budget must cover every enabled
+    # phase — a single timeout_s here would kill a run whose phases are
+    # each legal
+    phases = (
+        1
+        + (1 if join_storm else 0)
+        + (1 if preempt_pct else 0)
+        + (1 if warm_restart else 0)
+    )
     wall_timeout_s = timeout_s * phases + 60
     try:
         proc = subprocess.run(
@@ -769,7 +778,14 @@ def main() -> int:
     # variant buries the cluster in 20k unrelated pods to prove the
     # SCOPED Pod informer keeps operator memory inside the reference's
     # published envelope (values.yaml:106-112: 350Mi)
-    fleet_1000 = run_fleet_convergence(n_nodes=1000, timeout_s=540)
+    # the 1000-node axis ALSO runs the cold-vs-warm restart comparison
+    # (ISSUE 8): the same run reports cold time_to_ready_s next to
+    # warm_start_ms / warm_first_pass_writes / warm_relists — the warm
+    # restart must re-derive nothing (zero writes, zero lists) or the
+    # axis (and the bench) fails
+    fleet_1000 = run_fleet_convergence(
+        n_nodes=1000, timeout_s=540, warm_restart=True
+    )
     fleet_populated = run_fleet_convergence(
         n_nodes=100, bulk_pods=20000, timeout_s=540
     )

@@ -139,20 +139,50 @@ def main(argv=None) -> int:
         "percentage of the fleet in one spot-preemption wave and report "
         "preempt_recover_s (orphaned state must reconcile)",
     )
+    p.add_argument(
+        "--warm-restart",
+        action="store_true",
+        help="after the steady-state measurement, restart the operator "
+        "from the warm journal (kube/warm.py) against the unchanged "
+        "world and report warm_start_ms / warm_first_pass_writes / "
+        "warm_relists — the first warm pass must be zero-write and "
+        "zero-list",
+    )
     args = p.parse_args(argv)
 
     # a list, not a tuple: the join storm grows it mid-run and the
     # kubelet sweep reads the latest membership each pass
     nodes = [f"fleet-{i}" for i in range(args.nodes)]
-    server = KubeSimServer(KubeSim()).start()
+    # event-log retention sized for fleet scale: real etcd keeps minutes
+    # of history (default compaction interval 5 min), so a watch stream
+    # that is a burst behind the head can still resume; the unit-test
+    # default (512) would compact a single fleet-wide label wave away
+    # mid-flight and force spurious 410 re-lists
+    server = KubeSimServer(KubeSim(compact_keep=16384)).start()
     client = make_client(server.port)
     client.GET_RETRY_BACKOFF_S = 0.05
-    seed_cluster(client, NS, node_names=nodes)
+    # seed the namespace/CRD/CR over the wire, but materialize the fleet
+    # in-process (kubesim add_nodes, the same admission path): the bench
+    # measures the operator converging an EXISTING fleet, and N harness
+    # node POSTs were both a request-count floor and seconds of wall
+    # before t0 that had nothing to do with the operator
+    seed_cluster(client, NS, node_names=())
+    server.sim.add_nodes(len(nodes), names=nodes)
     if args.pods:
         _seed_bulk_pods(client, args.pods, args.pod_namespaces)
 
+    warm_path = None
+    if args.warm_restart:
+        import tempfile
+
+        warm_path = os.path.join(
+            tempfile.mkdtemp(prefix="fleet-warm-"), "warm.json"
+        )
+
     t0 = time.monotonic()
-    mgr, reconciler, _ = build_manager(client, NS, metrics_port=0, probe_port=0)
+    mgr, reconciler, _ = build_manager(
+        client, NS, metrics_port=0, probe_port=0, warm_state=warm_path
+    )
     stop = threading.Event()
     wire_event_sources(mgr, client, NS, stop_event=stop)
     mgr.start()
@@ -165,19 +195,36 @@ def main(argv=None) -> int:
         # of pods, and doing that 10×/s steals the shared interpreter
         # from the operator whose convergence this bench measures
         idle_sleep = 0.05
+
+        def writes_now():
+            # pod creates ride the batched APPLY verb now; POST/PUT
+            # alone would read a pod-creating sweep as idle and back
+            # the cadence off mid-materialization
+            return sum(
+                server.sim.request_counts.get(v, 0)
+                for v in ("POST", "PUT", "APPLY")
+            )
+
         while not halt.is_set():
-            before = server.sim.request_counts.get(
-                "POST", 0
-            ) + server.sim.request_counts.get("PUT", 0)
+            before = writes_now()
+            t_sweep = time.monotonic()
             try:
                 simulate_kubelet_nodes(client, NS, nodes, halt_event=halt)
             except (ConflictError, NotFoundError, TransientAPIError, OSError):
                 pass
-            wrote = (
-                server.sim.request_counts.get("POST", 0)
-                + server.sim.request_counts.get("PUT", 0)
-            ) > before
-            idle_sleep = 0.05 if wrote else min(idle_sleep * 2, 1.0)
+            sweep_s = time.monotonic() - t_sweep
+            wrote = writes_now() > before
+            # idle cadence proportional to sweep cost: a no-op sweep at
+            # 1000 nodes LISTs ~9k pods (~1s of pure CPU) — re-running
+            # that every second steals the shared interpreter from the
+            # operator whose convergence this bench measures; pacing at
+            # 2× the measured sweep duration caps the kubelet's idle
+            # CPU share at ~33% regardless of fleet size
+            idle_sleep = (
+                0.05
+                if wrote
+                else min(max(idle_sleep * 2, 2.0 * sweep_s), 5.0)
+            )
             halt.wait(idle_sleep)
 
     kubelet_thread = threading.Thread(target=kubelet, daemon=True)
@@ -304,7 +351,7 @@ def main(argv=None) -> int:
     # pipeline exists to shrink (serial RTT × writes vs overlapped)
     converge_writes = sum(
         server.sim.request_counts.get(verb, 0)
-        for verb in ("POST", "PUT", "PATCH", "DELETE")
+        for verb in ("POST", "PUT", "PATCH", "APPLY", "DELETE")
     )
     converge_wall_per_write_us = (
         round(elapsed * 1e6 / converge_writes, 1) if converge_writes else None
@@ -370,10 +417,85 @@ def main(argv=None) -> int:
         if inf is not None and inf.synced.is_set():
             pod_informer_objects = len(inf)
 
+    # -- warm-restart axis (ISSUE 8): restart the operator against the
+    # UNCHANGED world from the journal mgr.stop() just saved — the first
+    # pass must re-derive nothing: zero writes, zero re-lists, informers
+    # seeded in memory and watches resumed at the journal rv
+    warm = None
+    warm_ok = True
+    if args.warm_restart:
+        # the warm claim is "unchanged inputs, zero re-derivation" — so
+        # first let the COLD operator fully settle (a kubelet sweep
+        # aborted by the halt can leave trailing drift that the next
+        # pass or two repairs) and re-save the journal against the
+        # settled world; only then is a restarted operator's write an
+        # actual warm-path bug
+        for _ in range(10):
+            before_q = server.sim.requests_total()
+            try:
+                reconciler.reconcile()
+            except Exception:
+                break
+            if server.sim.requests_total() == before_q:
+                break
+        save_warm = getattr(reconciler, "save_warm_state", None)
+        if callable(save_warm):
+            save_warm()
+        write_verbs = ("POST", "PUT", "PATCH", "APPLY", "DELETE")
+        before_w = {v: server.sim.request_counts.get(v, 0) for v in write_verbs}
+        before_l = server.sim.request_counts.get("LIST", 0)
+        client2 = make_client(server.port)
+        client2.GET_RETRY_BACKOFF_S = 0.05
+        t_warm = time.monotonic()
+        mgr2, rec2, _ = build_manager(
+            client2, NS, metrics_port=0, probe_port=0, warm_state=warm_path
+        )
+        stop2 = threading.Event()
+        wire_event_sources(mgr2, client2, NS, stop_event=stop2)
+        mgr2.start()
+        warm_start_ms = None
+        try:
+            mgr2.enqueue("clusterpolicy")
+            deadline_w = time.monotonic() + args.timeout
+            while time.monotonic() < deadline_w:
+                if rec2.passes_total >= 1:
+                    warm_start_ms = round(
+                        (time.monotonic() - t_warm) * 1000.0, 1
+                    )
+                    break
+                time.sleep(0.05)
+        finally:
+            stop2.set()
+            mgr2.stop()
+        warm_writes = sum(
+            server.sim.request_counts.get(v, 0) - before_w[v]
+            for v in write_verbs
+        )
+        warm_relists = server.sim.request_counts.get("LIST", 0) - before_l
+        warm_stats = getattr(rec2, "warm_stats", {})
+        warm = {
+            "warm_start_ms": warm_start_ms,
+            "warm_seed_ms": warm_stats.get("seed_ms"),
+            "warm_loaded": warm_stats.get("loaded", False),
+            "warm_informer_kinds": warm_stats.get("seeded", {}).get(
+                "informer_kinds", 0
+            ),
+            "warm_first_pass_writes": warm_writes,
+            "warm_relists": warm_relists,
+        }
+        # the axis's whole claim: unchanged inputs, zero re-derivation
+        warm_ok = (
+            warm_start_ms is not None
+            and bool(warm_stats.get("loaded"))
+            and warm_writes == 0
+            and warm_relists == 0
+        )
+
     stop.set()
     server.stop()
+    batch = reconciler.ctrl.batch_stats()
     out = {
-        "ok": ok and steady_ok and cache_ok and alloc_ok,
+        "ok": ok and steady_ok and cache_ok and alloc_ok and warm_ok,
         "nodes": args.nodes,
         "bulk_pods": args.pods,
         "time_to_ready_s": round(elapsed, 2),
@@ -383,6 +505,16 @@ def main(argv=None) -> int:
         "preempt_recover_s": preempt_recover,
         "converge_requests": converge_requests,
         "converge_writes": converge_writes,
+        # the server-side-apply engine's own ledger: how many APPLYs the
+        # converge took, how many hit a field-ownership conflict, and
+        # how full the batch lanes ran (amortization is real only when
+        # fill_avg > 1 under fan-out load)
+        "converge_applies": server.sim.request_counts.get("APPLY", 0),
+        "apply_conflicts": server.sim.apply_conflicts,
+        "batch_fill_avg": batch["fill_avg"],
+        "batch_items_total": batch["items_total"],
+        "batch_batches_total": batch["batches_total"],
+        "applyset_members": reconciler.ctrl.applyset.stats()["members"],
         "converge_wall_per_write_us": converge_wall_per_write_us,
         "write_pipeline_depth": pipeline_stats["depth"],
         "write_pipeline_submitted": pipeline_stats["submitted_total"],
@@ -402,6 +534,9 @@ def main(argv=None) -> int:
         "peak_rss_mib": _peak_rss_mib(),
         "pod_informer_objects": pod_informer_objects,
     }
+    if warm is not None:
+        out.update(warm)
+        out["warm_ok"] = warm_ok
     if alloc_stats is not None:
         out.update(
             {
@@ -416,7 +551,7 @@ def main(argv=None) -> int:
             }
         )
     print(json.dumps(out))
-    return 0 if ok and steady_ok and cache_ok and alloc_ok else 1
+    return 0 if ok and steady_ok and cache_ok and alloc_ok and warm_ok else 1
 
 
 if __name__ == "__main__":

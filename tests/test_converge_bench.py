@@ -8,11 +8,16 @@ The ceiling is seeded from the PRE-concurrent-write-pipeline baseline on
 the bench box: main@PR4 measured 142.1-167.5 s across quiet/loaded
 rounds (24-28k serial RTTs — one fresh connection per request, one
 write at a time). The pipeline + pooled keep-alive connections + the
-request-volume cuts landed 34-41 s (alternating-runs A/B, min-of-rounds
-142.1 -> 34.1, 4.2x), so the generous 120 s ceiling (under every
-baseline round, ~3x the new measurement) trips on a return-to-serial
-regression class — a lost connection pool, a serialized fan-out, a
-restored per-pod GET sweep — without flaking on a loaded CI box.
+request-volume cuts landed 34-41 s (min-of-rounds 142.1 -> 34.1, 4.2x);
+the server-side apply engine (PR 8: one APPLY per object, batched
+group-commit submission) then cut converge_requests 11.5k -> ~0.4k and
+measured 17.8-43 s across quiet/loaded rounds on the ~1.5-CPU-share
+bench box (wall now dominated by the simulated kubelet's pod
+materialization, not the write path). Ceiling ratcheted 120 -> 90 s:
+still ~2x over the worst loaded round so a slow CI box doesn't flake,
+but under every pre-apply baseline round, so it trips on a
+return-to-serial regression class — a lost connection pool, a
+serialized fan-out, a restored per-object GET-compare-PUT path.
 """
 
 import json
@@ -25,7 +30,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 PRE_PIPELINE_BASELINE_S = 142.1  # main@PR4, same box, best of rounds
-CONVERGE_S_CEILING = float(os.environ.get("BENCH_CONVERGE_S_CEILING", "120"))
+CONVERGE_S_CEILING = float(os.environ.get("BENCH_CONVERGE_S_CEILING", "90"))
 ROUNDS = int(os.environ.get("BENCH_CONVERGE_ROUNDS", "2"))
 N_NODES = 1000
 
@@ -62,6 +67,18 @@ def test_fleet_converge_time_to_ready_under_ceiling():
         assert res["write_pipeline_errors"] == 0, res
         # the per-write wall metric the tentpole optimizes is reported
         assert res["converge_wall_per_write_us"] is not None, res
+        # the apply engine must carry the converge: APPLY verb flowed,
+        # no field-ownership conflicts on a quiet fleet, batches
+        # genuinely amortized (fill > 1), and total request volume
+        # stays an order of magnitude under the pre-apply 11.5k
+        assert res["converge_applies"] > 0, res
+        assert res["apply_conflicts"] == 0, res
+        assert res["batch_fill_avg"] > 1, res
+        assert res["converge_requests"] <= 5000, (
+            f"converge took {res['converge_requests']} apiserver requests "
+            f"(pre-apply baseline 11.5k, apply-engine budget 5k): the "
+            f"batched APPLY path has degraded to per-object round-trips"
+        )
     best = min(r["time_to_ready_s"] for r in results)
     assert best <= CONVERGE_S_CEILING, (
         f"1000-node time_to_ready min-of-{ROUNDS} {best:.1f}s exceeds the "

@@ -56,15 +56,22 @@ def test_fault_matrix_write_verbs_converge():
     client = _tune_client(make_client(server.port))
     seed_cluster(client, NS, node_names=("fm-node-1",))
 
-    # the write-verb matrix: every mutation verb takes error codes AND
-    # added latency; reads get a row too (LIST drives the informer seed)
+    # the write-verb matrix: every mutation verb the operator uses takes
+    # error codes AND added latency; reads get a row too (LIST drives
+    # the informer seed). APPLY carries the converge write path now
+    # (operand manifests, node labels, slice verdicts) so it gets the
+    # full 429/500/503/latency row set; PUT remains the CR status
+    # update; PATCH left the hot path entirely (everything that merged
+    # now APPLYs) so a PATCH row would sit unconsumed.
     sim.inject_fault("POST", "*", code=500, count=2)
     sim.inject_fault("POST", "*", code=429, retry_after=0.05, count=2)
     sim.inject_fault("PUT", "*", code=503, count=2)
     sim.inject_fault("PUT", "*", code=429, retry_after=0.05, count=1)
     sim.inject_fault("PUT", "*", latency_s=0.15, count=2)
-    sim.inject_fault("PATCH", "*", code=429, retry_after=0.05, count=2)
-    sim.inject_fault("PATCH", "*", code=500, count=1)
+    sim.inject_fault("APPLY", "*", code=429, retry_after=0.05, count=2)
+    sim.inject_fault("APPLY", "*", code=500, count=1)
+    sim.inject_fault("APPLY", "*", code=503, count=1)
+    sim.inject_fault("APPLY", "*", latency_s=0.15, count=2)
     sim.inject_fault("LIST", "*", code=500, count=2)
     injected = sim.faults_pending()
 
@@ -83,6 +90,10 @@ def test_fault_matrix_write_verbs_converge():
             stats = client.fault_stats()
             assert stats["retry"]["retries_total"] > 0
             assert stats["retry"]["retry_after_honored"] > 0
+            # the APPLY verb is a first-class citizen of the policy
+            # surface: its retries are counted under its own name (the
+            # wire carries it as a PATCH, the counters must not)
+            assert stats["retry"]["retries_by_verb"].get("APPLY", 0) > 0
 
             # DELETE row: disabling an operand forces a real DELETE,
             # faulted with a 500 the retry must absorb

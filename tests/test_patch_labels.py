@@ -249,9 +249,10 @@ def test_kubesim_patch_emits_modified_watch_event(kubesim_client):
 def test_concurrent_pause_override_survives_label_race(monkeypatch):
     """A human sets a deploy label to "false" (the documented pause
     override) between the operator's informer read and its label write.
-    The rv-conditioned patch 409s and the retry RECOMPUTES the delta
-    from the fresh node — the pause must never be reverted by the
-    operator's stale "true" decision."""
+    The human's patch moved the leaf to the ``unmanaged`` field owner,
+    so the operator's stale non-forced APPLY conflicts and the retry
+    RECOMPUTES the delta from the fresh node — the pause must never be
+    reverted by the operator's stale "true" decision."""
     import os
 
     import yaml
@@ -264,27 +265,32 @@ def test_concurrent_pause_override_survives_label_race(monkeypatch):
     paused_key = consts.DEPLOY_LABEL_PREFIX + "device-plugin"
 
     class RacingClient:
-        """Forwards everything; the FIRST label patch loses a race: an
-        admin writes the pause right before it, so its observed rv is
-        stale."""
+        """Forwards everything; the FIRST batched label apply naming the
+        deploy key loses a race: an admin writes the pause right before
+        it lands, so the operator's applied value is a stale decision."""
 
         def __init__(self, inner):
             self._inner = inner
             self.raced = False
 
-        def patch_labels(
-            self, av, kind, name, namespace="", labels=None,
-            resource_version=None,
-        ):
-            if not self.raced and labels and paused_key in labels:
+        def apply_ssa_batch(self, items, **kw):
+            named = [
+                obj
+                for obj, _ in (
+                    i if isinstance(i, tuple) else (i, False) for i in items
+                )
+                if paused_key
+                in (obj.get("metadata", {}).get("labels") or {})
+            ]
+            if not self.raced and named:
                 self.raced = True
                 self._inner.patch_labels(
-                    av, kind, name, namespace, labels={paused_key: "false"}
+                    "v1",
+                    "Node",
+                    named[0]["metadata"]["name"],
+                    labels={paused_key: "false"},
                 )
-            return self._inner.patch_labels(
-                av, kind, name, namespace, labels=labels,
-                resource_version=resource_version,
-            )
+            return self._inner.apply_ssa_batch(items, **kw)
 
         def __getattr__(self, attr):
             return getattr(self._inner, attr)

@@ -1,0 +1,70 @@
+"""Warm-restart regression gate (slow-marked; ``make bench-warm``).
+
+Converges a 1000-node kubesim fleet cold, saves the warm journal
+(render fingerprint + informer snapshots + apply-set membership,
+``kube/warm.py``), then restarts the operator against the UNCHANGED
+world and gates on the warm axis's whole claim: the first warm pass
+re-derives nothing — zero writes on any verb, zero LISTs, journal
+actually loaded (a schema/namespace/staleness mismatch silently falls
+back to a cold start, which this gate must catch).
+
+``fleet_converge --warm-restart`` computes the verdict itself
+(``warm_ok`` folds into ``ok``); this test pins the individual fields
+so a regression names the exact broken half (a stray write vs a
+re-list vs a journal that never loaded) instead of a bare ``not ok``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_NODES = int(os.environ.get("BENCH_WARM_NODES", "1000"))
+
+
+def _converge_warm():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "tests", "scripts", "fleet_converge.py"),
+            "--nodes",
+            str(N_NODES),
+            "--warm-restart",
+            "--timeout",
+            "300",
+        ],
+        cwd=REPO,
+        env=dict(os.environ, OPERATOR_NAMESPACE="tpu-operator"),
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, (proc.stderr or proc.stdout)[-1024:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_warm_restart_first_pass_is_zero_write():
+    res = _converge_warm()
+    assert res["ok"], res
+    # the journal must genuinely load — a cold-start fallback would
+    # still converge (and even look zero-write on a small fleet once
+    # the world matches), but it re-lists, which the next field pins
+    assert res["warm_loaded"], res
+    assert res["warm_informer_kinds"] > 0, res
+    # the claim itself: unchanged inputs, zero re-derivation
+    assert res["warm_first_pass_writes"] == 0, (
+        f"warm first pass issued {res['warm_first_pass_writes']} writes "
+        f"against an unchanged world: {res}"
+    )
+    assert res["warm_relists"] == 0, (
+        f"warm restart re-listed {res['warm_relists']} kinds instead of "
+        f"seeding informers from the journal: {res}"
+    )
+    # and it must be fast relative to the cold converge it replaces
+    assert res["warm_start_ms"] is not None, res
+    assert res["warm_start_ms"] < res["time_to_ready_s"] * 1000.0, res

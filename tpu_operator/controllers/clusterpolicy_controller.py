@@ -120,6 +120,9 @@ class ClusterPolicyReconciler:
             self.ctrl.init(primary)
         except Exception:
             log.exception("init failed")
+            # init may have opened an apply-set pass before raising; an
+            # empty commit would read as "prune everything"
+            self.ctrl.applyset.abort()
             self._set_status(primary, State.NOT_READY)
             self.metrics.observe_reconcile(-1)
             raise
@@ -128,6 +131,9 @@ class ClusterPolicyReconciler:
         # (reference :170-182); has_tpu_nodes was computed by init's
         # label_tpu_nodes pass over the node list
         if not self.ctrl.has_tpu_nodes:
+            # no states ran, nothing registered: sealing this pass would
+            # prune every previously-applied object
+            self.ctrl.applyset.abort()
             self._set_status(primary, State.NOT_READY)
             self.metrics.observe_reconcile(0)
             self._update_fleet_metrics()
@@ -176,6 +182,18 @@ class ClusterPolicyReconciler:
         # world the states just wrote). Errors already surfaced through
         # the per-state futures; drain only collects stragglers.
         self.ctrl.writes.drain()
+
+        # apply-set pruning: a pass that ran EVERY state to completion
+        # holds the complete intended-object picture — seal it and
+        # delete what an earlier pass applied but this one abandoned
+        # (the renamed-DaemonSet leak). An errored state's registrations
+        # are incomplete, so that pass aborts instead: membership stays
+        # at the last complete picture and nothing is pruned on partial
+        # information.
+        if errored_states:
+            self.ctrl.applyset.abort()
+        else:
+            self.ctrl.prune_abandoned()
 
         # node-health remediation (its quarantine label writes move the
         # Node store version, so the slice aggregate below never memoizes
@@ -359,6 +377,7 @@ class ClusterPolicyReconciler:
                     self.ctrl.namespace,
                     tpu_nodes,
                     pipeline=self.ctrl.writes,
+                    lane=self.ctrl.label_lane,
                 )
             except Exception:
                 log.exception("slice readiness aggregation failed")

@@ -115,13 +115,28 @@ def _fill_namespace(n, obj: Obj) -> None:
 
 
 def apply_with_hash(n, obj: Obj, precomputed_hash: Optional[str] = None) -> str:
-    """Create-or-update gated on the content hash; returns the hash.
+    """Hash-gated server-side APPLY; returns the hash.
+
+    The steady state costs ZERO requests: the cached object's
+    ``last-applied-hash`` annotation matches the rendered hash and
+    nothing is sent. Any drift (or absence) costs exactly ONE request —
+    a force-owned APPLY (``kube/apply.py``) that creates-or-merges
+    server-side under field ownership. The old GET-compare-PUT shape is
+    gone entirely, and with it the 409 path that re-GET and re-PUT the
+    whole object: an APPLY carries no resourceVersion, so a concurrent
+    kubelet status stamp can no longer conflict with a manifest write
+    at all, and fields the operator stopped rendering are pruned by
+    omission instead of surviving a merge.
 
     With ``precomputed_hash`` (the render-cache path) ``obj`` is a
-    pre-annotated — and possibly FROZEN — rendered manifest: the hash is
-    not recomputed and the object is never mutated here. The drift
-    branch deep-copies before touching resourceVersion, which thaws a
-    frozen view into a private mutable object."""
+    pre-annotated — and possibly FROZEN — rendered manifest: the hash
+    is not recomputed and the object is never mutated here (every
+    ``apply_ssa`` implementation treats its input as read-only).
+
+    Every intended object also registers in the pass's apply-set
+    (``n.applyset``) — including on the no-op branch — so a later pass
+    that stops intending it (a renamed DaemonSet, a dropped generation)
+    prunes it with no hand-written delete path."""
     if precomputed_hash is None:
         h = compute_hash(obj)
         obj.setdefault("metadata", {}).setdefault("annotations", {})[
@@ -131,34 +146,34 @@ def apply_with_hash(n, obj: Obj, precomputed_hash: Optional[str] = None) -> str:
         h = precomputed_hash
     av, kind = obj["apiVersion"], obj["kind"]
     meta = obj["metadata"]
+    aps = getattr(n, "applyset", None)
+    if aps is not None:
+        aps.seen(av, kind, meta.get("namespace", ""), meta["name"])
     existing = n.client.get_or_none(av, kind, meta["name"], meta.get("namespace", ""))
-    if existing is None:
-        n.client.create(obj)
-        return h
-    old_hash = (
-        existing.get("metadata", {}).get("annotations", {}) or {}
-    ).get(consts.LAST_APPLIED_HASH_ANNOTATION)
-    if old_hash == h:
-        return h  # no-op: idempotent reconcile
-    merged = copy.deepcopy(obj)
-    merged["metadata"]["resourceVersion"] = existing["metadata"].get(
-        "resourceVersion"
-    )
-    try:
-        n.client.update(merged)
-    except ConflictError:
-        # the rv can be stale behind an informer cache (or the kubelet
-        # stamped status between our read and write): one live refresh —
-        # the operator owns everything but status on its operands, so
-        # re-applying the rendered manifest at the fresh rv is safe
-        fresh = getattr(n.client, "get_live", n.client.get)(
-            av, kind, meta["name"], meta.get("namespace", "")
-        )
-        merged["metadata"]["resourceVersion"] = fresh["metadata"].get(
-            "resourceVersion"
-        )
-        n.client.update(merged)
+    if existing is not None:
+        old_hash = (
+            existing.get("metadata", {}).get("annotations", {}) or {}
+        ).get(consts.LAST_APPLIED_HASH_ANNOTATION)
+        if old_hash == h:
+            return h  # no-op: idempotent reconcile, zero requests
+    _submit_apply(n, obj)
     return h
+
+
+def _submit_apply(n, obj: Obj) -> Obj:
+    """One manifest APPLY, batched when the controller carries an apply
+    lane: concurrent states of a DAG wave submitting sibling manifests
+    group-commit into multi-object submissions (per-item status
+    fan-back keeps each control's error its own). Controllers without a
+    lane (unit tests driving a control directly) apply inline."""
+    lane = getattr(n, "apply_lane", None)
+    if lane is not None:
+        return lane.submit(
+            (obj.get("kind", ""), obj["metadata"].get("namespace", ""),
+             obj["metadata"].get("name", "")),
+            obj,
+        ).result()
+    return n.client.apply_ssa(obj, force=True, prune=True)
 
 
 def _render_memo(

@@ -168,6 +168,63 @@ class RenderCache:
             self.renders_total += 1
 
     # ------------------------------------------------------------------
+    # warm restart (kube/warm.py journal)
+    # ------------------------------------------------------------------
+    def export(self) -> Dict[str, Any]:
+        """JSON-serializable snapshot of the cache (entries thawed to
+        plain dicts) plus the base fingerprint it was rendered for —
+        the warm journal's render half."""
+        from tpu_operator.kube.frozen import thaw
+
+        with self._lock:
+            return {
+                "base_fp": self._base_fp,
+                "generations": list(self._generations),
+                "entries": [
+                    {
+                        "key": list(key),
+                        "obj": thaw(obj),
+                        "hash": h,
+                        "generation": gen,
+                    }
+                    for key, (obj, h, gen) in self._entries.items()
+                ],
+            }
+
+    def seed(self, payload: Dict[str, Any]) -> int:
+        """Load a journal snapshot BEFORE the first pass. The seeded
+        base fingerprint is compared by the next ``begin_pass`` exactly
+        like a live one: a restart whose inputs changed invalidates the
+        seeded entries through the normal path, so a stale journal can
+        never serve wrong manifests. Returns entries seeded."""
+        from tpu_operator.kube.frozen import freeze
+
+        base_fp = payload.get("base_fp")
+        entries = payload.get("entries") or []
+        if not base_fp or not entries:
+            return 0
+        with self._lock:
+            if self._base_fp is not None:
+                return 0  # a live pass already ran: its picture wins
+            self._base_fp = base_fp
+            self._generations = tuple(sorted(payload.get("generations") or ()))
+            self.fingerprint = _digest(
+                {"base": base_fp, "generations": list(self._generations)}
+            )
+            for ent in entries:
+                key = ent.get("key")
+                obj = ent.get("obj")
+                h = ent.get("hash")
+                if not key or len(key) != 4 or obj is None or not h:
+                    continue
+                self._entries[tuple(key)] = (
+                    freeze(obj),
+                    h,
+                    ent.get("generation"),
+                )
+            return len(self._entries)
+
+    # ------------------------------------------------------------------
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)

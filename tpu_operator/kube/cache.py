@@ -252,6 +252,12 @@ class Informer:
         # DELETED between list() and replace() must not be resurrected by
         # the older snapshot
         self._tombstones: Dict[Tuple[str, str], int] = {}
+        # stream resume position (client-go LastSyncResourceVersion):
+        # advanced by every watch event AND bookmark, so a QUIET kind's
+        # journal resume point tracks the collection head instead of its
+        # own (ancient) max object rv — which the apiserver compacts past
+        # within minutes, turning every warm resume into a 410 re-list
+        self._resume_rv = 0
         # recent deletions (key -> (rv, monotonic)) consulted by resync's
         # ADDED-repair direction: an object deleted between the resync
         # LIST being cut and the repair pass must not be resurrected from
@@ -579,6 +585,29 @@ class Informer:
             self.list_seconds += perf_counter() - t0
             return out
 
+    def note_progress(self, rv) -> None:
+        """Record the watch stream's position (event or bookmark rv).
+        Monotonic — a racing older report can't rewind the resume point."""
+        try:
+            rv = int(rv)
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            if rv > self._resume_rv:
+                self._resume_rv = rv
+
+    def export(self) -> Tuple[List[Obj], int]:
+        """Snapshot for the warm-restart journal: private mutable copies
+        of every stored object plus the stream's resume position — the
+        bookmark-advanced rv where a warm watch picks up (falling back
+        to the max stored object rv when no stream ever reported)."""
+        with self._lock:
+            objs = [thaw(self._store[k]) for k in self._sorted_keys_locked()]
+            max_rv = max(
+                (_rv_int(o) or 0 for o in self._store.values()), default=0
+            )
+            return objs, max(self._resume_rv, max_rv)
+
     def read_stats(self) -> Dict[str, float]:
         with self._lock:
             return {
@@ -635,6 +664,10 @@ class CachedClient(Client):
             for av, kind, ns in specs
         }
         self._hooks: List[Callable[[str, Obj], None]] = []
+        # (av, kind) -> (resume rv, known keys) installed by seed_from:
+        # a warm-restarted informer opens its watch AT the journal's
+        # resourceVersion instead of re-LISTing the world
+        self._warm_seed: Dict[Tuple[str, str], Tuple[str, set]] = {}
         self._started = False
         self._threads: List[threading.Thread] = []
         # owned by this cache so stop() works even when the caller never
@@ -712,19 +745,26 @@ class CachedClient(Client):
             log.warning("underlying client has no watch; cache stays passthrough")
             return False
         for (av, kind), inf in self._informers.items():
+            kwargs = {
+                "namespace": inf.namespace,
+                "stop_event": self._stop_event,
+                "on_sync": inf.synced.set,
+                "on_progress": inf.note_progress,
+                # rest.WATCH_WINDOW_S windows bound SILENT staleness:
+                # a watch whose server half died without closing the
+                # socket freezes this informer until the socket times
+                # out, and a frozen Node cache can pin the upgrade
+                # budget on ghost nodes (seed-777 soak wedge)
+            }
+            seed = self._warm_seed.get((av, kind))
+            if seed is not None:
+                # warm restart: stream from the journal rv, no re-list
+                # (a 410 inside watch() falls back to a normal list)
+                kwargs["seed_rv"], kwargs["seed_known"] = seed
             t = threading.Thread(
                 target=self.live.watch,
                 args=(av, kind, lambda e, o, i=inf: self._dispatch(i, e, o)),
-                kwargs={
-                    "namespace": inf.namespace,
-                    "stop_event": self._stop_event,
-                    "on_sync": inf.synced.set,
-                    # rest.WATCH_WINDOW_S windows bound SILENT staleness:
-                    # a watch whose server half died without closing the
-                    # socket freezes this informer until the socket times
-                    # out, and a frozen Node cache can pin the upgrade
-                    # budget on ghost nodes (seed-777 soak wedge)
-                },
+                kwargs=kwargs,
                 daemon=True,
                 name=f"informer-{kind}",
             )
@@ -902,6 +942,55 @@ class CachedClient(Client):
             return None
         return inf.store_version
 
+    # -- warm restart (kube/warm.py journal) -----------------------------
+    def export_state(self) -> Dict[str, Dict]:
+        """Per-kind store snapshot + resume resourceVersion for the
+        warm-restart journal — everything a restarted operator needs to
+        reach its first steady pass without re-LISTing the world."""
+        out: Dict[str, Dict] = {}
+        for (av, kind), inf in self._informers.items():
+            if not inf.synced.is_set():
+                continue
+            objs, rv = inf.export()
+            out[f"{av}|{kind}"] = {
+                "namespace": inf.namespace,
+                "rv": rv,
+                "objects": objs,
+            }
+        return out
+
+    def seed_from(self, state: Dict[str, Dict]) -> int:
+        """Seed informer stores from a journal snapshot BEFORE
+        ``start_informers``: each seeded kind marks synced immediately
+        and its watch stream resumes from the journal rv instead of
+        issuing an initial LIST. Self-healing covers a stale journal —
+        a compacted rv 410s into a normal re-list, and the periodic
+        resync repairs drift. Returns how many kinds were seeded."""
+        if self._started:
+            return 0
+        seeded = 0
+        for key, payload in (state or {}).items():
+            av, _, kind = key.partition("|")
+            inf = self._informers.get((av, kind))
+            if inf is None or not kind:
+                continue
+            objs = payload.get("objects") or []
+            for o in objs:
+                o.setdefault("apiVersion", av)
+                o.setdefault("kind", kind)
+            inf.replace(objs)
+            inf.note_progress(payload.get("rv"))
+            known = {
+                (
+                    o.get("metadata", {}).get("namespace", ""),
+                    o.get("metadata", {}).get("name", ""),
+                )
+                for o in objs
+            }
+            self._warm_seed[(av, kind)] = (str(payload.get("rv") or ""), known)
+            seeded += 1
+        return seeded
+
     def cache_info(self) -> Dict[str, Optional[int]]:
         """Per-kind store sizes for the debug surface; an UNSYNCED kind
         reports ``None`` (reads fall through live) — distinguishable from
@@ -1040,6 +1129,49 @@ class CachedClient(Client):
         if isinstance(updated, dict):
             self._write_through(updated)
         return updated
+
+    def apply_ssa(
+        self, obj, field_manager=None, force=True, prune=True,
+        create_only=False, update_only=False,
+    ):
+        """APPLY passes through to the live client (which owns the
+        merge — natively or over the wire) and write-throughs the
+        response, so apply → readiness-check sees fresh data without a
+        watch round-trip."""
+        fn = getattr(self.live, "apply_ssa", None)
+        if callable(fn):
+            applied = fn(
+                obj, field_manager=field_manager, force=force, prune=prune,
+                create_only=create_only, update_only=update_only,
+            )
+        else:
+            applied = super().apply_ssa(
+                obj, field_manager=field_manager, force=force, prune=prune,
+                create_only=create_only, update_only=update_only,
+            )
+        if isinstance(applied, dict):
+            self._write_through(applied)
+        return applied
+
+    def apply_ssa_batch(
+        self, items, field_manager=None, force=True, prune=True,
+        update_only=False,
+    ):
+        fn = getattr(self.live, "apply_ssa_batch", None)
+        if callable(fn):
+            results = fn(
+                items, field_manager=field_manager, force=force, prune=prune,
+                update_only=update_only,
+            )
+        else:
+            results = super().apply_ssa_batch(
+                items, field_manager=field_manager, force=force, prune=prune,
+                update_only=update_only,
+            )
+        for obj, err in results:
+            if err is None and isinstance(obj, dict):
+                self._write_through(obj)
+        return results
 
     def delete(self, api_version, kind, name, namespace=""):
         self.live.delete(api_version, kind, name, namespace)

@@ -476,6 +476,140 @@ class Client:
         ].get("resourceVersion")
         return self.update(obj)
 
+    def apply_ssa(
+        self,
+        obj: Obj,
+        field_manager: Optional[str] = None,
+        force: bool = True,
+        prune: bool = True,
+        create_only: bool = False,
+        update_only: bool = False,
+    ) -> Obj:
+        """Server-side APPLY (``tpu_operator/kube/apply.py`` semantics):
+        ONE idempotent request merging the applied configuration into
+        the live object under per-field ownership. ``force`` transfers
+        conflicting fields; ``prune`` removes fields this manager
+        stopped applying; ``create_only`` refuses to touch an existing
+        object (POST semantics for batched pod creation);
+        ``update_only`` refuses to create — a label apply racing a node
+        deletion must 404, never resurrect the node as a ghost.
+
+        This generic fallback emulates the verb with a conflict-retried
+        read-merge-update so ANY ``Client`` supports it; FakeClient,
+        kubesim/RestClient and CachedClient override it with native
+        single-shot implementations.
+
+        Ownership survives write paths that discard caller-supplied
+        ``managedFields`` (every ``update`` implementation does, by
+        design — non-apply writes must not forge ownership): the
+        fallback remembers, per object, the leaves this manager
+        committed AND their values, and re-grafts that ownership before
+        the next merge for leaves whose live value still matches. A
+        foreign writer's change breaks the match, so it still surfaces
+        as a conflict — but the manager can never spuriously conflict
+        with its own previous apply."""
+        from tpu_operator.kube import apply as ssa
+
+        manager = field_manager or ssa.DEFAULT_FIELD_MANAGER
+        av, kind, ns, name = obj_key(obj)
+        ledger: dict = self.__dict__.setdefault("_ssa_fallback_owned", {})
+        lkey = (av, kind, ns, name, manager)
+
+        def _remember(committed: Obj) -> None:
+            owned = ssa.decode_managed(committed).get(manager, set())
+            ledger[lkey] = {
+                p: copy.deepcopy(ssa.get_path(committed, p, None))
+                for p in owned
+            }
+
+        last: Optional[Exception] = None
+        for _ in range(5):
+            existing = self.get_or_none(av, kind, name, ns, copy=True)
+            if existing is None:
+                if update_only:
+                    raise NotFoundError(f"{kind} {ns}/{name} not found")
+                try:
+                    created = ssa.create_from_applied(obj, manager)
+                    result = self.create(created)
+                    _remember(created)
+                    return result
+                except ConflictError as e:
+                    if create_only:
+                        raise
+                    last = e
+                    continue  # created under us: merge onto it
+            if create_only:
+                raise ConflictError(f"{kind} {ns}/{name} already exists")
+            remembered = ledger.get(lkey)
+            if remembered:
+                owned = ssa.decode_managed(existing)
+                mine = owned.setdefault(manager, set())
+                for path, val in remembered.items():
+                    if ssa.get_path(existing, path, None) == val:
+                        # untouched since our commit: reclaim the leaf
+                        # from wherever the write path's bookkeeping
+                        # parked it (usually ``unmanaged``)
+                        for other, paths in owned.items():
+                            if other != manager:
+                                paths.discard(path)
+                        mine.add(path)
+                ssa.encode_managed(existing, owned)
+            merged, changed, conflicts = ssa.apply_merge(
+                existing, obj, manager=manager, force=force, prune=prune
+            )
+            if conflicts:
+                raise ssa.ApplyConflictError(
+                    ssa.conflict_message(kind, name, conflicts), conflicts
+                )
+            if not changed:
+                _remember(existing)
+                return existing
+            try:
+                result = self.update(merged)
+                _remember(merged)
+                return result
+            except ConflictError as e:  # racing writer: re-read, re-merge
+                last = e
+        raise last  # type: ignore[misc]
+
+    def apply_ssa_batch(
+        self,
+        items,
+        field_manager: Optional[str] = None,
+        force: bool = True,
+        prune: bool = True,
+        update_only: bool = False,
+    ):
+        """Apply many objects in one submission; returns a list aligned
+        to ``items`` of ``(object, error)`` pairs — exactly one of the
+        two is ``None`` per item, and one failed item never fails its
+        siblings. ``items`` are ``(obj, create_only)`` pairs (or bare
+        objects). The generic fallback loops ``apply_ssa``; the
+        kubesim-backed RestClient overrides it with a single wire
+        request (the batch lane's amortization)."""
+        out = []
+        for item in items:
+            obj, create_only = (
+                item if isinstance(item, tuple) else (item, False)
+            )
+            try:
+                out.append(
+                    (
+                        self.apply_ssa(
+                            obj,
+                            field_manager=field_manager,
+                            force=force,
+                            prune=prune,
+                            create_only=create_only,
+                            update_only=update_only,
+                        ),
+                        None,
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 - per-item fan-back
+                out.append((None, e))
+        return out
+
     def delete_if_exists(
         self, api_version: str, kind: str, name: str, namespace: str = ""
     ) -> bool:
@@ -590,6 +724,23 @@ class FakeClient(Client):
             self._notify("ADDED", stored)
             return copy.deepcopy(stored)
 
+    @staticmethod
+    def _reown(existing: Obj, stored: Obj) -> None:
+        """Non-apply writes move ownership of the leaves they changed to
+        the ``unmanaged`` bucket (see kube/apply.py): a human or foreign
+        controller touching a field an APPLY manager owns must surface
+        as a conflict on the next non-forced apply, never be silently
+        reverted. Caller-supplied ``managedFields`` are ignored — the
+        bookkeeping always starts from the STORED object's."""
+        from tpu_operator.kube import apply as ssa
+
+        stored.setdefault("metadata", {}).pop("managedFields", None)
+        if existing["metadata"].get("managedFields"):
+            stored["metadata"]["managedFields"] = copy.deepcopy(
+                existing["metadata"]["managedFields"]
+            )
+        ssa.reown(existing, stored)
+
     def update(self, obj):
         with self._lock:
             key = obj_key(obj)
@@ -613,6 +764,7 @@ class FakeClient(Client):
             # uid is immutable: always the stored one, never caller-supplied
             if existing["metadata"].get("uid"):
                 stored.setdefault("metadata", {})["uid"] = existing["metadata"]["uid"]
+            self._reown(existing, stored)
             self._stamp(stored)
             self._store[key] = stored
             self._notify("MODIFIED", stored)
@@ -623,12 +775,64 @@ class FakeClient(Client):
             key = obj_key(obj)
             if key not in self._store:
                 raise NotFoundError(f"{key[1]} {key[2]}/{key[3]} not found")
-            existing = copy.deepcopy(self._store[key])
+            before = self._store[key]
+            existing = copy.deepcopy(before)
             existing["status"] = copy.deepcopy(obj.get("status", {}))
+            self._reown(before, existing)
             self._stamp(existing)
             self._store[key] = existing
             self._notify("MODIFIED", existing)
             return copy.deepcopy(existing)
+
+    def apply_ssa(
+        self,
+        obj,
+        field_manager=None,
+        force=True,
+        prune=True,
+        create_only=False,
+        update_only=False,
+    ):
+        """Native server-side APPLY on the in-memory store: single-shot
+        under the store lock (no read-merge-update race), conflict
+        detection against recorded field ownership, and — like the real
+        apiserver — a no-op apply does NOT bump the resourceVersion or
+        emit a watch event (repeated applies stay free)."""
+        from tpu_operator.kube import apply as ssa
+
+        manager = field_manager or ssa.DEFAULT_FIELD_MANAGER
+        with self._lock:
+            key = obj_key(obj)
+            if not key[3]:
+                raise ValueError(f"object has no name: {obj}")
+            stored = self._store.get(key)
+            if stored is None:
+                if update_only:
+                    raise NotFoundError(
+                        f"{key[1]} {key[2]}/{key[3]} not found"
+                    )
+                new = ssa.create_from_applied(obj, manager)
+                self._stamp(new)
+                self._store[key] = new
+                self._notify("ADDED", new)
+                return copy.deepcopy(new)
+            if create_only:
+                raise ConflictError(
+                    f"{key[1]} {key[2]}/{key[3]} already exists"
+                )
+            merged, changed, conflicts = ssa.apply_merge(
+                stored, obj, manager=manager, force=force, prune=prune
+            )
+            if conflicts:
+                raise ssa.ApplyConflictError(
+                    ssa.conflict_message(key[1], key[3], conflicts), conflicts
+                )
+            if not changed:
+                return copy.deepcopy(stored)
+            self._stamp(merged)
+            self._store[key] = merged
+            self._notify("MODIFIED", merged)
+            return copy.deepcopy(merged)
 
     def patch_labels(
         self, api_version, kind, name, namespace="", labels=None,
@@ -651,10 +855,14 @@ class FakeClient(Client):
                     f"{resource_version} != "
                     f"{stored['metadata'].get('resourceVersion')}"
                 )
-            current = stored.setdefault("metadata", {}).setdefault("labels", {})
+            fresh = copy.deepcopy(stored)
+            current = fresh.setdefault("metadata", {}).setdefault("labels", {})
             if apply_label_delta(current, labels or {}):
-                self._stamp(stored)
-                self._notify("MODIFIED", stored)
+                self._reown(stored, fresh)
+                self._stamp(fresh)
+                self._store[key] = fresh
+                self._notify("MODIFIED", fresh)
+                return copy.deepcopy(fresh)
             return copy.deepcopy(stored)
 
     def delete(self, api_version, kind, name, namespace=""):

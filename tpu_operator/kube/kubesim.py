@@ -23,10 +23,19 @@ cannot prove, behind the genuine REST/JSON wire the operator's
   (too-old) resourceVersion with a 410 Gone ERROR event — the re-list
   path clients must survive;
 * namespacing, labelSelector/fieldSelector list filtering, and the
-  ``pods/{name}/eviction`` subresource.
+  ``pods/{name}/eviction`` subresource;
+* **server-side apply** (the APPLY verb): a PATCH with content type
+  ``application/apply-patch+yaml`` merges the applied configuration
+  under per-field manager ownership (``tpu_operator/kube/apply.py`` —
+  ``metadata.managedFields`` recorded on stored objects, 409
+  ``FieldConflict`` naming the owning manager, ``force``/``prune``/
+  ``createOnly`` query knobs), a no-op apply does not bump the
+  resourceVersion, non-apply writes re-own the leaves they change, and
+  a name-less collection PATCH applies a BATCH of sibling objects in
+  one request with per-item status fan-back.
 
-Deliberately NOT simulated: authn/authz (any token accepted), admission
-webhooks, and server-side apply. Pod and DaemonSet status stays writable
+Deliberately NOT simulated: authn/authz (any token accepted) and
+admission webhooks. Pod and DaemonSet status stays writable
 by the test's node simulator, which plays the kubelet's role. One
 controller behavior IS modeled because every real cluster has it and its
 absence diverges operator behavior: deleting a Node garbage-collects the
@@ -108,6 +117,14 @@ class KubeSim:
         self._partition_until = 0.0
         self.faults_injected = 0
         self.partition_rejects = 0
+        # plural -> highest event rv compacted out of the log (the
+        # per-kind 410 horizon; see _emit)
+        self._compacted_rv_by_plural: Dict[str, int] = {}
+        # server-side-apply accounting: field-ownership 409s answered
+        # (the bench's apply_conflicts signal) and batch submissions
+        self.apply_conflicts = 0
+        self.apply_batches = 0
+        self.apply_batch_items = 0
         # Events expire like a real apiserver's --event-ttl (default 1h):
         # without it an hour-scale Event storm grows the store — and
         # every informer mirroring it — without bound. Keyed by store
@@ -158,7 +175,9 @@ class KubeSim:
     ) -> None:
         """Queue ``count`` injected faults for requests matching
         ``(verb, plural)`` — verbs are the request-accounting names
-        (GET/LIST/WATCH/POST/PUT/PATCH/DELETE), ``"*"`` matches any.
+        (GET/LIST/WATCH/POST/PUT/PATCH/APPLY/DELETE; APPLY is
+        server-side apply, which rides PATCH on the wire but is its own
+        verb to fault injection and accounting), ``"*"`` matches any.
         Each consumed fault adds ``latency_s`` of service delay, then
         answers HTTP ``code`` when given (with a ``Retry-After`` header
         when ``retry_after`` is set — the 429 contract clients must
@@ -452,6 +471,14 @@ class KubeSim:
         if len(self._events) > self.compact_keep:
             drop = len(self._events) - self.compact_keep
             self._min_event_rv = self._events[drop - 1][0]
+            for rv, _et, dkey, _obj in self._events[:drop]:
+                # per-plural compaction horizon: a watch resuming at rv
+                # X only missed history if an event FOR ITS PLURAL was
+                # dropped past X (a real apiserver's watch cache is
+                # per-kind; the global log here must not 410 a quiet
+                # kind's warm resume just because Nodes were busy)
+                if rv > self._compacted_rv_by_plural.get(dkey[2], 0):
+                    self._compacted_rv_by_plural[dkey[2]] = rv
             del self._events[:drop]
             del self._event_rvs[:drop]
         self._cond_for(key[2]).notify_all()
@@ -483,6 +510,9 @@ class KubeSim:
         with self._lock:
             if self._events:
                 self._min_event_rv = self._events[-1][0]
+                for rv, _et, dkey, _obj in self._events:
+                    if rv > self._compacted_rv_by_plural.get(dkey[2], 0):
+                        self._compacted_rv_by_plural[dkey[2]] = rv
                 self._events.clear()
                 self._event_rvs.clear()
 
@@ -588,6 +618,7 @@ class KubeSim:
                 # a /status PUT can ONLY change status
                 merged = copy.deepcopy(stored)
                 merged["status"] = new.get("status", {})
+                self._reown(stored, merged)
                 merged["metadata"]["resourceVersion"] = self._bump()
                 self._objs[key] = merged
                 if plural == "events":
@@ -609,12 +640,27 @@ class KubeSim:
                 new["status"] = copy.deepcopy(stored["status"])
             return self._commit_main_locked(key, plural, kind, stored, new)
 
-    def _commit_main_locked(self, key, plural, kind, stored, new):
-        """Shared commit tail for main-resource PUT and PATCH (caller
-        holds the lock and has already resolved subresource + immutable
-        fields): admission, conditional generation bump, rv stamp,
-        store, CRD/event hooks, MODIFIED emit. One definition so the two
-        write verbs cannot drift apart."""
+    @staticmethod
+    def _reown(stored, new) -> None:
+        """Ownership bookkeeping for non-apply writes (see
+        kube/apply.py): leaves this write changed move to the
+        ``unmanaged`` manager so a later non-forced APPLY on them
+        conflicts instead of silently reverting. Caller-supplied
+        ``managedFields`` never win — the computation always starts
+        from the STORED object's."""
+        from tpu_operator.kube import apply as ssa
+
+        ssa.reown(stored, new)
+
+    def _commit_main_locked(self, key, plural, kind, stored, new, reown=True):
+        """Shared commit tail for main-resource PUT, PATCH and APPLY
+        (caller holds the lock and has already resolved subresource +
+        immutable fields): ownership bookkeeping (skipped for APPLY,
+        whose merge already computed it), admission, conditional
+        generation bump, rv stamp, store, CRD/event hooks, MODIFIED
+        emit. One definition so the write verbs cannot drift apart."""
+        if reown:
+            self._reown(stored, new)
         rejects = self._admit(kind, new)
         if rejects:
             return 422, _status(422, "Invalid", "; ".join(rejects))
@@ -671,6 +717,130 @@ class KubeSim:
             if stored["metadata"].get("namespace"):
                 meta["namespace"] = stored["metadata"]["namespace"]
             return self._commit_main_locked(key, plural, kind, stored, new)
+
+    def apply_ssa(
+        self,
+        group,
+        version,
+        plural,
+        namespace,
+        name,
+        body: dict,
+        field_manager=None,
+        force: bool = True,
+        prune: bool = True,
+        create_only: bool = False,
+        update_only: bool = False,
+    ):
+        """Server-side apply (``application/apply-patch+yaml``): ONE
+        request that creates-or-merges under field-manager ownership
+        (semantics in ``tpu_operator/kube/apply.py``). A conflicting
+        non-forced apply answers 409 with reason ``FieldConflict``
+        naming the field and its owner; a no-op apply answers 200
+        WITHOUT bumping the resourceVersion or emitting a watch event —
+        the property that keeps a converged reconcile pass free."""
+        from tpu_operator.kube import apply as ssa
+
+        kind, _ = PLURAL_TABLE[plural]
+        manager = field_manager or ssa.DEFAULT_FIELD_MANAGER
+        body = copy.deepcopy(body)
+        meta = body.setdefault("metadata", {})
+        if name:
+            meta.setdefault("name", name)
+        obj_name = meta.get("name", "")
+        if not obj_name:
+            return 422, _status(422, "Invalid", "metadata.name required")
+        if kind in STATUS_SUBRESOURCE_KINDS:
+            # apply to the main resource cannot touch a subresource status
+            body.pop("status", None)
+        with self._lock:
+            key = self._key(group, version, plural, namespace, obj_name)
+            stored = self._objs.get(key)
+            if stored is None:
+                if update_only:
+                    return 404, _status(
+                        404, "NotFound", f"{plural} {obj_name} not found"
+                    )
+                return self.create(
+                    group,
+                    version,
+                    plural,
+                    namespace,
+                    ssa.create_from_applied(body, manager),
+                )
+            if create_only:
+                return 409, _status(
+                    409, "AlreadyExists", f"{plural} {obj_name} exists"
+                )
+            merged, changed, conflicts = ssa.apply_merge(
+                stored, body, manager=manager, force=force, prune=prune
+            )
+            if conflicts:
+                self.apply_conflicts += 1
+                return 409, _status(
+                    409,
+                    "FieldConflict",
+                    ssa.conflict_message(kind, obj_name, conflicts),
+                )
+            if not changed:
+                return 200, stored  # reference (see create); NO rv bump
+            return self._commit_main_locked(
+                key, plural, kind, stored, merged, reown=False
+            )
+
+    def apply_batch(
+        self,
+        group,
+        version,
+        plural,
+        namespace,
+        items,
+        field_manager=None,
+        force: bool = True,
+        prune: bool = True,
+        update_only: bool = False,
+    ):
+        """Batched apply: one wire request carrying N sibling applied
+        configurations (``{"items": [{"object": ..., "createOnly":
+        bool}, ...]}``), processed strictly in order, answered with
+        per-item status fan-back — one failed item fails only itself.
+        The batch lane (kube/write_pipeline.BatchLane) rides this to
+        amortize per-request overhead across a slice's label applies or
+        a wave's DaemonSet applies."""
+        out = []
+        with self._lock:
+            self.apply_batches += 1
+            self.apply_batch_items += len(items)
+        for item in items:
+            if isinstance(item, dict) and "object" in item:
+                obj = item.get("object") or {}
+                create_only = bool(item.get("createOnly"))
+            else:
+                obj, create_only = item, False
+            code, payload = self.apply_ssa(
+                group,
+                version,
+                plural,
+                namespace,
+                "",
+                obj,
+                field_manager=field_manager,
+                force=force,
+                prune=prune,
+                create_only=create_only,
+                update_only=update_only,
+            )
+            entry = {"code": code}
+            if code < 400:
+                entry["object"] = payload
+            else:
+                entry["status"] = payload
+            out.append(entry)
+        return 200, {
+            "apiVersion": "v1",
+            "kind": "ApplyBatchResult",
+            "items": out,
+        }
 
     def delete(self, group, version, plural, namespace, name):
         with self._lock:
@@ -841,7 +1011,12 @@ class KubeSim:
         deadline = time.monotonic() + timeout_s
         last_bookmark = time.monotonic()
         with self._lock:
-            gone = since_rv and int(since_rv) < self._min_event_rv
+            # 410 only when an event for THIS plural was compacted past
+            # the resume rv — the per-kind watch-cache contract; a global
+            # horizon would force a quiet kind into a pointless re-list
+            gone = bool(since_rv) and (
+                self._compacted_rv_by_plural.get(plural, 0) > int(since_rv)
+            )
             cursor = int(since_rv) if since_rv else self._rv
         # NEVER yield while holding the sim lock: the consumer writes to a
         # client socket, and a stalled client must not freeze the cluster
@@ -862,10 +1037,10 @@ class KubeSim:
             batch: List[Tuple[str, dict]] = []
             with self._lock:
                 cond = self._cond_for(plural)
-                if cursor < self._min_event_rv:
-                    # events between our cursor and the log head were
-                    # compacted away while we waited: the client MUST
-                    # re-list (the 410 Gone contract)
+                if self._compacted_rv_by_plural.get(plural, 0) > cursor:
+                    # events for this plural between our cursor and the
+                    # log head were compacted away while we waited: the
+                    # client MUST re-list (the 410 Gone contract)
                     gone = True
                 else:
                     # bisect to the first event past the cursor: a wake
@@ -1131,8 +1306,46 @@ class _Handler(BaseHTTPRequestHandler):
         route = self._route()
         if route is None:
             return self._json(404, _status(404, "NotFound", self.path))
-        self.sim.count_request("PATCH")
         group, version, plural, namespace, name, sub = route
+        ctype = self.headers.get("Content-Type", "") or ""
+        if ctype.startswith("application/apply-patch"):
+            # server-side apply rides PATCH on the wire but is its own
+            # verb for accounting AND fault injection: the chaos
+            # matrices target APPLY directly
+            self.sim.count_request("APPLY")
+            body = self._body()  # consume before injected replies (framing)
+            if self._maybe_fault("APPLY", plural):
+                return None
+            if sub:
+                return self._json(
+                    405,
+                    _status(
+                        405,
+                        "MethodNotAllowed",
+                        f"apply on subresource {sub!r} is not supported",
+                    ),
+                )
+            qs = parse_qs(urlparse(self.path).query)
+            field_manager = qs.get("fieldManager", [None])[0]
+            force = qs.get("force", ["false"])[0] == "true"
+            prune = qs.get("prune", ["true"])[0] == "true"
+            update_only = qs.get("updateOnly", ["false"])[0] == "true"
+            if name:
+                create_only = qs.get("createOnly", ["false"])[0] == "true"
+                code, obj = self.sim.apply_ssa(
+                    group, version, plural, namespace, name, body,
+                    field_manager=field_manager, force=force, prune=prune,
+                    create_only=create_only, update_only=update_only,
+                )
+            else:
+                code, obj = self.sim.apply_batch(
+                    group, version, plural, namespace,
+                    body.get("items") or [],
+                    field_manager=field_manager, force=force, prune=prune,
+                    update_only=update_only,
+                )
+            return self._json(code, obj)
+        self.sim.count_request("PATCH")
         body = self._body()  # consume before any injected reply (framing)
         if self._maybe_fault("PATCH", plural):
             return None

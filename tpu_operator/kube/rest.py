@@ -240,6 +240,7 @@ class RestClient(Client):
         body: Optional[Obj] = None,
         content_type: str = "application/json",
         retry_429: bool = True,
+        count_as: Optional[str] = None,
     ) -> Obj:
         """One API call under the fault-tolerance policy: per-verb
         bounded retries with jittered exponential backoff for transient
@@ -250,9 +251,12 @@ class RestClient(Client):
         is alive. The global circuit breaker fails calls fast while the
         apiserver is known-dead. ``retry_429=False`` exempts a call
         whose 429 is a semantic veto, not load shedding (the eviction
-        subresource's PDB refusal)."""
+        subresource's PDB refusal). ``count_as`` overrides the verb the
+        retry counters record (server-side apply rides PATCH on the
+        wire but is the APPLY verb to the policy surface)."""
         policy = self.retry_policy
         breaker = self.breaker
+        verb = count_as or method
         attempts = policy.attempts_for(method)
         deadline = time.monotonic() + policy.budget_s
         last_err: Optional[Exception] = None
@@ -271,7 +275,7 @@ class RestClient(Client):
                     policy.count_giveup()
                     break  # budget exhausted: surface the last error
                 policy.count_retry(
-                    method, honored_retry_after=retry_after is not None
+                    verb, honored_retry_after=retry_after is not None
                 )
                 time.sleep(delay)
             try:
@@ -353,7 +357,12 @@ class RestClient(Client):
             if resp.status == 404:
                 raise NotFoundError(path)
             if resp.status == 409:
-                raise ConflictError(path)
+                err = ConflictError(path)
+                # the status body distinguishes an rv/AlreadyExists 409
+                # from a field-ownership conflict (apply callers need
+                # the reason + the conflicting fields)
+                err.body = data
+                raise err
             if resp.status == 429:
                 err = TooManyRequestsError(
                     f"{method} {path} -> {resp.status}: {data[:512]!r}"
@@ -496,6 +505,140 @@ class RestClient(Client):
             content_type="application/merge-patch+json",
         )
 
+    # -- server-side apply -------------------------------------------------
+    @staticmethod
+    def _apply_qs(
+        field_manager, force, prune, create_only=None, update_only=None
+    ) -> str:
+        from tpu_operator.kube.apply import DEFAULT_FIELD_MANAGER
+
+        params = {
+            "fieldManager": field_manager or DEFAULT_FIELD_MANAGER,
+            "force": "true" if force else "false",
+            "prune": "true" if prune else "false",
+        }
+        if create_only:
+            params["createOnly"] = "true"
+        if update_only:
+            params["updateOnly"] = "true"
+        return urlencode(params)
+
+    def _raise_apply_conflict(self, e: ConflictError) -> None:
+        """Re-raise a 409 whose status body is a field-ownership
+        conflict as ``ApplyConflictError`` (callers recompute from a
+        fresh read); any other 409 (stale rv, AlreadyExists) propagates
+        unchanged."""
+        from tpu_operator.kube.apply import ApplyConflictError
+
+        body = getattr(e, "body", b"") or b""
+        if b"FieldConflict" in body:
+            try:
+                message = json.loads(body).get("message", str(e))
+            except (ValueError, AttributeError):
+                message = str(e)
+            raise ApplyConflictError(message) from e
+        raise e
+
+    def apply_ssa(
+        self,
+        obj,
+        field_manager=None,
+        force=True,
+        prune=True,
+        create_only=False,
+        update_only=False,
+    ):
+        """The APPLY verb on the wire: one PATCH with content type
+        ``application/apply-patch+yaml`` (body is the applied
+        configuration as JSON — a YAML superset, like the real
+        apiserver accepts). No GET-before-PUT, no resourceVersion: the
+        server merges under field ownership and a repeat apply is a
+        server-side no-op."""
+        av, kind = obj["apiVersion"], obj["kind"]
+        meta = obj.get("metadata", {})
+        path = (
+            _resource_path(av, kind, meta.get("namespace", ""), meta["name"])
+            + "?"
+            + self._apply_qs(
+                field_manager, force, prune, create_only, update_only
+            )
+        )
+        try:
+            return self._request(
+                "PATCH",
+                path,
+                obj,
+                content_type="application/apply-patch+yaml",
+                count_as="APPLY",
+            )
+        except ConflictError as e:
+            self._raise_apply_conflict(e)
+
+    def apply_ssa_batch(
+        self, items, field_manager=None, force=True, prune=True,
+        update_only=False,
+    ):
+        """Batched APPLY: N sibling objects of ONE (apiVersion, kind,
+        namespace) collection in a single wire request, per-item status
+        fan-back (one failed item fails only itself). Returns
+        ``[(object, error)]`` aligned to ``items``. Transient transport
+        failures retry the WHOLE batch inside ``_request`` — applies
+        are idempotent, and a retried ``create_only`` item surfaces as
+        a benign per-item AlreadyExists."""
+        from tpu_operator.kube.apply import ApplyConflictError
+
+        norm = [
+            item if isinstance(item, tuple) else (item, False)
+            for item in items
+        ]
+        if not norm:
+            return []
+        first = norm[0][0]
+        av, kind = first["apiVersion"], first["kind"]
+        ns = first.get("metadata", {}).get("namespace", "")
+        path = (
+            _resource_path(av, kind, ns)
+            + "?"
+            + self._apply_qs(
+                field_manager, force, prune, update_only=update_only
+            )
+        )
+        body = {
+            "items": [
+                {"object": obj, "createOnly": bool(create_only)}
+                for obj, create_only in norm
+            ]
+        }
+        result = self._request(
+            "PATCH",
+            path,
+            body,
+            content_type="application/apply-patch+yaml",
+            count_as="APPLY",
+        )
+        out = []
+        for i, entry in enumerate(result.get("items", [])):
+            code = entry.get("code", 500)
+            if code < 400:
+                obj = entry.get("object", {})
+                obj.setdefault("apiVersion", av)
+                obj.setdefault("kind", kind)
+                out.append((obj, None))
+                continue
+            status = entry.get("status", {}) or {}
+            message = status.get("message", f"apply item {i} -> {code}")
+            if code == 404:
+                out.append((None, NotFoundError(message)))
+            elif code == 409 and status.get("reason") == "FieldConflict":
+                out.append((None, ApplyConflictError(message)))
+            elif code == 409:
+                out.append((None, ConflictError(message)))
+            else:
+                out.append((None, RuntimeError(message)))
+        while len(out) < len(norm):  # defensive: a short reply fails the rest
+            out.append((None, RuntimeError("apply batch reply truncated")))
+        return out
+
     def delete(self, api_version, kind, name, namespace=""):
         self._request(
             "DELETE", _resource_path(api_version, kind, namespace, name)
@@ -511,12 +654,28 @@ class RestClient(Client):
         stop_event=None,
         timeout_s: int = WATCH_WINDOW_S,
         on_sync=None,
+        seed_rv=None,
+        seed_known=None,
+        on_progress=None,
     ) -> None:
         """Blocking list+watch loop: calls ``callback(event_type, obj)`` for
         ADDED/MODIFIED/DELETED. Re-lists on expiry/disconnect (the
         controller-runtime informer contract, minus caching).
         ``on_sync()`` fires after each full list has been delivered — the
-        informer cache uses it as its HasSynced barrier."""
+        informer cache uses it as its HasSynced barrier.
+
+        ``seed_rv``/``seed_known`` (warm restart): the caller already
+        holds the world (journal-seeded informer store), so the FIRST
+        cycle skips the initial LIST entirely and streams from
+        ``seed_rv``; a 410 (history compacted past the journal) falls
+        back to the normal list path — bounded staleness, never wrong.
+
+        ``on_progress(rv)`` fires whenever the stream's resume position
+        advances — list rv, event rv, or BOOKMARK rv. The informer
+        records it as its journal resume point (client-go's
+        LastSyncResourceVersion, which bookmarks advance on QUIET kinds
+        precisely so a restart can resume instead of 410ing into a
+        re-list)."""
         import logging
         import threading
 
@@ -530,7 +689,8 @@ class RestClient(Client):
             except Exception:
                 log.exception("watch callback failed for %s %s", etype, kind)
 
-        known = set()
+        known = set(seed_known) if seed_known else set()
+        warm_rv = str(seed_rv) if seed_rv else None
         # jittered exponential reconnect backoff (reset once a list
         # succeeds): a fleet of informers on a fixed delay re-LISTs a
         # recovering apiserver in lockstep — the thundering herd the
@@ -538,6 +698,13 @@ class RestClient(Client):
         backoff = WatchBackoff()
         while not stop_event.is_set():
             try:
+                if warm_rv is not None:
+                    rv, warm_rv = warm_rv, None
+                    self._watch_loop_streams(
+                        api_version, kind, namespace, rv, deliver,
+                        stop_event, timeout_s, known, on_progress,
+                    )
+                    continue  # stream ended: re-list (cold path below)
                 try:
                     listing = self._request(
                         "GET", _resource_path(api_version, kind, namespace)
@@ -570,6 +737,11 @@ class RestClient(Client):
                     stop_event.wait(30)
                     continue
                 rv = listing.get("metadata", {}).get("resourceVersion", "")
+                if rv and on_progress is not None:
+                    try:
+                        on_progress(rv)
+                    except Exception:
+                        log.exception("watch on_progress callback failed")
                 seen = set()
                 for item in listing.get("items", []):
                     item.setdefault("apiVersion", api_version)
@@ -599,24 +771,37 @@ class RestClient(Client):
                 # stream, RESUMING from the last seen resourceVersion on
                 # clean expiry (server timeoutSeconds) — the informer
                 # contract: only a 410 Gone forces the full re-list above
-                while not stop_event.is_set():
-                    rv = self._watch_stream(
-                        api_version,
-                        kind,
-                        namespace,
-                        rv,
-                        deliver,
-                        stop_event,
-                        timeout_s,
-                        known,
-                    )
-                    if rv is None:
-                        break  # expired history: re-list
+                self._watch_loop_streams(
+                    api_version, kind, namespace, rv, deliver, stop_event,
+                    timeout_s, known, on_progress,
+                )
             except Exception:
                 if stop_event.is_set():
                     return
                 log.exception("watch %s/%s disconnected; re-listing", api_version, kind)
                 stop_event.wait(backoff.next_delay())  # then re-list
+
+    def _watch_loop_streams(
+        self, api_version, kind, namespace, rv, deliver, stop_event,
+        timeout_s, known, on_progress=None,
+    ) -> None:
+        """Renew watch windows from ``rv`` until the history expires
+        (410/ERROR) or the caller stops — returning means the caller
+        must re-list."""
+        while not stop_event.is_set():
+            rv = self._watch_stream(
+                api_version,
+                kind,
+                namespace,
+                rv,
+                deliver,
+                stop_event,
+                timeout_s,
+                known,
+                on_progress,
+            )
+            if rv is None:
+                return  # expired history: re-list
 
     def _watch_stream(
         self,
@@ -628,6 +813,7 @@ class RestClient(Client):
         stop_event,
         timeout_s,
         known=None,
+        on_progress=None,
     ) -> Optional[str]:
         """One watch request. Returns the resourceVersion to RESUME from
         after a clean server-side close (expiry), or ``None`` when the
@@ -676,6 +862,11 @@ class RestClient(Client):
                     obj_rv = obj.get("metadata", {}).get("resourceVersion")
                     if obj_rv:
                         last_rv = obj_rv
+                        if on_progress is not None:
+                            try:
+                                on_progress(obj_rv)
+                            except Exception:
+                                pass  # progress is advisory, never fatal
                     if etype == "BOOKMARK":
                         continue  # progress marker only: advances last_rv
                     if etype in ("ADDED", "MODIFIED", "DELETED"):

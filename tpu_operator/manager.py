@@ -336,6 +336,11 @@ class Manager:
         # returning a JSON-serializable value (e.g. the reconciler's
         # per-pass snapshot hit rates)
         self._debug_vars = {}
+        # shutdown callbacks (run once, before the cache stops): the
+        # warm-restart journal's final save rides this so a clean stop
+        # persists the freshest world-state
+        self._stop_hooks = []
+        self._stop_hooks_ran = False
 
     def add_reconciler(self, key: str, fn: Callable[[str], object]) -> None:
         """``fn(name) -> Result`` (with optional ``requeue_after``)."""
@@ -344,6 +349,11 @@ class Manager:
     def register_debug_vars(self, name: str, fn: Callable[[], object]) -> None:
         """Attach a provider to the /debug/vars payload."""
         self._debug_vars[name] = fn
+
+    def add_stop_hook(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` once when the manager stops, before the informer
+        cache shuts down (so hooks can still read it)."""
+        self._stop_hooks.append(fn)
 
     def enqueue(self, key: str, delay: float = 0.0) -> None:
         self.queue.add(key, delay)
@@ -448,6 +458,13 @@ class Manager:
 
     def stop(self) -> None:
         self._stop.set()
+        if not self._stop_hooks_ran:
+            self._stop_hooks_ran = True
+            for fn in self._stop_hooks:
+                try:
+                    fn()
+                except Exception:
+                    log.exception("stop hook failed")
         # graceful cache shutdown: join informer + resync threads so no
         # loop LISTs a dead apiserver after the manager stops (the
         # reference's manager stops its cache before Start returns,

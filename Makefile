@@ -28,7 +28,7 @@ DOCKER_PUSH_TARGETS = $(patsubst %,docker-push-%,$(IMAGES))
 # variable expands to nothing and silently un-phonies the fan-out
 .PHONY: all native test crd bundle release-bundle validate lint clean \
 	dev-run dev-run-kubesim soak bench bench-gate bench-converge \
-	bench-alloc chaos-fast chaos-soak-fast chaos-soak \
+	bench-alloc obs-fast chaos-fast chaos-soak-fast chaos-soak \
 	builder docker-build \
 	docker-push $(DOCKER_BUILD_TARGETS) $(DOCKER_PUSH_TARGETS)
 
@@ -64,6 +64,7 @@ validate:
 	python -m tpu_operator.cfg.main validate chart --dir deployments/tpu-operator
 	python -m tpu_operator.cfg.main validate csv --input bundle/manifests/tpu-operator.clusterserviceversion.yaml
 	python -m tpu_operator.cfg.main validate bundle --dir bundle
+	$(MAKE) obs-fast
 	$(MAKE) bench-gate
 	$(MAKE) bench-converge
 	$(MAKE) bench-warm
@@ -114,6 +115,16 @@ bench-warm:
 # chips / partially-placed gangs / leaked reservations every round
 bench-alloc:
 	python -m pytest tests/test_alloc_bench.py -q -m slow -p no:cacheprovider
+
+# CI observability gate: tracing-on unit suite (spans, flight recorder,
+# log-once, /debug/vars schema stability, /metrics + /healthz over
+# HTTP, prometheus-masked fallback) plus the overhead smoke — a steady
+# pass with tracing ENABLED must stay within 1.15x the tracing-off min
+obs-fast:
+	python -m pytest tests/test_obs.py tests/test_logonce.py \
+	  tests/test_debug_vars_schema.py tests/test_manager_http.py \
+	  tests/test_metrics_noprom.py tests/test_chaos_flight.py \
+	  -q -p no:cacheprovider
 
 # CI fault gate: the deterministic fault matrix (injected 429/500/503/
 # latency on every write verb, a full partition window, a raising state)

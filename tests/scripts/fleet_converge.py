@@ -140,6 +140,14 @@ def main(argv=None) -> int:
         "preempt_recover_s (orphaned state must reconcile)",
     )
     p.add_argument(
+        "--trace-out",
+        default=None,
+        help="enable reconcile tracing (tpu_operator/obs/trace.py) for "
+        "the whole run and write the span buffer as Chrome trace-event "
+        "JSON (Perfetto-loadable) to this path; trace_overhead_pct is "
+        "measured and reported either way",
+    )
+    p.add_argument(
         "--warm-restart",
         action="store_true",
         help="after the steady-state measurement, restart the operator "
@@ -178,6 +186,11 @@ def main(argv=None) -> int:
         warm_path = os.path.join(
             tempfile.mkdtemp(prefix="fleet-warm-"), "warm.json"
         )
+
+    from tpu_operator.obs import trace as trace_mod
+
+    if args.trace_out:
+        trace_mod.enable()
 
     t0 = time.monotonic()
     mgr, reconciler, _ = build_manager(
@@ -275,6 +288,7 @@ def main(argv=None) -> int:
     from tpu_operator import consts as _c
 
     join_time_to_ready = None
+    join_phases = None
     if ok and args.join_storm > 0:
         t_join = time.monotonic()
         joined = server.sim.add_nodes(
@@ -283,7 +297,51 @@ def main(argv=None) -> int:
         nodes.extend(joined)
         deadline_j = time.monotonic() + args.timeout
 
-        def join_ready():
+        # per-node convergence timeline: first-seen time of each phase
+        # (join -> labeled -> validated -> slice-Ready) sampled once per
+        # poll — the phase-latency percentiles name WHERE a slow join
+        # storm spends its time (labeling vs validation vs slice math)
+        phase_seen = {"labeled": {}, "validated": {}, "slice_ready": {}}
+
+        def _validator_nodes():
+            out = set()
+            try:
+                pods = client.list(
+                    "v1",
+                    "Pod",
+                    NS,
+                    label_selector={"app": "tpu-operator-validator"},
+                )
+            except Exception:
+                return out
+            for pod in pods:
+                if pod.get("status", {}).get("phase") != "Running":
+                    continue
+                node = pod.get("spec", {}).get("nodeName")
+                if node:
+                    out.add(node)
+            return out
+
+        def _sample_phases(now):
+            labels = _labels_by_name()
+            validated = _validator_nodes()
+            for n in joined:
+                lab = labels.get(n, {})
+                if (
+                    n not in phase_seen["labeled"]
+                    and lab.get(_c.TPU_PRESENT_LABEL) == "true"
+                ):
+                    phase_seen["labeled"][n] = now
+                if n not in phase_seen["validated"] and n in validated:
+                    phase_seen["validated"][n] = now
+                if (
+                    n not in phase_seen["slice_ready"]
+                    and lab.get(_c.SLICE_READY_LABEL) == "true"
+                ):
+                    phase_seen["slice_ready"][n] = now
+            return labels
+
+        def join_ready(labels):
             cp = (
                 client.get_or_none(CPV, "ClusterPolicy", "cluster-policy")
                 or {}
@@ -292,18 +350,37 @@ def main(argv=None) -> int:
                 return False
             # every joined node labeled, validated, and slice-ready —
             # the full label/validate/slice-form pipeline completed
-            labels = _labels_by_name()
             return all(
                 labels.get(n, {}).get(_c.SLICE_READY_LABEL) == "true"
                 for n in joined
             )
 
         while time.monotonic() < deadline_j:
-            if join_ready():
+            labels_now = _sample_phases(time.monotonic())
+            if join_ready(labels_now):
                 join_time_to_ready = round(time.monotonic() - t_join, 2)
                 break
             time.sleep(0.2)
         ok = ok and join_time_to_ready is not None
+
+        def _pct(values, p):
+            if not values:
+                return None
+            ordered = sorted(values)
+            idx = min(
+                len(ordered) - 1,
+                max(0, int(round(p / 100.0 * (len(ordered) - 1)))),
+            )
+            return round(ordered[idx], 2)
+
+        join_phases = {}
+        for phase, seen in phase_seen.items():
+            lat = [t - t_join for t in seen.values()]
+            join_phases[phase] = {
+                "nodes": len(lat),
+                "p50_s": _pct(lat, 50),
+                "p99_s": _pct(lat, 99),
+            }
 
     preempt_recover = None
     if ok and args.preempt_pct > 0:
@@ -391,6 +468,11 @@ def main(argv=None) -> int:
     steady_ok = True
     rounds = 5
     round_ms = []
+    # tracing OFF for the baseline rounds — the overhead comparison
+    # below needs an honest untraced min even when --trace-out enabled
+    # tracing for the whole convergence
+    was_tracing = trace_mod.TRACER.enabled
+    trace_mod.disable()
     pass_t0 = time.monotonic()
     for _ in range(rounds):
         t = time.monotonic()
@@ -401,6 +483,25 @@ def main(argv=None) -> int:
         round_ms.append((time.monotonic() - t) * 1000.0)
     reconcile_pass_ms = (time.monotonic() - pass_t0) * 1000.0 / rounds
     per_reconcile = (server.sim.requests_total() - before) / rounds
+    # tracing-ON rounds: same steady pass, spans live — the overhead
+    # budget the obs-fast CI smoke gates (≤ 1.15× the untraced min)
+    trace_mod.enable()
+    traced_ms = []
+    for _ in range(rounds):
+        t = time.monotonic()
+        try:
+            steady_ok = reconciler.reconcile().ready and steady_ok
+        except Exception:
+            steady_ok = False
+        traced_ms.append((time.monotonic() - t) * 1000.0)
+    trace_overhead_pct = (
+        round((min(traced_ms) / min(round_ms) - 1.0) * 100.0, 2)
+        if min(round_ms) > 0
+        else None
+    )
+    trace_summary = dict(trace_mod.TRACER.last_pass)
+    if not was_tracing:
+        trace_mod.disable()
     # render-path steady state: the last quiesced pass must serve every
     # manifest from the fingerprint-gated render cache
     render_stats = reconciler.ctrl.render_cache.stats()
@@ -501,6 +602,9 @@ def main(argv=None) -> int:
         "time_to_ready_s": round(elapsed, 2),
         "join_storm_nodes": args.join_storm,
         "join_time_to_ready_s": join_time_to_ready,
+        # per-node convergence timeline (join -> labeled -> validated ->
+        # slice-Ready), p50/p99 per phase over the joined wave
+        "join_phase_latency": join_phases,
         "preempt_pct": args.preempt_pct,
         "preempt_recover_s": preempt_recover,
         "converge_requests": converge_requests,
@@ -533,7 +637,19 @@ def main(argv=None) -> int:
         "render_cache_fingerprint": render_stats["fingerprint"],
         "peak_rss_mib": _peak_rss_mib(),
         "pod_informer_objects": pod_informer_objects,
+        # tracing cost on the steady pass (min traced vs min untraced)
+        # and the last traced pass's self-time-by-layer breakdown
+        "trace_overhead_pct": trace_overhead_pct,
+        "trace_summary": trace_summary,
     }
+    if args.trace_out:
+        try:
+            out["trace_spans"] = trace_mod.TRACER.export_chrome(
+                args.trace_out
+            )
+            out["trace_out"] = args.trace_out
+        except Exception:
+            out["trace_out"] = None
     if warm is not None:
         out.update(warm)
         out["warm_ok"] = warm_ok

@@ -1,0 +1,120 @@
+"""Chaos post-mortem capture (ISSUE 10 acceptance): a forced disruption
+-budget invariant violation — real repartition admissions checked by the
+real chaos-soak ``InvariantChecker`` under a lowered cap — produces a
+flight-recorder dump whose timeline NAMES the violating admissions."""
+
+import json
+import os
+import time
+from types import SimpleNamespace
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+NS = "tpu-operator"
+
+
+def _fleet(n=3):
+    from tpu_operator.kube import FakeClient
+    from tpu_operator.kube.testing import make_tpu_node
+
+    return FakeClient(
+        [
+            {
+                "apiVersion": "v1",
+                "kind": "Namespace",
+                "metadata": {"name": NS},
+            }
+        ]
+        + [make_tpu_node(f"fv-{i}") for i in range(n)]
+    )
+
+
+def test_forced_budget_violation_dump_names_the_admissions(tmp_path):
+    from tpu_operator.chaos.soak import InvariantChecker
+    from tpu_operator.controllers.repartition import (
+        SliceRepartitionController,
+    )
+    from tpu_operator.obs import flight
+
+    flight.RECORDER.dir = str(tmp_path)
+    flight.RECORDER.min_interval_s = 0.0
+    flight.RECORDER.clear()
+    dumps_before = flight.RECORDER.dumps_total
+
+    client = _fleet(3)
+    nodes = client.list("v1", "Node", copy=True)
+
+    # the real controller admits all three single-host slices under its
+    # own (generous) cap — each admission lands a budget.admit event in
+    # the flight ring, exactly like a production roll
+    spec = SimpleNamespace(
+        config=SimpleNamespace(name="layouts", default="balanced-2x2"),
+        max_unavailable="100%",
+    )
+    ctrl = SliceRepartitionController(client)
+    summary = ctrl.reconcile(nodes, spec, NS)
+    assert summary.rolling_slices == 3, summary
+
+    # the soak's checker audits the SAME cluster under the shared cap
+    # the fleet actually runs with (1): three rolling holds violate it
+    checker = InvariantChecker(
+        client, NS, max_unavailable="1", grace_s=0.0
+    )
+    checker.check_once()
+    time.sleep(0.01)
+    checker.check_once()
+    assert any(
+        v.startswith("budget:cap") for v in checker.violations
+    ), checker.violations
+
+    # the violation dumped a flight file...
+    assert flight.RECORDER.dumps_total == dumps_before + 1
+    path = flight.RECORDER.last_dump_path
+    assert path and os.path.exists(path)
+    dump = json.loads(open(path).read())
+    assert dump["reason"].startswith("invariant-budget")
+
+    # ...whose timeline names the violating admissions: the same slice
+    # ids the violation reports appear as budget.admit events with
+    # their owner and target node
+    violation = next(
+        e for e in dump["events"] if e["kind"] == "invariant.violation"
+    )
+    assert violation["key"] == "budget:cap"
+    admits = [
+        e
+        for e in dump["events"]
+        if e["kind"] == "budget.admit" and e.get("owner") == "repartition"
+    ]
+    admitted_nodes = {e["node"] for e in admits}
+    assert admitted_nodes == {"fv-0", "fv-1", "fv-2"}
+    for name in admitted_nodes:
+        assert name in violation["detail"], (name, violation["detail"])
+    # the admissions carry the layout that was being rolled
+    assert all(e["layout"] == "balanced-2x2" for e in admits)
+
+
+def test_soak_report_lists_flight_dumps(tmp_path):
+    """The fast-tier soak surface: a clean run reports an empty
+    flight_dumps list (the key exists for red runs to fill)."""
+    from tpu_operator.chaos.soak import SoakRunner
+
+    from tpu_operator.obs import flight
+
+    flight.RECORDER.dir = str(tmp_path)
+    flight.RECORDER.clear()
+    runner = SoakRunner(
+        nodes=4,
+        slice_pairs=1,
+        seed=3,
+        duration_s=1.0,
+        churn=False,
+        repartition=False,
+        converge_timeout_s=90.0,
+        settle_timeout_s=90.0,
+    )
+    report = runner.run()
+    assert "flight_dumps" in report
+    assert report["ok"], report
+    assert report["flight_dumps"] == [], report["flight_dumps"]

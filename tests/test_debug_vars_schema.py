@@ -1,0 +1,89 @@
+"""/debug/vars schema stability (ISSUE 10 satellite): the top-level key
+set of the SHIPPED wiring (``build_manager`` + ``Manager``) is pinned so
+a refactor silently dropping a diagnostic surface fails tier-1 instead
+of being discovered during an incident."""
+
+import json
+import os
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+NS = "tpu-operator"
+
+# the stable diagnostic surface: every key a dashboard, runbook or soak
+# harness reads today. ADDING keys is fine; dropping one is a breaking
+# change to the operational contract and must be deliberate (update this
+# set in the same PR that updates the runbooks).
+REQUIRED_KEYS = {
+    # manager internals
+    "queue_len",
+    "threads",
+    "reconcilers",
+    "last_reconcile_ok",
+    "watchdog",
+    # apiserver fault tolerance (kube/retry.py)
+    "fault_tolerance",
+    # read path
+    "reconcile_snapshot",
+    "render_cache",
+    # write path
+    "write_pipeline",
+    "apply_batches",
+    "applyset",
+    # fleet FSMs
+    "remediation",
+    "repartition",
+    # allocation traffic (placeholder until a churn harness registers
+    # the live engine under the same key)
+    "allocation",
+    # observability subsystem (ISSUE 10)
+    "trace",
+    "flight",
+}
+
+
+def _shipped_payload():
+    from tpu_operator.kube import FakeClient
+    from tpu_operator.main import build_manager
+
+    client = FakeClient()
+    mgr, _, _ = build_manager(
+        client,
+        NS,
+        metrics_port=0,
+        probe_port=0,
+        informer_cache=False,
+    )
+    try:
+        return mgr.debug_vars_payload()
+    finally:
+        mgr.stop()
+
+
+def test_debug_vars_keyset_is_stable():
+    payload = _shipped_payload()
+    missing = REQUIRED_KEYS - set(payload)
+    assert not missing, (
+        f"/debug/vars lost diagnostic surface(s): {sorted(missing)} — "
+        f"present: {sorted(payload)}"
+    )
+
+
+def test_debug_vars_payload_is_json_and_providers_healthy():
+    payload = _shipped_payload()
+    # the whole payload must serialize (the HTTP handler json.dumps it)
+    blob = json.dumps(payload)
+    assert blob
+    # no registered provider degraded to an error entry in the default
+    # wiring — a provider crashing at rest is a wiring bug, not a
+    # runtime condition
+    for key in REQUIRED_KEYS:
+        value = payload[key]
+        if isinstance(value, dict):
+            assert "error" not in value, (key, value)
+    # spot-check shapes the runbooks rely on
+    assert "stalled" in payload["watchdog"]
+    assert "pass_deadline_s" in payload["watchdog"]
+    assert payload["trace"]["enabled"] in (True, False)
+    assert "dumps_total" in payload["flight"]

@@ -1,0 +1,93 @@
+"""The consolidated log-once registry (ISSUE 10 satellite): one
+``obs/logonce.py`` implementation behind the no-TPU DaemonSet skip,
+remediation's (node, reason) pairs and repartition's slice log-once."""
+
+import logging
+import os
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+from tpu_operator.obs.logonce import LogOnce  # noqa: E402
+
+log = logging.getLogger("logonce-test")
+
+
+def _infos(caplog, needle):
+    return [
+        r
+        for r in caplog.records
+        if r.levelno == logging.INFO and needle in r.getMessage()
+    ]
+
+
+def test_log_once_then_debug(caplog):
+    reg = LogOnce()
+    with caplog.at_level(logging.DEBUG, logger="logonce-test"):
+        assert reg.log(log, ("n1", "budget"), "deferred %s", "n1") is True
+        assert reg.log(log, ("n1", "budget"), "deferred %s", "n1") is False
+        assert reg.log(log, ("n2", "budget"), "deferred %s", "n2") is True
+    infos = _infos(caplog, "deferred")
+    assert len(infos) == 2  # one per key, repeats demoted to DEBUG
+    debugs = [
+        r
+        for r in caplog.records
+        if r.levelno == logging.DEBUG and "deferred" in r.getMessage()
+    ]
+    assert len(debugs) == 1
+
+
+def test_clear_makes_a_new_stretch_log_again(caplog):
+    reg = LogOnce()
+    with caplog.at_level(logging.INFO, logger="logonce-test"):
+        reg.log(log, "ds-a", "skip %s", "ds-a")
+        reg.clear("ds-a")  # condition cleared
+        reg.log(log, "ds-a", "skip %s", "ds-a")
+    assert len(_infos(caplog, "skip")) == 2
+
+
+def test_prune_retires_dead_subjects_only():
+    reg = LogOnce()
+    reg.add(("alive", "budget"))
+    reg.add(("dead", "budget"))
+    reg.add(("dead", "interlock"))
+    reg.add("plain-alive")
+    reg.add("plain-dead")
+    dropped = reg.prune({"alive", "plain-alive"})
+    assert dropped == 3
+    assert ("alive", "budget") in reg
+    assert ("dead", "budget") not in reg
+    assert ("dead", "interlock") not in reg
+    assert "plain-alive" in reg and "plain-dead" not in reg
+    assert len(reg) == 2
+
+
+def test_set_surface_compat():
+    reg = LogOnce()
+    reg.add("x")
+    assert "x" in reg and len(reg) == 1
+    reg.discard("x")
+    assert "x" not in reg
+    reg.add("y")
+    reg.clear()  # no-arg clear = full reset (the no-TPU transition)
+    assert len(reg) == 0
+
+
+def test_all_three_registries_are_logonce():
+    from tpu_operator.controllers.remediation import (
+        NodeRemediationController,
+    )
+    from tpu_operator.controllers.repartition import (
+        SliceRepartitionController,
+    )
+    from tpu_operator.controllers.state_manager import (
+        ClusterPolicyController,
+    )
+    from tpu_operator.kube import FakeClient
+
+    client = FakeClient()
+    assert isinstance(
+        ClusterPolicyController(client).no_tpu_skip_logged, LogOnce
+    )
+    assert isinstance(NodeRemediationController(client)._logged, LogOnce)
+    assert isinstance(SliceRepartitionController(client)._logged, LogOnce)

@@ -1,0 +1,82 @@
+"""HAVE_PROM=False fallback (ISSUE 10 satellite): with
+``prometheus_client`` masked at import, every gauge/counter/histogram
+access hits a no-op stub and the operator converges a fake cluster
+metric-less instead of raising AttributeError."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, {block!r})   # masks prometheus_client
+    sys.path.insert(0, {repo!r})
+    os.environ["OPERATOR_NAMESPACE"] = "tpu-operator"
+    os.environ["UNIT_TEST"] = "true"
+
+    from tpu_operator.controllers.operator_metrics import (
+        HAVE_PROM, OperatorMetrics, _NoopMetric,
+    )
+    assert not HAVE_PROM, "mask failed: prometheus_client imported"
+
+    m = OperatorMetrics()
+    # every collector attribute is a callable-safe stub
+    for name in vars(m):
+        attr = getattr(m, name)
+        if isinstance(attr, _NoopMetric):
+            attr.labels(state="x").set(1)
+            attr.inc()
+            attr.observe(1.0)
+            attr.remove("x")
+    m.observe_reconcile(1)
+    m.observe_reconcile(-1)
+    m.set_state("state-libtpu", 1)
+    # the histogram hooks installed into the kube layer are stubs too
+    from tpu_operator.kube import rest, write_pipeline
+    write_pipeline.on_queue_wait_ms(1.0)
+    rest.on_write_rtt_ms("APPLY", 2.0)
+
+    # the real proof: a full fake-cluster converge, metric-less
+    from tpu_operator.main import make_fake_client
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        ClusterPolicyReconciler,
+    )
+    from tpu_operator.kube.testing import simulate_kubelet_once
+
+    client = make_fake_client()
+    r = ClusterPolicyReconciler(client)
+    res = None
+    for _ in range(30):
+        res = r.reconcile()
+        simulate_kubelet_once(client, "tpu-operator")
+        if res.ready:
+            break
+    assert res is not None and res.ready, "never converged metric-less"
+    print("METRICLESS_OK")
+    """
+)
+
+
+def test_operator_converges_without_prometheus(tmp_path):
+    block = tmp_path / "block"
+    block.mkdir()
+    (block / "prometheus_client.py").write_text(
+        'raise ImportError("prometheus_client masked for the '
+        'HAVE_PROM=False fallback test")\n'
+    )
+    script = _SCRIPT.format(block=str(block), repo=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, (
+        f"metric-less operator crashed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    assert "METRICLESS_OK" in proc.stdout

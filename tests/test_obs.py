@@ -1,0 +1,369 @@
+"""Tracing + flight-recorder unit suite and the tracing-overhead smoke
+(ISSUE 10 tentpole; ``make obs-fast``)."""
+
+import json
+import logging
+import os
+import time
+
+import pytest
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+NS = "tpu-operator"
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    from tpu_operator.obs import flight, trace
+
+    trace.disable()
+    trace.TRACER.reset()
+    yield
+    trace.disable()
+    trace.TRACER.reset()
+    flight.RECORDER.clear()
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+
+def test_span_disabled_is_shared_noop():
+    from tpu_operator.obs import trace
+
+    assert not trace.TRACER.enabled
+    a = trace.span("pass.x", k=1)
+    b = trace.span("state.y")
+    # the disabled fast path allocates nothing: same shared handle
+    assert a is b is trace.NOOP
+    with a as sp:
+        sp.set("ignored", True)  # no-op, never raises
+    assert trace.TRACER.spans_total == 0
+    trace.instant("pass.marker")  # also a no-op while disabled
+    assert trace.TRACER.spans_total == 0
+
+
+def test_span_nesting_parents_and_self_time():
+    from tpu_operator.obs import trace
+
+    trace.enable()
+    with trace.span("pass.outer"):
+        time.sleep(0.002)
+        with trace.span("state.inner", state="s1"):
+            time.sleep(0.004)
+    summary = trace.TRACER.mark_pass()
+    assert set(summary) == {"pass", "state"}
+    # the child's time is excluded from the parent's SELF time but
+    # included in its total
+    assert summary["pass"]["total_ms"] >= summary["state"]["total_ms"]
+    assert summary["pass"]["self_ms"] < summary["pass"]["total_ms"]
+    assert summary["state"]["spans"] == 1
+    # a second mark with no new spans reports an empty pass
+    assert trace.TRACER.mark_pass() == {}
+
+
+def test_span_records_error_and_attrs():
+    from tpu_operator.obs import trace
+
+    trace.enable()
+    with pytest.raises(ValueError):
+        with trace.span("rest.request", verb="PUT") as sp:
+            sp.set("retries", 2)
+            raise ValueError("boom")
+    stats = trace.TRACER.stats()
+    assert stats["spans_total"] == 1
+    snap = list(trace.TRACER._spans)
+    assert snap[0]["args"]["verb"] == "PUT"
+    assert snap[0]["args"]["retries"] == 2
+    assert snap[0]["args"]["error"] == "ValueError"
+
+
+def test_chrome_export_is_perfetto_loadable_json(tmp_path):
+    from tpu_operator.obs import trace
+
+    trace.enable()
+    with trace.span("pass.reconcile"):
+        with trace.span("apply.object", kind="DaemonSet", name="d"):
+            pass
+    trace.instant("render.cache_hit", state="s")
+    out = tmp_path / "trace.json"
+    n = trace.TRACER.export_chrome(str(out))
+    assert n == 3
+    data = json.loads(out.read_text())
+    events = data["traceEvents"]
+    assert len(events) == 3
+    durations = [e for e in events if e["ph"] == "X"]
+    instants = [e for e in events if e["ph"] == "i"]
+    assert len(durations) == 2 and len(instants) == 1
+    for e in durations:
+        assert {"name", "cat", "ts", "dur", "pid", "tid"} <= set(e)
+    # the child names its parent for causal reconstruction
+    child = next(e for e in durations if e["name"] == "apply.object")
+    parent = next(e for e in durations if e["name"] == "pass.reconcile")
+    assert child["args"]["parent"] == parent["id"]
+
+
+def test_tracer_ring_is_bounded():
+    from tpu_operator.obs.trace import Tracer, _SpanHandle
+
+    t = Tracer(capacity=64)
+    t.enable()
+    for i in range(200):
+        with _SpanHandle(t, "pass.x", {}):
+            pass
+    assert t.spans_total == 200
+    assert len(t._spans) == 64
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_bounded_and_dump(tmp_path):
+    from tpu_operator.obs.flight import FlightRecorder
+
+    rec = FlightRecorder(event_capacity=32)
+    rec.dir = str(tmp_path)
+    rec.min_interval_s = 0.0
+    for i in range(100):
+        rec.record("labels.write", nodes=i)
+    assert rec.events_total == 100
+    snap = rec.snapshot()
+    assert len(snap["events"]) == 32
+    assert snap["events"][-1]["nodes"] == 99
+
+    sink_calls = []
+    rec.event_sink = lambda reason, detail, path: sink_calls.append(
+        (reason, detail, path)
+    )
+    path = rec.dump("unit-test", detail="forced")
+    assert path and os.path.exists(path)
+    data = json.loads(open(path).read())
+    assert data["reason"] == "unit-test"
+    assert data["detail"] == "forced"
+    assert len(data["events"]) == 32
+    assert sink_calls == [("unit-test", "forced", path)]
+    assert rec.stats()["dumps_total"] == 1
+
+
+def test_flight_dump_rate_limited(tmp_path):
+    from tpu_operator.obs.flight import FlightRecorder
+
+    rec = FlightRecorder()
+    rec.dir = str(tmp_path)
+    rec.min_interval_s = 60.0
+    assert rec.dump("same-reason") is not None
+    assert rec.dump("same-reason") is None  # inside the window
+    assert rec.dump("other-reason") is not None  # per-reason limiter
+    assert rec.dumps_total == 2
+
+
+def test_spans_flow_into_flight_ring():
+    from tpu_operator.obs import flight, trace
+
+    trace.enable()
+    with trace.span("fsm.remediation"):
+        pass
+    snap = flight.RECORDER.snapshot()
+    assert any(s["name"] == "fsm.remediation" for s in snap["spans"])
+
+
+def test_flight_broken_sink_never_breaks_dump(tmp_path):
+    from tpu_operator.obs.flight import FlightRecorder
+
+    rec = FlightRecorder()
+    rec.dir = str(tmp_path)
+    rec.min_interval_s = 0.0
+
+    def broken(*a):
+        raise RuntimeError("sink down")
+
+    rec.event_sink = broken
+    assert rec.dump("x") is not None
+
+
+# ---------------------------------------------------------------------------
+# histogram promotion (ISSUE 10 part 3)
+# ---------------------------------------------------------------------------
+
+
+def test_latency_histograms_registered_and_observable():
+    from tpu_operator.controllers.operator_metrics import (
+        HAVE_PROM,
+        OperatorMetrics,
+    )
+
+    m = OperatorMetrics()
+    for attr in (
+        "reconcile_pass_ms_hist",
+        "state_render_ms_hist",
+        "write_pipeline_queue_wait_hist",
+        "apply_rtt_ms_hist",
+        "alloc_latency_ms_hist",
+    ):
+        assert hasattr(m, attr), attr
+    m.reconcile_pass_ms_hist.observe(12.0)
+    m.state_render_ms_hist.labels(state="state-libtpu").observe(0.8)
+    m.write_pipeline_queue_wait_hist.observe(0.2)
+    m.apply_rtt_ms_hist.labels(verb="APPLY").observe(1.5)
+    m.alloc_latency_ms_hist.observe(40.0)
+    if HAVE_PROM:
+        from prometheus_client import generate_latest
+
+        text = generate_latest().decode()
+        assert "tpu_operator_reconcile_pass_duration_ms_bucket" in text
+        assert 'verb="APPLY"' in text
+
+
+def test_queue_wait_hook_feeds_histogram():
+    from tpu_operator.controllers.operator_metrics import OperatorMetrics
+    from tpu_operator.kube import write_pipeline as wp
+
+    OperatorMetrics()  # installs the hook
+    assert wp.on_queue_wait_ms is not None
+    observed = []
+    orig = wp.on_queue_wait_ms
+    wp.on_queue_wait_ms = observed.append
+    try:
+        pipe = wp.WritePipeline(depth=2, name="obs-test")
+        pipe.submit("k", lambda: "v").result()
+        pipe.drain()
+    finally:
+        wp.on_queue_wait_ms = orig
+    assert len(observed) == 1 and observed[0] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# instrumented pass: spans cover the layer stack end-to-end
+# ---------------------------------------------------------------------------
+
+
+def _mini_reconciler(n_nodes=4):
+    import yaml
+
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        ClusterPolicyReconciler,
+    )
+    from tpu_operator.kube import FakeClient
+    from tpu_operator.kube.testing import (
+        make_tpu_node,
+        sample_clusterpolicy_path,
+    )
+
+    objs = [
+        {
+            "apiVersion": "v1",
+            "kind": "Namespace",
+            "metadata": {"name": NS},
+        }
+    ] + [make_tpu_node(f"obs-{i}") for i in range(n_nodes)]
+    client = FakeClient(objs)
+    with open(sample_clusterpolicy_path()) as f:
+        cr = yaml.safe_load(f)
+    cr["metadata"]["uid"] = "obs-uid"
+    client.create(cr)
+    return ClusterPolicyReconciler(client), client
+
+
+def test_traced_pass_covers_the_layer_stack():
+    from tpu_operator.kube.testing import simulate_kubelet_once
+    from tpu_operator.obs import trace
+
+    r, client = _mini_reconciler()
+    trace.enable()
+    for _ in range(3):
+        r.reconcile()
+        simulate_kubelet_once(client, NS)
+    layers = set(trace.TRACER.stats()["layers"])
+    # pass -> waves -> per-state steps -> renders -> applies -> FSM
+    # sub-passes all present in one converge's trace
+    for expected in ("pass", "state", "render", "apply", "fsm"):
+        assert expected in layers, (expected, layers)
+    assert r.last_trace_summary, "reconciler did not seal a pass summary"
+
+
+def test_degraded_state_dumps_flight_once_per_transition(
+    tmp_path, monkeypatch
+):
+    from tpu_operator.controllers import object_controls
+    from tpu_operator.obs import flight
+
+    r, client = _mini_reconciler(n_nodes=1)
+    flight.RECORDER.dir = str(tmp_path)
+    flight.RECORDER.min_interval_s = 0.0
+    flight.RECORDER.clear()
+    before = flight.RECORDER.dumps_total
+
+    orig = object_controls.CONTROLS["daemonset"]
+
+    def boom(n, state_name, obj):
+        if state_name == "state-libtpu":
+            raise RuntimeError("forced operand failure")
+        return orig(n, state_name, obj)
+
+    monkeypatch.setitem(object_controls.CONTROLS, "daemonset", boom)
+    r.reconcile()
+    assert flight.RECORDER.dumps_total == before + 1
+    data = json.loads(open(flight.RECORDER.last_dump_path).read())
+    assert data["reason"] == "state-degraded"
+    assert "state-libtpu" in data["detail"]
+    assert any(
+        e["kind"] == "state.degraded" and e["state"] == "state-libtpu"
+        for e in data["events"]
+    )
+    # the same degraded picture on the next pass does NOT dump again
+    r.reconcile()
+    assert flight.RECORDER.dumps_total == before + 1
+
+
+# ---------------------------------------------------------------------------
+# overhead smoke: tracing ON <= 1.15x tracing-off min (the obs-fast gate)
+# ---------------------------------------------------------------------------
+
+
+def test_tracing_overhead_smoke():
+    from tpu_operator.kube.testing import simulate_kubelet_once
+    from tpu_operator.obs import trace
+
+    r, client = _mini_reconciler(n_nodes=120)
+    # converge-ish warmup: hash-gated applies and label writes settle so
+    # the measured rounds are honest zero-write steady passes
+    for _ in range(4):
+        r.reconcile()
+        simulate_kubelet_once(client, NS)
+
+    def min_pass_ms(rounds=12, per_round=2):
+        best = float("inf")
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            for _ in range(per_round):
+                r.reconcile()
+            best = min(
+                best, (time.perf_counter() - t0) * 1000.0 / per_round
+            )
+        return best
+
+    # interleave OFF/ON batches so scheduler drift hits both sides
+    trace.disable()
+    off1 = min_pass_ms()
+    trace.enable()
+    on1 = min_pass_ms()
+    trace.disable()
+    off2 = min_pass_ms()
+    trace.enable()
+    on2 = min_pass_ms()
+    trace.disable()
+    off_ms = min(off1, off2)
+    on_ms = min(on1, on2)
+    # the ISSUE's overhead budget, with a 0.2 ms absolute epsilon so a
+    # sub-millisecond pass on a noisy box cannot flake the gate on
+    # scheduler jitter smaller than the measurement granularity
+    assert on_ms <= off_ms * 1.15 + 0.2, (
+        f"tracing-on steady pass {on_ms:.3f} ms exceeds 1.15x the "
+        f"tracing-off min {off_ms:.3f} ms: the span fast path grew a "
+        f"hot-path cost"
+    )

@@ -60,16 +60,28 @@ def test_reconcile_pass_under_ceiling_at_1000_nodes(monkeypatch):
         # it is dominated by the 1000 label writes)
         r.reconcile()
 
-        rounds = 5
-        t0 = time.perf_counter()
-        for _ in range(rounds):
-            r.reconcile()
-        pass_ms = (time.perf_counter() - t0) * 1000.0 / rounds
+        # tracing ON for the timed rounds (ISSUE 10 acceptance): the
+        # steady-pass ceiling must hold WITH the span instrumentation
+        # live — the overhead budget is part of the gate
+        from tpu_operator.obs import trace
+
+        trace.enable()
+        try:
+            rounds = 5
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                r.reconcile()
+            pass_ms = (time.perf_counter() - t0) * 1000.0 / rounds
+        finally:
+            trace.disable()
         assert pass_ms <= PASS_MS_CEILING, (
             f"steady reconcile pass {pass_ms:.1f} ms at {N_NODES} nodes "
-            f"(> {PASS_MS_CEILING:.0f} ms ceiling): the read path is "
-            f"scanning/copying the fleet again"
+            f"(> {PASS_MS_CEILING:.0f} ms ceiling, tracing ON): the "
+            f"read path is scanning/copying the fleet again — or the "
+            f"tracer grew a hot-path cost"
         )
+        # the traced pass actually produced spans + a layer summary
+        assert r.last_trace_summary, "traced pass produced no summary"
         # the pass demonstrably rode the snapshot + zero-copy reads
         assert r.ctrl.last_snapshot_stats["hits"] >= 1
         reads = cached.read_stats()

@@ -37,6 +37,7 @@ import time
 from typing import Dict, List, Optional, Set
 
 from tpu_operator import consts
+from tpu_operator.obs import flight
 
 log = logging.getLogger("tpu-chaos")
 
@@ -179,6 +180,13 @@ class InvariantChecker:
         if record not in self.violations:
             self.violations.append(record)
             log.error("INVARIANT VIOLATION %s", record)
+            # post-mortem: freeze the recent causal timeline (budget
+            # admissions, label writes, FSM transitions, chaos events,
+            # breaker trips) the moment the invariant flags — "violation
+            # at seed 5, round 37" becomes a replayable dump naming the
+            # violating write/admission
+            flight.record("invariant.violation", key=key, detail=detail)
+            flight.RECORDER.dump(f"invariant-{key}", detail=record)
 
     def _clear(self, key_prefix: str, active: Set[str]) -> None:
         for key in [k for k in self._pending if k.startswith(key_prefix)]:
@@ -598,6 +606,10 @@ class SoakRunner:
             "seed": self.seed,
             "nodes_initial": self.n_nodes,
         }
+        # set-diff, not a length slice: dump_paths is a bounded ring and
+        # a wrap during a long run would silently drop this run's dumps
+        # (snapshot accessor: the live deque may be appended mid-read)
+        dumps_before = set(flight.RECORDER.dump_paths_snapshot())
         try:
             converged = wait_until(
                 lambda: cp_state() == "ready", self.converge_timeout_s
@@ -681,6 +693,13 @@ class SoakRunner:
         report["checker_samples"] = checker.samples
         report["checker_sample_errors"] = checker.sample_errors
         report["violations"] = checker.violations + final
+        # flight-recorder dumps fired during THIS run: each violation's
+        # replayable causal timeline (see docs/observability.md)
+        report["flight_dumps"] = [
+            p
+            for p in flight.RECORDER.dump_paths_snapshot()
+            if p not in dumps_before
+        ]
         report["ok"] = bool(
             report.get("converged_before_chaos")
             and report.get("settled")
@@ -699,6 +718,17 @@ class SoakRunner:
             delay = t0 + ev.at_s * self.time_scale - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
+            # the injected chaos is half the post-mortem timeline: a
+            # dump must show WHAT was done to the fleet next to how the
+            # operator responded (victim lists truncated to stay small)
+            flight.record(
+                "chaos." + ev.kind,
+                at_s=round(ev.at_s, 3),
+                **{
+                    k: (list(v[:8]) if isinstance(v, (list, tuple)) else v)
+                    for k, v in ev.args.items()
+                },
+            )
             try:
                 if ev.kind == "join":
                     extra = None

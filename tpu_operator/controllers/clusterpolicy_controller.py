@@ -25,6 +25,7 @@ from tpu_operator.controllers.state_manager import (
     has_tpu_labels,
 )
 from tpu_operator.kube.client import Client, ConflictError
+from tpu_operator.obs import flight, trace
 
 log = logging.getLogger("tpu-operator.reconcile")
 
@@ -88,8 +89,47 @@ class ClusterPolicyReconciler:
         # passes" is meaningful on any box, "stale for N seconds" only
         # on an idle one
         self.passes_total = 0
+        # Degraded-transition tracker: the flight recorder dumps once
+        # per NEW errored-state picture, not once per 5 s requeue
+        self._last_errored_states: frozenset = frozenset()
+        # the last pass's self-time-by-layer trace summary (populated
+        # while tracing is enabled; /debug/vars "trace" mirrors it)
+        self.last_trace_summary = {}
+        # flight dumps post a warning Event against the primary CR.
+        # Weakly bound: the process-global recorder must not pin a
+        # retired reconciler (test fixtures build many per process)
+        import weakref
+
+        self_ref = weakref.ref(self)
+
+        def _sink(reason: str, detail: str, path: str) -> None:
+            live = self_ref()
+            if live is not None:
+                live._flight_dump_event(reason, detail, path)
+
+        flight.RECORDER.event_sink = _sink
+
+    def _flight_dump_event(self, reason: str, detail: str, path: str) -> None:
+        """Flight-recorder dump notifier: a warning Event on the CR so
+        the dump is discoverable from ``kubectl describe``."""
+        cp = self.ctrl.cp_obj
+        ns = self.ctrl.namespace
+        if not cp or not ns:
+            return
+        record_event(
+            self.client,
+            ns,
+            cp,
+            TYPE_WARNING,
+            "FlightRecorderDump",
+            f"flight recorder dumped ({reason}"
+            + (f": {detail}" if detail else "")
+            + f") -> {path}",
+        )
 
     def reconcile(self, name: str = "") -> Result:
+        import time as _time
+
         # copy=True: the CR objects are mutated below (_set_status writes
         # status in place; init stores the primary as cp_obj) — they must
         # be private copies, not the informer's shared frozen views
@@ -103,12 +143,19 @@ class ClusterPolicyReconciler:
         # share one node scan + one indexed pod read per app instead of
         # each issuing their own (end_pass also feeds the hit-rate debug
         # surface and metrics)
+        t0 = _time.perf_counter()
         self.ctrl.begin_pass()
         try:
-            return self._reconcile_pass(policies)
+            with trace.span("pass.reconcile", n=self.passes_total):
+                return self._reconcile_pass(policies)
         finally:
             self.ctrl.end_pass()
             self.passes_total += 1
+            hist = getattr(self.metrics, "reconcile_pass_ms_hist", None)
+            if hist is not None:
+                hist.observe((_time.perf_counter() - t0) * 1000.0)
+            if trace.TRACER.enabled:
+                self.last_trace_summary = trace.TRACER.mark_pass()
             self._update_snapshot_metrics()
 
     def _reconcile_pass(self, policies) -> Result:
@@ -199,16 +246,19 @@ class ClusterPolicyReconciler:
         # Node store version, so the slice aggregate below never memoizes
         # a pre-quarantine world; the labels themselves land in the next
         # pass's node list — level-triggered, like every other writer)
-        remediation_summary = self._run_remediation()
+        with trace.span("fsm.remediation"):
+            remediation_summary = self._run_remediation()
 
         # live slice re-partition roll (after remediation, and handed
         # remediation's in-pass disrupted set: the quarantine labels it
         # just wrote are on the wire but NOT in this pass's node
         # snapshot, and the label-derived joint set alone would let the
         # two consumers jointly over-admit past the one cap)
-        repartition_summary = self._run_repartition(remediation_summary)
+        with trace.span("fsm.repartition"):
+            repartition_summary = self._run_repartition(remediation_summary)
 
-        slice_summary = self._aggregate_slices()
+        with trace.span("pass.slices"):
+            slice_summary = self._aggregate_slices()
 
         was_ready = (primary.get("status", {}) or {}).get("state") == State.READY
         if overall == State.READY and not was_ready:
@@ -239,6 +289,18 @@ class ClusterPolicyReconciler:
                 "states errored: "
                 + "; ".join(f"{n} ({e})" for n, e in errored_states),
             )
+        # flight recorder: a NEW Degraded picture dumps the recent
+        # causal timeline once (the 5 s requeue re-reporting the same
+        # errored set must not dump every pass)
+        errored_now = frozenset(n for n, _ in errored_states)
+        if errored_states and errored_now != self._last_errored_states:
+            for state_name, err in errored_states:
+                flight.record("state.degraded", state=state_name, error=err)
+            flight.RECORDER.dump(
+                "state-degraded",
+                detail=", ".join(sorted(errored_now)),
+            )
+        self._last_errored_states = errored_now
 
         self._set_status(
             primary, overall, slice_summary, errored_states,

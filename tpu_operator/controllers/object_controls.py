@@ -35,6 +35,8 @@ from tpu_operator import consts
 from tpu_operator.api.v1.clusterpolicy_types import State
 from tpu_operator.kube.client import ConflictError
 from tpu_operator.kube.frozen import freeze
+from tpu_operator.obs import trace
+from tpu_operator.obs.logonce import LogOnce
 
 log = logging.getLogger("tpu-operator.controls")
 
@@ -156,7 +158,8 @@ def apply_with_hash(n, obj: Obj, precomputed_hash: Optional[str] = None) -> str:
         ).get(consts.LAST_APPLIED_HASH_ANNOTATION)
         if old_hash == h:
             return h  # no-op: idempotent reconcile, zero requests
-    _submit_apply(n, obj)
+    with trace.span("apply.object", kind=kind, name=meta["name"]):
+        _submit_apply(n, obj)
     return h
 
 
@@ -203,17 +206,35 @@ def _render_memo(
     if cache is not None:
         hit = cache.lookup(key)
         if hit is not None:
+            # steady-state hot path: the instant marker costs one
+            # branch when tracing is off
+            trace.instant("render.cache_hit", state=state_name, key=key[1:3])
             return hit
     t0 = perf_counter()
-    rendered = render(obj)
-    h = compute_hash(rendered)
+    with trace.span(
+        "render.manifest",
+        state=state_name,
+        kind=key[1],
+        name=key[2],
+        cache="miss" if cache is not None else "bypass",
+    ):
+        rendered = render(obj)
+        h = compute_hash(rendered)
+    render_s = perf_counter() - t0
     rendered.setdefault("metadata", {}).setdefault("annotations", {})[
         consts.LAST_APPLIED_HASH_ANNOTATION
     ] = h
+    metrics = getattr(n, "metrics", None)
+    if metrics is not None and getattr(
+        metrics, "state_render_ms_hist", None
+    ):
+        metrics.state_render_ms_hist.labels(state=state_name).observe(
+            render_s * 1000.0
+        )
     if cache is not None:
         rendered = freeze(rendered)
         cache.store(
-            key, rendered, h, state_name, perf_counter() - t0,
+            key, rendered, h, state_name, render_s,
             generation=generation,
         )
     return rendered, h
@@ -414,15 +435,16 @@ def daemonset(n, state_name: str, obj: Obj) -> str:
 
 def _log_no_tpu_skip(n, name: str) -> None:
     """A TPU-less cluster re-reconciles every 45 s forever; the skip is
-    logged at INFO once per DaemonSet per no-TPU transition (the set is
-    cleared when TPU nodes appear), DEBUG thereafter."""
+    logged at INFO once per DaemonSet per no-TPU transition (the
+    registry is cleared when TPU nodes appear), DEBUG thereafter —
+    through the shared ``obs/logonce.py`` registry."""
     logged = getattr(n, "no_tpu_skip_logged", None)
-    if logged is None or name not in logged:
-        if logged is not None:
-            logged.add(name)
-        log.info("no TPU nodes; skipping DaemonSet %s", name)
-    else:
-        log.debug("no TPU nodes; skipping DaemonSet %s", name)
+    if isinstance(logged, LogOnce):
+        logged.log(log, name, "no TPU nodes; skipping DaemonSet %s", name)
+        return
+    # controllers without the registry (unit tests driving a control
+    # directly) log every time, exactly as before
+    log.info("no TPU nodes; skipping DaemonSet %s", name)
 
 
 def _render_daemonset(n, obj: Obj) -> Obj:

@@ -76,6 +76,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from tpu_operator import consts
+from tpu_operator.obs import LogOnce, flight, trace
 from tpu_operator.kube.client import (
     Client,
     ConflictError,
@@ -231,7 +232,7 @@ class NodeRemediationController:
         # log-once state: (node, reason-kind) pairs already noted; an
         # entry is dropped when the condition clears so a recurrence
         # logs again (once per stretch, not once per process)
-        self._logged: Set[tuple] = set()
+        self._logged = LogOnce()
         self._breaker_was_open = False
 
     # ------------------------------------------------------------------
@@ -365,7 +366,7 @@ class NodeRemediationController:
         # grow the set without bound, and a rejoin under the same name
         # would inherit the old suppression
         live = {v.name for v in verdicts}
-        self._logged = {k for k in self._logged if k[0] in live}
+        self._logged.prune(live)
         self._finish(summary, verdicts)
         return summary
 
@@ -603,6 +604,19 @@ class NodeRemediationController:
             return True
 
         mutate_with_retry(self.client, "v1", "Node", name, mutate=mutate)
+        # flight timeline: every FSM transition is a causal post-mortem
+        # event (low rate — at most one per unhealthy node per pass)
+        flight.record(
+            "remediation.fsm", node=name, state=state or "cleared"
+        )
+        if state in (
+            consts.REMEDIATION_STATE_CORDON_DRAIN,
+            consts.REMEDIATION_STATE_QUARANTINED,
+        ):
+            # the FSM just consumed (or confirmed) a shared-budget
+            # disruption unit on this host's slice
+            flight.record("budget.admit", owner="remediation", node=name)
+        trace.instant("fsm.remediation_transition", node=name, state=state)
         if state is not None:
             log.info("node %s remediation-state -> %s", name, state)
 
@@ -1029,10 +1043,7 @@ class NodeRemediationController:
 
     # ------------------------------------------------------------------
     def _log_once(self, key: tuple, msg: str, *args) -> None:
-        if key in self._logged:
-            return
-        self._logged.add(key)
-        log.info(msg, *args)
+        self._logged.log(log, key, msg, *args)
 
     def _record_event(
         self, etype: str, reason: str, message: str, dedup_extra: str = ""

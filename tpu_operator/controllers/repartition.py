@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from tpu_operator import consts
+from tpu_operator.obs import LogOnce, flight
 from tpu_operator.kube.client import (
     Client,
     ConflictError,
@@ -85,7 +86,7 @@ class SliceRepartitionController:
         self.rolls_completed_total = 0
         self.budget_deferred_total = 0
         self.last_summary: Dict[str, object] = {}
-        self._logged: Set[tuple] = set()
+        self._logged = LogOnce()
 
     # ------------------------------------------------------------------
     def stats(self) -> Dict[str, object]:
@@ -249,7 +250,7 @@ class SliceRepartitionController:
         summary.disrupted_slices = len(disrupted)
         # retire log-once state for vanished nodes/slices
         live = set(nodes_by_name) | set(slices)
-        self._logged = {k for k in self._logged if k[0] in live}
+        self._logged.prune(live)
         self.last_summary = {
             "desired": desired,
             "total": summary.total,
@@ -320,6 +321,15 @@ class SliceRepartitionController:
                     self.client, "v1", "Node", name, mutate=mutate
                 )
                 started += 1
+                # flight timeline: each admitted member is one budget-
+                # consuming write — the event a budget post-mortem names
+                flight.record(
+                    "budget.admit",
+                    owner="repartition",
+                    sid=sid,
+                    node=name,
+                    layout=desired,
+                )
                 log.info(
                     "node %s: rolling slice layout -> %r (slice %s)",
                     name,
@@ -346,6 +356,7 @@ class SliceRepartitionController:
             return True
 
         mutate_with_retry(self.client, "v1", "Node", name, mutate=mutate)
+        flight.record("budget.release", owner="repartition", node=name)
         log.info("node %s: slice re-partition complete; hold released", name)
 
     def _under_maintenance(
@@ -384,10 +395,7 @@ class SliceRepartitionController:
 
     # ------------------------------------------------------------------
     def _log_once(self, key: tuple, msg: str, *args) -> None:
-        if key in self._logged:
-            return
-        self._logged.add(key)
-        log.info(msg, *args)
+        self._logged.log(log, key, msg, *args)
 
     def _record_event(
         self, etype: str, reason: str, message: str, dedup_extra: str = ""

@@ -51,6 +51,7 @@ from tpu_operator.kube.apply import (
 )
 from tpu_operator.kube.frozen import thaw
 from tpu_operator.kube.write_pipeline import BatchLane, WritePipeline
+from tpu_operator.obs import LogOnce, flight, trace
 
 log = logging.getLogger("tpu-operator.state")
 
@@ -273,8 +274,9 @@ class ClusterPolicyController:
         self.render_cache = RenderCache()
         # DaemonSets whose no-TPU skip was already logged this no-TPU
         # stretch (cleared when TPU nodes appear) — the skip used to
-        # logspam every pass on TPU-less clusters
-        self.no_tpu_skip_logged: Set[str] = set()
+        # logspam every pass on TPU-less clusters. One shared LogOnce
+        # implementation (obs/logonce.py) with the set surface intact.
+        self.no_tpu_skip_logged = LogOnce()
         # (node store version, sandbox flag) of the last clean labeling
         # pass — while it matches, the O(nodes) label scan is skipped
         self._label_world: Optional[Tuple[int, bool]] = None
@@ -577,22 +579,31 @@ class ClusterPolicyController:
         # provided, without its false conflicts against unrelated
         # writers. The conflict path recomputes from a live read.
         if to_write:
-            futs = [
-                (
-                    i,
-                    node,
-                    changes,
-                    self.label_lane.submit(
-                        ("Node", "", node["metadata"]["name"]),
-                        _label_apply_payload(
-                            node["metadata"]["name"], changes
+            # flight timeline: one aggregate event per writing pass (a
+            # per-node event at fleet scale would flush the ring), with
+            # a small sample of the touched nodes for the post-mortem
+            flight.record(
+                "labels.write",
+                nodes=len(to_write),
+                sample=[n["metadata"]["name"] for _, n, _ in to_write[:8]],
+            )
+            with trace.span("pass.label_writes", nodes=len(to_write)):
+                futs = [
+                    (
+                        i,
+                        node,
+                        changes,
+                        self.label_lane.submit(
+                            ("Node", "", node["metadata"]["name"]),
+                            _label_apply_payload(
+                                node["metadata"]["name"], changes
+                            ),
                         ),
-                    ),
-                )
-                for i, node, changes in to_write
-            ]
-            for i, node, changes, fut in futs:
-                results[i] = self._label_outcome(node, changes, fut)
+                    )
+                    for i, node, changes in to_write
+                ]
+                for i, node, changes, fut in futs:
+                    results[i] = self._label_outcome(node, changes, fut)
         self._nodes_cache = final_nodes = [
             n for n in results if n is not None
         ]
@@ -866,11 +877,13 @@ class ClusterPolicyController:
         per object — a per-control thread handoff here would cost more
         than the steady-state control does."""
         overall = State.READY
-        for control_name, obj in self.controls[state]:
-            fn = object_controls.CONTROLS[control_name]
-            status = fn(self, state, obj)
-            if status == State.NOT_READY:
-                overall = State.NOT_READY
+        with trace.span("state.step", state=state) as sp:
+            for control_name, obj in self.controls[state]:
+                fn = object_controls.CONTROLS[control_name]
+                status = fn(self, state, obj)
+                if status == State.NOT_READY:
+                    overall = State.NOT_READY
+            sp.set("status", overall)
         return overall
 
     def run_states(self, concurrent: Optional[bool] = None):
@@ -901,20 +914,33 @@ class ClusterPolicyController:
             except Exception as e:  # noqa: BLE001 - isolated per state
                 return e
 
-        for wave in state_waves(self.state_names):
-            if (
-                len(wave) == 1
-                or not concurrent
-                or self.writes.depth == 1
+        for wave_idx, wave in enumerate(state_waves(self.state_names)):
+            with trace.span(
+                "pass.wave", wave=wave_idx, states=len(wave),
+                concurrent=bool(
+                    concurrent and len(wave) > 1 and self.writes.depth > 1
+                ),
             ):
-                for state in wave:
-                    results[state] = run_catching(state)
-                continue
-            pool = self._ensure_state_pool()
-            for state, fut in [
-                (s, pool.submit(run_catching, s)) for s in wave
-            ]:
-                results[state] = fut.result()
+                if (
+                    len(wave) == 1
+                    or not concurrent
+                    or self.writes.depth == 1
+                ):
+                    for state in wave:
+                        results[state] = run_catching(state)
+                    continue
+                pool = self._ensure_state_pool()
+                futs = [(s, pool.submit(run_catching, s)) for s in wave]
+                # the barrier wait gets its OWN layer: the pooled state
+                # spans run on other threads (roots there), so without
+                # this the wave span's blocked-on-futures time would
+                # read as "pass" SELF time while the same milliseconds
+                # also count under "state" — the layer breakdown would
+                # misattribute exactly the concurrent passes it exists
+                # to explain
+                with trace.span("wait.states", states=len(wave)):
+                    for state, fut in futs:
+                        results[state] = fut.result()
         self.idx = len(self.state_names)
         return [(s, results[s]) for s in self.state_names]
 

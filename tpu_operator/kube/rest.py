@@ -25,8 +25,26 @@ from urllib.parse import quote, urlencode
 
 from tpu_operator.kube.client import Client, ConflictError, NotFoundError, Obj
 from tpu_operator.kube.retry import CircuitBreaker, RetryPolicy, WatchBackoff
+from tpu_operator.obs import flight, trace
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# installed by controllers/operator_metrics: observes each WRITE verb's
+# round-trip (ms, retries included) into the apiserver_write_rtt
+# histogram without kube/ importing upward
+on_write_rtt_ms = None
+
+_WRITE_VERBS = frozenset(("POST", "PUT", "PATCH", "DELETE", "APPLY"))
+
+
+def _plural_of(path: str) -> str:
+    """Resource plural from a discovery-rule path (trace attribute):
+    ``/api/v1/namespaces/ns/pods/name`` -> ``pods``."""
+    parts = path.split("?", 1)[0].strip("/").split("/")
+    i = 2 if parts[:1] == ["api"] else 3  # /apis/<group>/<version>/...
+    if len(parts) > i + 1 and parts[i] == "namespaces":
+        i += 2
+    return parts[i] if len(parts) > i else ""
 
 
 class TransientAPIError(RuntimeError):
@@ -242,6 +260,47 @@ class RestClient(Client):
         retry_429: bool = True,
         count_as: Optional[str] = None,
     ) -> Obj:
+        """Instrumented wrapper over ``_request_policied``: a
+        ``rest.request`` span (verb, plural, attempts, breaker state)
+        when tracing is on, and the write-RTT histogram observation
+        when metrics installed the hook. Both off — the common steady
+        state — is one extra frame and two branches."""
+        verb = count_as or method
+        observe = on_write_rtt_ms if verb in _WRITE_VERBS else None
+        if not trace.TRACER.enabled and observe is None:
+            return self._request_policied(
+                method, path, body, content_type, retry_429, verb,
+                trace.NOOP,
+            )
+        t0 = time.monotonic()
+        with trace.span(
+            "rest.request", verb=verb, plural=_plural_of(path)
+        ) as sp:
+            result = self._request_policied(
+                method, path, body, content_type, retry_429, verb, sp
+            )
+            # COMPLETED round-trips only: a failed call (and especially
+            # a microsecond breaker fast-fail) must not fill the
+            # alerting-grade RTT series with healthy-looking samples
+            # during the very outage it exists to catch — failures show
+            # up on the retry/breaker counters instead
+            if observe is not None:
+                try:
+                    observe(verb, (time.monotonic() - t0) * 1000.0)
+                except Exception:
+                    pass
+            return result
+
+    def _request_policied(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Obj],
+        content_type: str,
+        retry_429: bool,
+        verb: str,
+        sp,
+    ) -> Obj:
         """One API call under the fault-tolerance policy: per-verb
         bounded retries with jittered exponential backoff for transient
         failures (connection refused/reset, 429, 5xx) on reads AND
@@ -256,7 +315,6 @@ class RestClient(Client):
         wire but is the APPLY verb to the policy surface)."""
         policy = self.retry_policy
         breaker = self.breaker
-        verb = count_as or method
         attempts = policy.attempts_for(method)
         deadline = time.monotonic() + policy.budget_s
         last_err: Optional[Exception] = None
@@ -265,6 +323,7 @@ class RestClient(Client):
             # breaker first: an open breaker must fail fast, not after
             # sleeping a full backoff delay it was never going to use
             if not breaker.allow():
+                sp.set("breaker", "open")
                 raise CircuitOpenError(
                     f"{method} {path}: apiserver circuit open "
                     f"({breaker.stats()})"
@@ -281,6 +340,8 @@ class RestClient(Client):
             try:
                 result = self._request_once(method, path, body, content_type)
                 breaker.record_success()
+                if attempt:
+                    sp.set("retries", attempt)
                 return result
             except (NotFoundError, ConflictError):
                 breaker.record_success()  # the server answered
@@ -696,10 +757,24 @@ class RestClient(Client):
         # recovering apiserver in lockstep — the thundering herd the
         # jitter exists to break up
         backoff = WatchBackoff()
+        listed_once = False
         while not stop_event.is_set():
             try:
+                if listed_once:
+                    # every LIST after the first is a RE-list (410'd
+                    # history, disconnect, NotFound poll) — the watch-gap
+                    # event the flight recorder timelines. NEVER let a
+                    # recorder bug kill the watch loop.
+                    try:
+                        flight.record("watch.relist", watched=kind)
+                    except Exception:
+                        pass
                 if warm_rv is not None:
                     rv, warm_rv = warm_rv, None
+                    # the journal seed counts as the first list: when
+                    # this stream dies (e.g. a 410 history gap), the
+                    # re-list IS a watch-gap event worth timelining
+                    listed_once = True
                     self._watch_loop_streams(
                         api_version, kind, namespace, rv, deliver,
                         stop_event, timeout_s, known, on_progress,
@@ -710,6 +785,7 @@ class RestClient(Client):
                         "GET", _resource_path(api_version, kind, namespace)
                     )
                     backoff.reset()
+                    listed_once = True
                 except NotFoundError:
                     # the kind is not served (optional CRD not installed,
                     # e.g. ServiceMonitor without prometheus-operator, or

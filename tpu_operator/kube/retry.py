@@ -176,6 +176,19 @@ class CircuitBreaker:
             )
             self._open_until = now + cooldown
             self._consecutive = 0
+        # flight-recorder timeline: a trip is exactly the kind of rare
+        # causal event a post-mortem needs (outside the lock; the
+        # recorder takes its own)
+        try:
+            from tpu_operator.obs import flight
+
+            flight.record(
+                "breaker.trip",
+                trips_total=self.trips_total,
+                cooldown_s=round(cooldown, 3),
+            )
+        except Exception:  # pragma: no cover - recorder must never hurt
+            pass
 
     def stats(self) -> Dict[str, object]:
         with self._lock:

@@ -38,7 +38,14 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Deque, Dict, Hashable, List, Optional, Tuple
 
+from tpu_operator.obs import trace
+
 DEFAULT_DEPTH = 16
+
+# installed by controllers/operator_metrics (the on_conflict_retry
+# convention): observes each task's queue wait into the
+# write_pipeline_queue_wait histogram without kube/ importing upward
+on_queue_wait_ms: Optional[Callable[[float], None]] = None
 
 
 def default_depth() -> int:
@@ -181,14 +188,27 @@ class WritePipeline:
         self, fut: WriteFuture, fn, args, kwargs, submitted: float
     ) -> None:
         t0 = time.monotonic()
+        wait_s = max(0.0, t0 - submitted)
+        observe = on_queue_wait_ms
+        if observe is not None:
+            try:
+                observe(wait_s * 1000.0)
+            except Exception:
+                pass
         value, error = None, None
-        try:
-            value = fn(*args, **kwargs)
-        except BaseException as e:  # noqa: BLE001 - transported, not handled
-            error = e
+        with trace.span(
+            "write.execute",
+            key=str(fut.key),
+            queue_wait_ms=round(wait_s * 1000.0, 3),
+        ) as sp:
+            try:
+                value = fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 - transported, not handled
+                error = e
+                sp.set("error", type(e).__name__)
         elapsed = time.monotonic() - t0
         with self._lock:
-            self.queue_wait_s_total += max(0.0, t0 - submitted)
+            self.queue_wait_s_total += wait_s
             self.busy_s_total += elapsed
             self.completed_total += 1
             if error is not None:
@@ -436,7 +456,12 @@ class BatchLane:
             if not batch:
                 return  # queue empty; flag cleared under the cut lock
             try:
-                results = self.flush_fn([payload for _, payload, _ in batch])
+                with trace.span(
+                    "apply.batch_flush", lane=self.name, fill=len(batch)
+                ):
+                    results = self.flush_fn(
+                        [payload for _, payload, _ in batch]
+                    )
             except BaseException as e:  # noqa: BLE001 - fanned back per item
                 for _, _, fut in batch:
                     fut._finish(None, e)

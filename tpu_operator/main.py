@@ -84,6 +84,13 @@ def build_args(argv=None):
         "restarts so an unchanged world converges with zero writes and "
         "no re-list",
     )
+    p.add_argument(
+        "--trace-out",
+        default=os.environ.get("TPU_OPERATOR_TRACE_OUT") or None,
+        help="enable reconcile tracing (obs/trace.py) and write the "
+        "span buffer as Chrome trace-event JSON (Perfetto-loadable) to "
+        "this path on exit",
+    )
     p.add_argument("--log-level", default="INFO")
     p.add_argument(
         "--once",
@@ -259,6 +266,19 @@ def build_manager(
     mgr.register_debug_vars(
         "applyset", lambda: reconciler.ctrl.applyset.stats()
     )
+    # reconcile tracing: enabled flag, span totals, last pass's
+    # self-time-by-layer summary (obs/trace.py)
+    from tpu_operator.obs import flight as _flight
+    from tpu_operator.obs import trace as _trace
+
+    mgr.register_debug_vars("trace", _trace.TRACER.stats)
+    # flight recorder: ring occupancy + dump disposition (obs/flight.py)
+    mgr.register_debug_vars("flight", _flight.RECORDER.stats)
+    # allocation traffic: inactive placeholder until a churn harness
+    # (fleet_converge --alloc-churn, the soak) re-registers the live
+    # engine stats under the same key — the key itself is part of the
+    # stable /debug/vars schema
+    mgr.register_debug_vars("allocation", lambda: {"active": False})
     upgrade = UpgradeReconciler(client, namespace)
     mgr.add_reconciler(UPGRADE_KEY, lambda _key: upgrade.reconcile())
     return mgr, reconciler, upgrade
@@ -469,6 +489,23 @@ def main(argv=None) -> int:
     )
     log = logging.getLogger("tpu-operator")
 
+    trace_mod = None
+    if args.trace_out:
+        from tpu_operator.obs import trace as trace_mod
+
+        trace_mod.enable()
+        log.info("reconcile tracing enabled -> %s", args.trace_out)
+
+    def export_trace():
+        if trace_mod is not None:
+            try:
+                n = trace_mod.TRACER.export_chrome(args.trace_out)
+                log.info(
+                    "trace exported: %d span(s) -> %s", n, args.trace_out
+                )
+            except Exception:
+                log.exception("trace export failed")
+
     node_names = None
     if args.fake:
         client = make_fake_client()
@@ -559,6 +596,7 @@ def main(argv=None) -> int:
             log.info("single pass done: ready=%s", res.ready)
             return 0 if res.ready else 2
         finally:
+            export_trace()
             stop_grpc_rigs()
 
     wire_event_sources(mgr, client, namespace)
@@ -577,6 +615,7 @@ def main(argv=None) -> int:
     try:
         mgr.run_forever()
     finally:
+        export_trace()
         stop_grpc_rigs()
     return 0
 

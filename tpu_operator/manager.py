@@ -19,6 +19,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 from tpu_operator.kube.client import Client
+from tpu_operator.obs import flight
 
 log = logging.getLogger("tpu-operator.manager")
 
@@ -220,49 +221,11 @@ class _HealthHandler(BaseHTTPRequestHandler):
             import json
 
             m = self.manager
-            payload = {
-                "queue_len": len(m.queue) if m else 0,
-                "threads": threading.active_count(),
-                "reconcilers": sorted(m._reconcilers) if m else [],
-                "last_reconcile_ok": m._last_reconcile_ok if m else None,
-            }
-            if m:
-                # stall-watchdog disposition: what is in flight, for how
-                # long, and whether it breached the pass deadline
-                payload["watchdog"] = m.watchdog_stats()
-            fault = getattr(m.client if m else None, "fault_stats", None)
-            if callable(fault):
-                # retry/breaker counters (kube/retry.py): the apiserver
-                # fault-tolerance layer's disposition
-                try:
-                    payload["fault_tolerance"] = fault()
-                except Exception as e:  # noqa: BLE001
-                    payload["fault_tolerance"] = {"error": str(e)}
-            if hasattr(m.client, "cache_info"):
-                # per-kind informer store sizes; null = informer never
-                # synced (reads fall through live) — the staleness tell
-                payload["informer_cache"] = m.client.cache_info()
-            if hasattr(m.client, "drift_repairs_total"):
-                # watch events the resync pass had to repair — nonzero
-                # means a stream silently swallowed an event
-                payload["informer_drift_repairs"] = (
-                    m.client.drift_repairs_total()
-                )
-            if hasattr(m.client, "read_stats"):
-                # zero-copy read path counters: cache gets/lists served,
-                # cumulative list latency, indexed-list share, and how
-                # many reads paid an explicit copy
-                payload["informer_reads"] = m.client.read_stats()
-            for var_name, fn in (m._debug_vars if m else {}).items():
-                # registered providers (e.g. the reconciler's per-pass
-                # snapshot hit rates); a broken provider must not take
-                # down the whole debug surface
-                try:
-                    value = fn()
-                    json.dumps(value)  # unserializable == broken provider
-                    payload[var_name] = value
-                except Exception as e:  # noqa: BLE001
-                    payload[var_name] = {"error": str(e)}
+            payload = (
+                m.debug_vars_payload()
+                if m
+                else {"queue_len": 0, "threads": threading.active_count()}
+            )
             body = json.dumps(payload)
             self._respond(200, body, "application/json")
             return
@@ -341,6 +304,10 @@ class Manager:
         # persists the freshest world-state
         self._stop_hooks = []
         self._stop_hooks_ran = False
+        # stall-watchdog flight dumps fired (the monitor thread dumps
+        # the recorder once per stall EPISODE, not per poll)
+        self._stall_dumps = 0
+        self._metrics_httpd = None
 
     def add_reconciler(self, key: str, fn: Callable[[str], object]) -> None:
         """``fn(name) -> Result`` (with optional ``requeue_after``)."""
@@ -386,7 +353,58 @@ class Manager:
                 since is not None and now - since > self.pass_deadline_s
             ),
             "last_progress_age_s": round(now - self._last_progress, 3),
+            "stall_dumps": self._stall_dumps,
         }
+
+    def debug_vars_payload(self) -> dict:
+        """The full /debug/vars payload (factored out of the HTTP
+        handler so tests can pin the key-set schema — a refactor
+        silently dropping a diagnostic surface fails tier-1)."""
+        import json
+
+        payload = {
+            "queue_len": len(self.queue),
+            "threads": threading.active_count(),
+            "reconcilers": sorted(self._reconcilers),
+            "last_reconcile_ok": self._last_reconcile_ok,
+            # stall-watchdog disposition: what is in flight, for how
+            # long, and whether it breached the pass deadline
+            "watchdog": self.watchdog_stats(),
+        }
+        fault = getattr(self.client, "fault_stats", None)
+        if callable(fault):
+            # retry/breaker counters (kube/retry.py): the apiserver
+            # fault-tolerance layer's disposition
+            try:
+                payload["fault_tolerance"] = fault()
+            except Exception as e:  # noqa: BLE001
+                payload["fault_tolerance"] = {"error": str(e)}
+        if hasattr(self.client, "cache_info"):
+            # per-kind informer store sizes; null = informer never
+            # synced (reads fall through live) — the staleness tell
+            payload["informer_cache"] = self.client.cache_info()
+        if hasattr(self.client, "drift_repairs_total"):
+            # watch events the resync pass had to repair — nonzero
+            # means a stream silently swallowed an event
+            payload["informer_drift_repairs"] = (
+                self.client.drift_repairs_total()
+            )
+        if hasattr(self.client, "read_stats"):
+            # zero-copy read path counters: cache gets/lists served,
+            # cumulative list latency, indexed-list share, and how
+            # many reads paid an explicit copy
+            payload["informer_reads"] = self.client.read_stats()
+        for var_name, fn in self._debug_vars.items():
+            # registered providers (e.g. the reconciler's per-pass
+            # snapshot hit rates); a broken provider must not take
+            # down the whole debug surface
+            try:
+                value = fn()
+                json.dumps(value)  # unserializable == broken provider
+                payload[var_name] = value
+            except Exception as e:  # noqa: BLE001
+                payload[var_name] = {"error": str(e)}
+        return payload
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -394,9 +412,48 @@ class Manager:
             try:
                 from prometheus_client import start_http_server
 
-                start_http_server(self.metrics_port)
+                # newer prometheus_client returns (httpd, thread); keep
+                # the handle so stop() can release the port
+                started = start_http_server(self.metrics_port)
+                if isinstance(started, tuple) and started:
+                    self._metrics_httpd = started[0]
             except Exception:
                 log.exception("metrics server failed to start")
+        # stall-watchdog monitor: /healthz flipping is passive (it needs
+        # a probe to ask) — this thread actively notices the flip and
+        # dumps the flight recorder ONCE per stall episode, so the
+        # post-mortem timeline exists even when the kubelet restart
+        # destroys the process moments later
+        def _watchdog_monitor():
+            tripped = False
+            interval = min(5.0, max(0.2, self.pass_deadline_s / 10.0))
+            while not self._stop.is_set():
+                stalled = self.stalled()
+                if stalled and not tripped:
+                    tripped = True
+                    self._stall_dumps += 1
+                    flight.record(
+                        "watchdog.stall",
+                        inflight=self._inflight_item,
+                        deadline_s=self.pass_deadline_s,
+                    )
+                    flight.RECORDER.dump(
+                        "watchdog-stall",
+                        detail=(
+                            f"reconcile {self._inflight_item!r} in flight "
+                            f"> {self.pass_deadline_s}s"
+                        ),
+                        extra=self.watchdog_stats(),
+                    )
+                elif not stalled:
+                    tripped = False
+                self._stop.wait(interval)
+
+        t = threading.Thread(
+            target=_watchdog_monitor, name="watchdog", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
         if self.probe_port:
             handler = type("H", (_HealthHandler,), {"manager": self})
             server = ThreadingHTTPServer(("0.0.0.0", self.probe_port), handler)
@@ -474,6 +531,15 @@ class Manager:
                 self.client.stop()
             except Exception:
                 log.exception("cache stop failed")
+        if self._metrics_httpd is not None:
+            try:
+                self._metrics_httpd.shutdown()
+                # shutdown() only ends serve_forever; the listening
+                # socket stays bound until server_close()
+                self._metrics_httpd.server_close()
+            except Exception:
+                log.debug("metrics server shutdown failed", exc_info=True)
+            self._metrics_httpd = None
 
     def run_forever(self) -> None:
         self.start()

@@ -1,0 +1,25 @@
+"""Observability subsystem: reconcile tracing + flight recorder.
+
+Two always-importable, dependency-free primitives threaded through the
+hot path:
+
+* ``trace`` — context-propagated spans (``trace.span("layer.op", k=v)``)
+  with a disabled-mode cost of ONE branch, a bounded completed-span
+  ring, Chrome trace-event export (Perfetto-loadable) and a per-pass
+  self-time-by-layer summary for ``/debug/vars "trace"``;
+* ``flight`` — an always-on bounded ring of structured events (label
+  writes, budget admissions, breaker trips, watch re-lists, FSM
+  transitions) plus the recent spans, dumped to a timestamped JSON
+  file when the stall watchdog trips, a state goes Degraded, or the
+  chaos-soak invariant checker flags a violation;
+* ``logonce`` — the one pruned-on-liveness log-once registry shared by
+  remediation, repartition and the no-TPU DaemonSet skip.
+
+This package imports NOTHING from the rest of ``tpu_operator`` so every
+layer (``kube/``, ``controllers/``, ``schedsim/``, ``chaos/``) may
+instrument through it without cycles.
+"""
+
+from tpu_operator.obs import flight, trace  # noqa: F401  (wires span sink)
+from tpu_operator.obs.logonce import LogOnce  # noqa: F401
+from tpu_operator.obs.trace import instant, span  # noqa: F401

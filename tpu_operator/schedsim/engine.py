@@ -31,6 +31,7 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from tpu_operator import consts
+from tpu_operator.obs import flight, trace
 from tpu_operator.kube.kubelet_sim import (
     InProcessPluginStub,
     PodGoneError,
@@ -72,19 +73,28 @@ class SyntheticChipServicer(TPUDevicePluginServicer):
 
 
 class LatencyRecorder:
-    """Bounded latency sample sink with percentile readout."""
+    """Bounded latency sample sink with percentile readout. An
+    optional ``observer`` (the alloc-latency Prometheus histogram's
+    ``observe``) sees every sample as it lands."""
 
-    def __init__(self, cap: int = 200_000):
+    def __init__(self, cap: int = 200_000, observer=None):
         self.cap = cap
         self._lock = threading.Lock()
         self._samples: List[float] = []
         self.count = 0
+        self.observer = observer
 
     def add(self, ms: float) -> None:
         with self._lock:
             self.count += 1  # under self._lock
             if len(self._samples) < self.cap:
                 self._samples.append(ms)
+        obs = self.observer
+        if obs is not None:
+            try:
+                obs(ms)
+            except Exception:
+                pass
 
     @staticmethod
     def _at(ordered: List[float], p: float) -> float:
@@ -283,7 +293,9 @@ class ChurnEngine:
         self.fragmentation_last_pct = 0.0
         self.fragmentation_max_pct = 0.0
 
-        self.alloc_latency = LatencyRecorder()
+        self.alloc_latency = LatencyRecorder(
+            observer=self._alloc_hist_observer()
+        )
         self.gang_ready_latency = LatencyRecorder()
 
         self._seq = itertools.count()
@@ -698,7 +710,10 @@ class ChurnEngine:
                         pass
                 t0 = time.perf_counter()
                 try:
-                    agent.allocate(size, pod)
+                    with trace.span(
+                        "alloc.allocate", node=node, size=size
+                    ):
+                        agent.allocate(size, pod)
                 except PodGoneError:
                     self._bump("cancelled_total")
                     return
@@ -736,6 +751,10 @@ class ChurnEngine:
             self._bump("failures_total")
             return
         placed: List[dict] = []
+        gang_span = trace.span(
+            "alloc.gang_admit", gang=gang_id, hosts=m
+        )
+        gang_span.__enter__()
         try:
             if self._stop.is_set():
                 return  # shutting down: don't admit into the drain
@@ -759,6 +778,10 @@ class ChurnEngine:
             if len(held) != m:
                 self._bump("invariant_violations")
                 self._bump("partial_gang_violations")
+                flight.record(
+                    "alloc.partial_gang", gang=gang_id, held=len(held),
+                    want=m,
+                )
                 raise AssertionError(
                     f"{gang_id}: {len(held)}/{m} members hold chips after "
                     f"admission ({held})"
@@ -777,6 +800,9 @@ class ChurnEngine:
             if self.registry.pods_of_gang(gang_id):
                 self._bump("invariant_violations")
                 self._bump("partial_gang_violations")
+                flight.record(
+                    "alloc.partial_gang", gang=gang_id, phase="rollback"
+                )
                 raise AssertionError(
                     f"{gang_id}: rollback left members holding chips"
                 )
@@ -785,9 +811,22 @@ class ChurnEngine:
             if not isinstance(e, (InsufficientChipsError, PodGoneError)):
                 raise  # unexpected: surface to the worker's counters
         finally:
+            gang_span.__exit__(None, None, None)
             self.coordinator.release(gang_id, nodes)
 
     # -- observability ----------------------------------------------------
+    def _alloc_hist_observer(self):
+        """The alloc-latency histogram's observe hook (no-op stub
+        without prometheus; None when metrics are unimportable)."""
+        try:
+            from tpu_operator.controllers.operator_metrics import (
+                OperatorMetrics,
+            )
+
+            return OperatorMetrics().alloc_latency_ms_hist.observe
+        except Exception:
+            return None
+
     def set_node_health(self, node: str, healthy: bool) -> None:
         """Flip every chip on one simulated host (the churn half of a
         chip-death injection — kubesim's ``kill_node_chips`` covers the

@@ -59,7 +59,17 @@ bundle:
 # pin behind and fail `validate bundle`'s head check
 release-bundle: bundle
 
+# project-native concurrency & contract analyzer (tpu_operator/analysis):
+# layering, guarded-by, lock-order, lock-blocking, frozen-view and
+# metrics-fed rules over the package + the e2e driver scripts, gated on
+# the committed baseline (analysis-baseline.json) — any NON-baselined
+# finding exits non-zero. Rule catalog + suppression syntax:
+# docs/analysis.md
+lint:
+	python -m tpu_operator.analysis
+
 validate:
+	$(MAKE) lint
 	python -m tpu_operator.cfg.main validate clusterpolicy --input config/samples/v1_clusterpolicy.yaml
 	python -m tpu_operator.cfg.main validate chart --dir deployments/tpu-operator
 	python -m tpu_operator.cfg.main validate csv --input bundle/manifests/tpu-operator.clusterserviceversion.yaml
@@ -131,15 +141,19 @@ obs-fast:
 # plus the node-remediation chaos matrix (chip death -> quarantine ->
 # recovery, flapping -> exhausted, systemic breaker) must converge —
 # fast enough for every PR, unlike the randomized soak
+# TPU_LOCKWATCH=1: both chaos gates run under the runtime lock-order
+# watchdog (analysis/lockwatch.py) — the session fails on any observed
+# lock-acquisition-order cycle across the write pipeline / batch lanes /
+# breaker / informer stack
 chaos-fast:
-	python -m pytest tests/test_fault_matrix.py tests/test_remediation_matrix.py -q -p no:cacheprovider
+	TPU_LOCKWATCH=1 python -m pytest tests/test_fault_matrix.py tests/test_remediation_matrix.py -q -p no:cacheprovider
 
 # CI lifecycle gate: short fixed-seed chaos soaks (joins, preemptions,
 # chip faults, apiserver faults, one live re-partition, schedsim churn)
 # with the invariant checker on, plus the seed-replay regression — the
 # same seed must reproduce the identical event schedule
 chaos-soak-fast:
-	python -m pytest tests/test_chaos_soak.py tests/test_lifecycle.py tests/test_repartition.py -q -m 'not slow' -p no:cacheprovider
+	TPU_LOCKWATCH=1 python -m pytest tests/test_chaos_soak.py tests/test_lifecycle.py tests/test_repartition.py -q -m 'not slow' -p no:cacheprovider
 
 # the 1000-node acceptance soak (slow; not part of validate)
 chaos-soak:

@@ -40,6 +40,46 @@ def fake_client():
     return FakeClient()
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _lockwatch_session():
+    """Runtime lock-order watchdog (analysis/lockwatch.py). Opt-in via
+    TPU_LOCKWATCH=1 — `make chaos-fast` / `chaos-soak-fast` set it —
+    because it wraps threading.Lock creation process-wide. The suite
+    FAILS if any lock-order cycle was observed; held-across-blocking
+    events are reported (strict mode: TPU_LOCKWATCH_STRICT=1 fails on
+    them too)."""
+    if os.environ.get("TPU_LOCKWATCH") != "1":
+        yield
+        return
+    from tpu_operator.analysis import lockwatch
+
+    lockwatch.enable()
+    yield
+    cycles = lockwatch.cycles()
+    blocking = [
+        v for v in lockwatch.violations() if v["type"] == "held-across-blocking"
+    ]
+    stats = lockwatch.stats()
+    lockwatch.disable()
+    if blocking:
+        import warnings
+
+        summary = "; ".join(
+            f"{v['call']} at {v['at']} holding {v['locks']}" for v in blocking[:5]
+        )
+        if os.environ.get("TPU_LOCKWATCH_STRICT") == "1":
+            pytest.fail(
+                f"lockwatch: {len(blocking)} held-across-blocking event(s): {summary}"
+            )
+        warnings.warn(
+            f"lockwatch: {len(blocking)} held-across-blocking event(s): {summary}"
+        )
+    assert not cycles, (
+        f"lockwatch: lock-order cycle(s) observed ({stats}): "
+        + "; ".join(" -> ".join(c["cycle"]) for c in cycles)
+    )
+
+
 def wait_until(pred, timeout_s=60.0, poll_s=0.1):
     """Shared polling helper for the kubesim wire e2es."""
     import time

@@ -72,7 +72,7 @@ def main() -> int:
     assert uids_before == uids_after, "operands churned on operator restart"
 
     print("=== update-clusterpolicy (disable metricsExporter)")
-    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy", copy=True)
     cp["spec"]["metricsExporter"]["enabled"] = False
     client.update(cp)
     converge()
@@ -80,7 +80,7 @@ def main() -> int:
     assert "tpu-metrics-exporter" not in ds_names, "exporter not deleted on disable"
 
     print("=== enable-operands (re-enable metricsExporter)")
-    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy", copy=True)
     cp["spec"]["metricsExporter"]["enabled"] = True
     client.update(cp)
     res = converge()

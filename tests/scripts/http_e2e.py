@@ -147,8 +147,8 @@ def main() -> int:
     print("ok: CR Ready; status written via the /status subresource")
 
     print("=== optimistic-concurrency (stale writer gets 409)")
-    a = client.get(CP, "ClusterPolicy", "cluster-policy")
-    b = client.get(CP, "ClusterPolicy", "cluster-policy")
+    a = client.get(CP, "ClusterPolicy", "cluster-policy", copy=True)
+    b = client.get(CP, "ClusterPolicy", "cluster-policy", copy=True)
     a["spec"]["metricsExporter"]["enabled"] = True
     client.update(a)
     b["spec"]["metricsExporter"]["enabled"] = False
@@ -159,13 +159,13 @@ def main() -> int:
         print("ok: stale ClusterPolicy update conflicted (409)")
 
     print("=== disable/enable operand")
-    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy", copy=True)
     cp["spec"]["metricsExporter"]["enabled"] = False
     client.update(cp)
     converge()
     ds_names = {d["metadata"]["name"] for d in client.list("apps/v1", "DaemonSet", NS)}
     assert "tpu-metrics-exporter" not in ds_names, sorted(ds_names)
-    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy", copy=True)
     cp["spec"]["metricsExporter"]["enabled"] = True
     client.update(cp)
     res = converge()
@@ -340,7 +340,7 @@ def main() -> int:
     print("ok: slice aggregate degraded → ready over the wire")
 
     print("=== sandbox workloads (vm-passthrough posture over the wire)")
-    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy", copy=True)
     cp["spec"]["sandboxWorkloads"] = {"enabled": True}
     client.update(cp)
     client.create(
@@ -366,7 +366,7 @@ def main() -> int:
         vm_labels.get(consts.DEPLOY_LABEL_PREFIX + consts.COMPONENT_LIBTPU)
         != "true"
     )
-    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy", copy=True)
     cp["spec"]["sandboxWorkloads"] = {"enabled": False}
     client.update(cp)
     client.delete("v1", "Node", "vm-host-1")
@@ -389,7 +389,7 @@ def main() -> int:
     print("=== host-maintenance handler (metadata window over the wire)")
     # enable the opt-in 18th state; the DS must appear and the node get
     # its deploy label
-    cp = client.get(CP, "ClusterPolicy", "cluster-policy")
+    cp = client.get(CP, "ClusterPolicy", "cluster-policy", copy=True)
     cp["spec"]["maintenanceHandler"] = {
         "enabled": True,
         "repository": "gcr.io/tpu-operator",

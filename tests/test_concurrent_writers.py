@@ -22,10 +22,25 @@ import pytest
 os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
 os.environ.setdefault("UNIT_TEST", "true")
 
+from tpu_operator.analysis import lockwatch
 from tpu_operator.kube import client as kube_client
 from tpu_operator.kube.client import FakeClient, mutate_with_retry
 from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
 from tpu_operator.kube.testing import make_tpu_node
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _lockwatch_module():
+    """This suite drives the genuinely multi-threaded write path, so it
+    always runs under the lock-order watchdog (not just when the chaos
+    targets export TPU_LOCKWATCH) and fails on any observed cycle."""
+    was_enabled = lockwatch.enabled()
+    lockwatch.enable()
+    yield
+    cycles = lockwatch.cycles()
+    if not was_enabled:
+        lockwatch.disable()
+    assert not cycles, "; ".join(" -> ".join(c["cycle"]) for c in cycles)
 
 
 @pytest.fixture()

@@ -1,0 +1,227 @@
+"""Runtime lock-order watchdog tests (analysis/lockwatch.py): a
+deliberately inverted two-lock acquisition is detected as a cycle (and
+flight-recorded), consistent ordering is not, RLock reentrancy and
+Condition interplay stay consistent, held-across-blocking events are
+caught, and enable/disable restores the process."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_operator.analysis import lockwatch
+from tpu_operator.obs import flight
+
+
+@pytest.fixture()
+def watch():
+    """Fresh graph around every test. The reset at teardown is REQUIRED
+    (these tests seed deliberate cycles that must not leak into a
+    session-level TPU_LOCKWATCH=1 assertion), but disable only if this
+    fixture did the enabling — a session watchdog must stay armed for
+    the rest of the suite."""
+    was_enabled = lockwatch.enabled()
+    lockwatch.reset()
+    lockwatch.enable()
+    yield lockwatch.WATCH
+    if not was_enabled:
+        lockwatch.disable()
+    lockwatch.reset()
+
+
+def _run_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(10)
+    assert not t.is_alive()
+
+
+def test_inverted_two_lock_acquisition_detected(watch, tmp_path):
+    flight.RECORDER.clear()  # reset the dump rate-limiter
+    flight.RECORDER.dir = str(tmp_path)
+    # separate lines: the graph keys locks by CREATION SITE
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def inverted():
+        with b:
+            with a:
+                pass
+
+    _run_thread(forward)
+    assert lockwatch.cycles() == []  # one order alone is fine
+    _run_thread(inverted)
+    cycles = lockwatch.cycles()
+    assert len(cycles) == 1
+    # the violation names both creation sites and was flight-recorded
+    assert len(set(cycles[0]["cycle"])) == 2
+    events = flight.RECORDER.snapshot()["events"]
+    assert any(e["kind"] == "lockwatch.cycle" for e in events)
+    assert flight.RECORDER.last_dump_path  # post-mortem dump landed
+
+
+def test_consistent_order_is_clean(watch):
+    a = threading.Lock()
+    b = threading.Lock()
+    for _ in range(3):
+        def ordered():
+            with a:
+                with b:
+                    pass
+        _run_thread(ordered)
+    assert lockwatch.cycles() == []
+    assert lockwatch.stats()["edges"] >= 1
+
+
+def test_rlock_reentrancy_no_false_edges(watch):
+    rl = threading.RLock()
+
+    def reenter():
+        with rl:
+            with rl:
+                with rl:
+                    pass
+
+    _run_thread(reenter)
+    assert lockwatch.cycles() == []
+    # reentrant acquisitions of one lock create no self-edges
+    assert all("->" not in k or k.split("->")[0] != k.split("->")[1]
+               for k in watch.edges())
+
+
+def test_condition_wait_keeps_held_set_consistent(watch):
+    """cond.wait() releases the underlying (watched) lock; another
+    thread acquiring more locks meanwhile must not fabricate edges from
+    the waiter's stale state — for both Lock- and RLock-backed
+    conditions."""
+    for factory in (threading.Lock, threading.RLock):
+        lk = factory()
+        cond = threading.Condition(lk)
+        other = threading.Lock()
+        released = threading.Event()
+
+        def waiter():
+            with cond:
+                released.set()
+                cond.wait(0.5)
+
+        def nudger():
+            released.wait(5)
+            with lk if factory is threading.Lock else cond:
+                with other:
+                    pass
+            with cond:
+                cond.notify_all()
+
+        t1 = threading.Thread(target=waiter)
+        t2 = threading.Thread(target=nudger)
+        t1.start()
+        t2.start()
+        t1.join(10)
+        t2.join(10)
+        assert not t1.is_alive() and not t2.is_alive()
+    assert lockwatch.cycles() == []
+
+
+def test_held_across_blocking_detected(watch):
+    lk = threading.Lock()
+
+    def sleepy():
+        with lk:
+            time.sleep(0.01)
+
+    _run_thread(sleepy)
+    blocking = [
+        v for v in lockwatch.violations()
+        if v["type"] == "held-across-blocking"
+    ]
+    assert len(blocking) == 1
+    assert "time.sleep" in blocking[0]["call"]
+    assert blocking[0]["locks"]  # names the held creation site
+
+    # unlocked sleep is not a violation
+    time.sleep(0.01)
+    assert len([
+        v for v in lockwatch.violations()
+        if v["type"] == "held-across-blocking"
+    ]) == 1
+
+
+def test_write_future_result_under_lock_detected(watch):
+    from tpu_operator.kube.write_pipeline import WritePipeline
+
+    pipe = WritePipeline(depth=2)
+    lk = threading.Lock()
+
+    def bad():
+        fut = pipe.submit("k", lambda: 42)
+        with lk:
+            assert fut.result(5) == 42
+
+    _run_thread(bad)
+    calls = [
+        v["call"] for v in lockwatch.violations()
+        if v["type"] == "held-across-blocking"
+    ]
+    assert "WriteFuture.result()" in calls
+
+    # the same call with no lock held is clean
+    before = len(calls)
+    fut = pipe.submit("k2", lambda: 1)
+    assert fut.result(5) == 1
+    after = [
+        v for v in lockwatch.violations()
+        if v["type"] == "held-across-blocking"
+    ]
+    assert len(after) == before
+
+
+def test_enable_disable_restores_factories():
+    if lockwatch.enabled():
+        pytest.skip(
+            "session-level TPU_LOCKWATCH watchdog active: this test "
+            "exercises global enable/disable and must not disarm it"
+        )
+    lockwatch.reset()
+    real_lock, real_rlock, real_sleep = (
+        threading.Lock,
+        threading.RLock,
+        time.sleep,
+    )
+    lockwatch.enable()
+    assert threading.Lock is not real_lock
+    lockwatch.enable()  # idempotent
+    lockwatch.disable()
+    assert threading.Lock is real_lock
+    assert threading.RLock is real_rlock
+    assert time.sleep is real_sleep
+    lockwatch.disable()  # idempotent
+    # locks created while enabled keep working after disable
+    lockwatch.enable()
+    lk = threading.Lock()
+    lockwatch.disable()
+    with lk:
+        pass
+    assert not lk.locked()
+
+
+def test_pipeline_under_watch_end_to_end(watch):
+    """The real write pipeline (pool, per-key chains, drain) runs
+    correctly under instrumentation and produces no cycles."""
+    from tpu_operator.kube.write_pipeline import BatchLane, WritePipeline
+
+    pipe = WritePipeline(depth=4)
+    futs = [pipe.submit(i % 5, lambda x=i: x * 2) for i in range(50)]
+    lane = BatchLane(pipe, lambda items: [(i, None) for i in items], shards=2)
+    lane_futs = [lane.submit(f"k{i}", i) for i in range(30)]
+    pipe.drain(timeout=30)
+    assert [f.result(5) for f in futs] == [i * 2 for i in range(50)]
+    for f in lane_futs:
+        f.result(5)
+    assert lockwatch.cycles() == []
+    assert lockwatch.stats()["acquires"] > 0

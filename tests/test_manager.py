@@ -140,6 +140,47 @@ def test_leader_election_takeover_on_expiry():
     assert lease["spec"]["holderIdentity"] == "pod-b"
 
 
+def test_leader_election_renews_a_frozen_lease_view():
+    """Regression for the frozen-view finding the analyzer surfaced
+    (`[frozen-view] manager.py: calls .update() on zero-copy informer
+    view 'spec'`): when `get_or_none` serves a FROZEN informer view —
+    the cached client's zero-copy read path — try_acquire must thaw
+    before its read-modify-write instead of dying on FrozenObjectError
+    and silently failing every renewal (the elector treats exceptions
+    as 'not acquired', so the bug read as a permanently lost lease)."""
+    from tpu_operator.kube.frozen import freeze
+
+    client = FakeClient()
+    a = LeaderElector(client, NS, identity="pod-a", lease_seconds=30)
+    assert a.try_acquire()
+
+    class FrozenReadClient:
+        """get_or_none returns frozen views, like CachedClient."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.updated = None
+
+        def get_or_none(self, api_version, kind, name, namespace=""):
+            obj = self._inner.get_or_none(api_version, kind, name, namespace)
+            return freeze(obj) if obj is not None else None
+
+        def create(self, obj):
+            return self._inner.create(obj)
+
+        def update(self, obj):
+            self.updated = obj
+            return self._inner.update(obj)
+
+    frozen_client = FrozenReadClient(client)
+    renewer = LeaderElector(frozen_client, NS, identity="pod-a")
+    assert renewer.try_acquire(), "renewal against a frozen view failed"
+    assert frozen_client.updated is not None
+    # the write carried a fresh renewTime, and it went through update()
+    # with a plain mutable object (no frozen types leak into the write)
+    assert frozen_client.updated["spec"]["holderIdentity"] == "pod-a"
+
+
 def test_manager_stops_on_lost_leadership():
     client = FakeClient()
     mgr = Manager(

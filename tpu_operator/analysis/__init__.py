@@ -1,0 +1,39 @@
+"""Project-native concurrency & contract analyzer.
+
+The operator is a deeply concurrent system (~35 locks across the write
+pipeline, batch lanes, gang coordinator, breaker, informer caches) whose
+structural contracts — layering ("obs/ imports nothing", "kube/ never
+imports upward"), the frozen-view read discipline, guarded-by locking,
+lock ordering — were previously enforced only by docs and hammer tests.
+This package is the machine check, in two halves:
+
+* **static** (``python -m tpu_operator.analysis`` / ``make lint``): a
+  dependency-free AST rule engine (``engine.py``) running the rule
+  catalog under ``rules/`` over ``tpu_operator/`` + ``tests/scripts/``,
+  with deterministic ``path:line: [rule] message`` findings, per-line
+  suppression comments (``# lint: ignore[rule-id]``), and a committed
+  baseline (``analysis-baseline.json``) so the gate bites only on NEW
+  findings;
+* **dynamic** (``lockwatch.py``): an opt-in runtime watchdog that wraps
+  ``threading.Lock``/``RLock`` creation, records the per-thread lock
+  acquisition-order graph plus held-across-blocking events, detects
+  order cycles that static nesting cannot see (acquisitions that nest
+  across call boundaries and threads), and flight-records violations
+  through ``obs/flight.py``. The chaos suites run under it
+  (``TPU_LOCKWATCH=1``) and fail on any cycle.
+
+Rule catalog, suppression/baseline syntax and the contract each rule
+encodes: ``docs/analysis.md``. Configuration: ``[tool.tpu_analysis]``
+in ``pyproject.toml``.
+
+Layering note: this package sits OUTSIDE the runtime stack — nothing in
+``tpu_operator`` imports it; the static half imports only the stdlib,
+and ``lockwatch`` additionally uses ``obs/`` (which imports nothing).
+"""
+
+from tpu_operator.analysis.engine import (  # noqa: F401
+    Finding,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)

@@ -179,6 +179,33 @@ DEVICE_PLUGIN_CONFIG_LABEL = f"{GROUP}/device-plugin.config"
 
 # upgrade FSM label (reference nvidia.com/gpu-driver-upgrade-state)
 UPGRADE_STATE_LABEL = f"{GROUP}/libtpu-upgrade-state"
+# the label's VALUES (reference upgrade consts.go:33-58). These are
+# node-label wire protocol, not FSM internals: the disruption budget
+# (kube/disruption.py) and the upgrade FSM (upgrade/upgrade_state.py)
+# both read them, and kube/ may not import upward — so the canonical
+# strings live here beside the label key; upgrade_state aliases them.
+UPGRADE_STATE_UNKNOWN = ""
+UPGRADE_STATE_UPGRADE_REQUIRED = "upgrade-required"
+UPGRADE_STATE_CORDON_REQUIRED = "cordon-required"
+UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED = "wait-for-jobs-required"
+UPGRADE_STATE_POD_DELETION_REQUIRED = "pod-deletion-required"
+UPGRADE_STATE_DRAIN_REQUIRED = "drain-required"
+UPGRADE_STATE_POD_RESTART_REQUIRED = "pod-restart-required"
+UPGRADE_STATE_VALIDATION_REQUIRED = "validation-required"
+UPGRADE_STATE_UNCORDON_REQUIRED = "uncordon-required"
+UPGRADE_STATE_DONE = "upgrade-done"
+UPGRADE_STATE_FAILED = "upgrade-failed"
+# states that hold a node DISRUPTED for the shared budget (between
+# cordon and uncordon, exclusive of the terminal done/failed pair)
+UPGRADE_ACTIVE_STATES = (
+    UPGRADE_STATE_CORDON_REQUIRED,
+    UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED,
+    UPGRADE_STATE_POD_DELETION_REQUIRED,
+    UPGRADE_STATE_DRAIN_REQUIRED,
+    UPGRADE_STATE_POD_RESTART_REQUIRED,
+    UPGRADE_STATE_VALIDATION_REQUIRED,
+    UPGRADE_STATE_UNCORDON_REQUIRED,
+)
 # bounded auto-retry of upgrade-failed nodes: {"count": N} JSON — a failed
 # node re-enters the FSM after a jittered exponential backoff instead of
 # permanently consuming maxUnavailable budget (clear UPGRADE_STATE_LABEL or

@@ -77,7 +77,7 @@ class ClusterSnapshot:
         return src() if callable(src) else src
 
     # -- nodes -----------------------------------------------------------
-    def _node_list(self) -> List[Obj]:
+    def _node_list_locked(self) -> List[Obj]:
         """Memoized node list WITHOUT touching the hit/miss counters —
         internal consumers (selector counting) record their own outcome,
         so one consumer read never counts twice."""
@@ -100,7 +100,7 @@ class ClusterSnapshot:
                 self.misses += 1
             else:
                 self.hits += 1
-            return self._node_list()
+            return self._node_list_locked()
 
     def set_nodes(self, nodes: List[Obj]) -> None:
         """Refresh the memoized node list after a writer changed node
@@ -128,7 +128,7 @@ class ClusterSnapshot:
                 return cached
             self.misses += 1
             count = 0
-            for node in self._node_list():
+            for node in self._node_list_locked():
                 labels = node.get("metadata", {}).get("labels", {}) or {}
                 if all(labels.get(k) == v for k, v in selector.items()):
                     count += 1

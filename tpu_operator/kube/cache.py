@@ -125,7 +125,7 @@ def pod_scope_filter(namespace: str) -> Callable[[Obj], bool]:
     label selector (vendor/.../upgrade/upgrade_state.go:160-212), this is
     the same idea expressed as a cache filter (controller-runtime
     ByObject selector)."""
-    from tpu_operator.upgrade.upgrade_state import pod_requests_tpu
+    from tpu_operator.kube.selector import pod_requests_tpu
 
     def keep(pod: Obj) -> bool:
         if pod.get("metadata", {}).get("namespace", "") == namespace:

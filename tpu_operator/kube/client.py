@@ -698,7 +698,7 @@ class FakeClient(Client):
         return match_fields(obj, selector)
 
     # -- writes ---------------------------------------------------------
-    def _stamp(self, obj: Obj) -> None:
+    def _stamp_locked(self, obj: Obj) -> None:
         self._rv += 1
         meta = obj.setdefault("metadata", {})
         meta["resourceVersion"] = str(self._rv)
@@ -719,7 +719,7 @@ class FakeClient(Client):
             if key in self._store:
                 raise ConflictError(f"{key[1]} {key[2]}/{key[3]} already exists")
             stored = copy.deepcopy(obj)
-            self._stamp(stored)
+            self._stamp_locked(stored)
             self._store[key] = stored
             self._notify("ADDED", stored)
             return copy.deepcopy(stored)
@@ -765,7 +765,7 @@ class FakeClient(Client):
             if existing["metadata"].get("uid"):
                 stored.setdefault("metadata", {})["uid"] = existing["metadata"]["uid"]
             self._reown(existing, stored)
-            self._stamp(stored)
+            self._stamp_locked(stored)
             self._store[key] = stored
             self._notify("MODIFIED", stored)
             return copy.deepcopy(stored)
@@ -779,7 +779,7 @@ class FakeClient(Client):
             existing = copy.deepcopy(before)
             existing["status"] = copy.deepcopy(obj.get("status", {}))
             self._reown(before, existing)
-            self._stamp(existing)
+            self._stamp_locked(existing)
             self._store[key] = existing
             self._notify("MODIFIED", existing)
             return copy.deepcopy(existing)
@@ -812,7 +812,7 @@ class FakeClient(Client):
                         f"{key[1]} {key[2]}/{key[3]} not found"
                     )
                 new = ssa.create_from_applied(obj, manager)
-                self._stamp(new)
+                self._stamp_locked(new)
                 self._store[key] = new
                 self._notify("ADDED", new)
                 return copy.deepcopy(new)
@@ -829,7 +829,7 @@ class FakeClient(Client):
                 )
             if not changed:
                 return copy.deepcopy(stored)
-            self._stamp(merged)
+            self._stamp_locked(merged)
             self._store[key] = merged
             self._notify("MODIFIED", merged)
             return copy.deepcopy(merged)
@@ -859,7 +859,7 @@ class FakeClient(Client):
             current = fresh.setdefault("metadata", {}).setdefault("labels", {})
             if apply_label_delta(current, labels or {}):
                 self._reown(stored, fresh)
-                self._stamp(fresh)
+                self._stamp_locked(fresh)
                 self._store[key] = fresh
                 self._notify("MODIFIED", fresh)
                 return copy.deepcopy(fresh)
@@ -870,7 +870,7 @@ class FakeClient(Client):
             key = (api_version, kind, namespace or "", name)
             if key not in self._store:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
-            self._delete_stored(key)
+            self._delete_stored_locked(key)
 
     def evict(self, name, namespace=""):
         """Eviction subresource with PDB enforcement — same arithmetic as
@@ -894,9 +894,9 @@ class FakeClient(Client):
             blocked = eviction_blocked_by(pod, pods, pdbs)
             if blocked is not None:
                 raise EvictionBlockedError(blocked[1])
-            self._delete_stored(key)
+            self._delete_stored_locked(key)
 
-    def _delete_stored(self, key) -> None:
+    def _delete_stored_locked(self, key) -> None:
         """Remove + notify with deletion-rv semantics, then cascade GC —
         the single deletion path, in the SAME order as kubesim's
         (ownerRef cascade, then node-bound pod GC) so the two doubles
@@ -924,7 +924,7 @@ class FakeClient(Client):
                     for ref in o.get("metadata", {}).get("ownerReferences", [])
                 )
             ]:
-                self._delete_stored(k)
+                self._delete_stored_locked(k)
         # node-lifecycle/pod-GC behavior: deleting a Node removes pods
         # bound to it (stale DaemonSet pods on a dead node would
         # otherwise pin readiness NotReady forever)
@@ -935,7 +935,7 @@ class FakeClient(Client):
                 if k[1] == "Pod"
                 and o.get("spec", {}).get("nodeName") == name
             ]:
-                self._delete_stored(k)
+                self._delete_stored_locked(k)
 
     # -- test helpers ----------------------------------------------------
     def all_objects(self) -> List[Obj]:

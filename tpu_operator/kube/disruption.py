@@ -169,14 +169,13 @@ def disruption_owner(node: Obj) -> Optional[str]:
     interlock order: the upgrade FSM outranks remediation (remediation
     defers to it), which outranks a re-partition roll."""
     from tpu_operator import consts
-    from tpu_operator.upgrade.upgrade_state import (
-        ACTIVE_STATES,
-        STATE_FAILED,
-    )
 
     labels = node.get("metadata", {}).get("labels", {}) or {}
     ustate = labels.get(consts.UPGRADE_STATE_LABEL, "")
-    if ustate in ACTIVE_STATES or ustate == STATE_FAILED:
+    if (
+        ustate in consts.UPGRADE_ACTIVE_STATES
+        or ustate == consts.UPGRADE_STATE_FAILED
+    ):
         return OWNER_UPGRADE
     if (
         labels.get(consts.REMEDIATION_STATE_LABEL)

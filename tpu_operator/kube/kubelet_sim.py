@@ -1,6 +1,10 @@
 """Kubelet device-manager simulator — the kubelet's half of the
 DevicePlugin gRPC contract.
 
+# lint: ignore-file[layering] — deliberate inversion: the kubelet sim
+# IS the kubelet side of the device-plugin wire, so it speaks the
+# plugin's gRPC glue/proto directly; runtime kube/ code never does.
+
 The reference's plugin check reads node capacity the *real kubelet*
 produced from the *real plugin*'s advertisement
 (``/root/reference/validator/main.go:1083-1161``). Round 2 hand-seeded

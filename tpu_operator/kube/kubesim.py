@@ -118,7 +118,7 @@ class KubeSim:
         self.faults_injected = 0
         self.partition_rejects = 0
         # plural -> highest event rv compacted out of the log (the
-        # per-kind 410 horizon; see _emit)
+        # per-kind 410 horizon; see _emit_locked)
         self._compacted_rv_by_plural: Dict[str, int] = {}
         # server-side-apply accounting: field-ownership 409s answered
         # (the bench's apply_conflicts signal) and batch submissions
@@ -242,7 +242,7 @@ class KubeSim:
             fn(fresh)
             fresh["metadata"]["resourceVersion"] = self._bump()
             self._objs[key] = fresh
-            self._emit("MODIFIED", key, fresh)
+            self._emit_locked("MODIFIED", key, fresh)
             return copy.deepcopy(fresh)
 
     def set_node_chips(self, name: str, allocatable: int, capacity: Optional[int] = None) -> dict:
@@ -460,7 +460,7 @@ class KubeSim:
             cond = self._conds[plural] = threading.Condition(self._lock)
         return cond
 
-    def _emit(self, etype: str, key, obj: dict) -> None:
+    def _emit_locked(self, etype: str, key, obj: dict) -> None:
         # the log holds REFERENCES: every write path replaces stored
         # objects instead of mutating them (copy-on-write invariant), so
         # a logged revision can never change after the fact — the
@@ -501,7 +501,7 @@ class KubeSim:
                 if t < cutoff
             ]
             for key, obj in expired:
-                self._delete_stored(key, obj)
+                self._delete_stored_locked(key, obj)
         return len(expired)
 
     def compact_now(self) -> None:
@@ -530,7 +530,13 @@ class KubeSim:
         crd = self._cr_schemas.get(kind)
         if crd is None:
             return []
-        from tpu_operator.cfg.schema_validate import default_cr, validate_cr
+        # deliberate inversion: the SIM plays apiserver admission, and
+        # the structural-schema engine lives in cfg/ — a runtime kube/
+        # module would never reach upward like this
+        from tpu_operator.cfg.schema_validate import (  # lint: ignore[layering]
+            default_cr,
+            validate_cr,
+        )
 
         default_cr(crd, obj)
         problems = validate_cr(crd, obj)
@@ -585,7 +591,7 @@ class KubeSim:
                 self._register_crd(self._objs[key])
             if plural == "events":
                 self._event_touch[key] = time.monotonic()
-            self._emit("ADDED", key, self._objs[key])
+            self._emit_locked("ADDED", key, self._objs[key])
             # a store REFERENCE: the HTTP handler serializes it, and the
             # copy-on-write invariant keeps it immutable — callers must
             # copy before mutating
@@ -623,7 +629,7 @@ class KubeSim:
                 self._objs[key] = merged
                 if plural == "events":
                     self._event_touch[key] = time.monotonic()
-                self._emit("MODIFIED", key, self._objs[key])
+                self._emit_locked("MODIFIED", key, self._objs[key])
                 return 200, self._objs[key]  # reference (see create)
             if kind in STATUS_SUBRESOURCE_KINDS:
                 # a main-resource PUT cannot change status
@@ -676,7 +682,7 @@ class KubeSim:
             self._register_crd(self._objs[key])
         if plural == "events":
             self._event_touch[key] = time.monotonic()
-        self._emit("MODIFIED", key, self._objs[key])
+        self._emit_locked("MODIFIED", key, self._objs[key])
         return 200, self._objs[key]  # reference (see create)
 
     def patch(self, group, version, plural, namespace, name, body: dict):
@@ -848,15 +854,15 @@ class KubeSim:
             stored = self._objs.get(key)
             if stored is None:
                 return 404, _status(404, "NotFound", f"{plural} {name} not found")
-            # _delete_stored stamps the DELETION resourceVersion on the
+            # _delete_stored_locked stamps the DELETION resourceVersion on the
             # event (real apiserver semantics), cascades ownerRef GC, and
             # for Nodes removes bound pods (pod-GC / node-lifecycle
             # behavior — stale DaemonSet pods on dead nodes would pin
             # readiness NotReady forever, unlike any real cluster)
-            self._delete_stored(key, stored)
+            self._delete_stored_locked(key, stored)
             return 200, _status(200, "Success", f"{plural} {name} deleted")
 
-    def _delete_stored(self, key, obj: dict) -> None:
+    def _delete_stored_locked(self, key, obj: dict) -> None:
         """Remove + emit with deletion-rv semantics, then cascade GC —
         the single deletion path shared by delete/_gc/_gc_node_pods.
         No-op when the object is already gone (an earlier cascade step in
@@ -870,7 +876,7 @@ class KubeSim:
         # serialization, and a logged revision must never change
         obj = copy.deepcopy(obj)
         obj["metadata"]["resourceVersion"] = self._bump()
-        self._emit("DELETED", key, obj)
+        self._emit_locked("DELETED", key, obj)
         self._gc(obj["metadata"].get("uid"))
         if key[2] == "nodes":
             self._gc_node_pods(key[4])
@@ -888,7 +894,7 @@ class KubeSim:
             )
         ]
         for key, obj in dependents:
-            self._delete_stored(key, obj)
+            self._delete_stored_locked(key, obj)
 
     def _gc_node_pods(self, node_name: str) -> None:
         orphans = [
@@ -898,7 +904,7 @@ class KubeSim:
             and obj.get("spec", {}).get("nodeName") == node_name
         ]
         for key, obj in orphans:
-            self._delete_stored(key, obj)
+            self._delete_stored_locked(key, obj)
 
     def evict(self, group, version, namespace, name):
         """pods/{name}/eviction with PodDisruptionBudget enforcement: a
@@ -923,7 +929,7 @@ class KubeSim:
             blocked = eviction_blocked_by(pod, pods, pdbs)
             if blocked is not None:
                 return 429, _status(429, "TooManyRequests", blocked[1])
-            self._delete_stored(key, pod)
+            self._delete_stored_locked(key, pod)
             return 201, _status(201, "Success", f"pod {name} evicted")
 
     def get(self, group, version, plural, namespace, name):
@@ -962,7 +968,7 @@ class KubeSim:
         """Shared LIST body; ``items`` holds STORE REFERENCES (callers
         must copy or serialize, never mutate). Serialization/copy happens
         outside the lock — safe because EVERY write path (create/update/
-        patch/_mutate_stored/_delete_stored) REPLACES stored objects
+        patch/_mutate_stored/_delete_stored_locked) REPLACES stored objects
         copy-on-write instead of mutating them in place, so a reference
         always denotes one immutable revision."""
         kind, namespaced = PLURAL_TABLE[plural]
@@ -1054,7 +1060,11 @@ class KubeSim:
                     if self._events:
                         cursor = max(cursor, self._events[-1][0])
                     if not batch:
-                        cond.wait(0.2)
+                        # cond wraps self._lock (_cond_for), so this
+                        # wait RELEASES the lock — the one correct
+                        # under-lock wait; the resolver cannot see
+                        # through the local variable
+                        cond.wait(0.2)  # lint: ignore[lock-blocking]
             if gone:
                 yield "ERROR", _status(410, "Expired", "history compacted")
                 return

@@ -135,3 +135,23 @@ def encode_dict_selector(selector: Dict[str, Any]) -> Optional[str]:
         else:
             parts.append(f"{k}={v}")
     return ",".join(parts) if parts else None
+
+
+def pod_requests_tpu(pod: Dict[str, Any]) -> bool:
+    """Whether any container requests a TPU resource — reference
+    ``gpuPodSpecFilter`` (``main.go:161-183``) for ``google.com/tpu*``.
+    A pure pod-spec predicate shared by the informer scope filter
+    (kube/cache.py), the upgrade FSM's job-wait, and the libtpu
+    manager's pod sweeps; it lives at the kube layer because the cache
+    may not import upward into upgrade/."""
+    from tpu_operator import consts
+
+    for container in pod.get("spec", {}).get("containers", []) or []:
+        res = container.get("resources", {}) or {}
+        for bucket in ("limits", "requests"):
+            for key in (res.get(bucket) or {}):
+                if key == consts.TPU_RESOURCE or key.startswith(
+                    consts.TPU_SUBSLICE_RESOURCE_PREFIX
+                ):
+                    return True
+    return False

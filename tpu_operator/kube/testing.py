@@ -3,6 +3,10 @@
 Used by both the pytest suite and the operator's ``--fake`` dev mode, so
 the two can't drift (the kubelet simulation must handle hash-revision
 updates identically in both).
+
+# lint: ignore-file[layering] — test/dev scaffolding: the doubles
+# deliberately reach upward (sliceman verdicts, CRD generation) to stay
+# faithful to what the full stack writes; runtime kube/ code never does.
 """
 
 from __future__ import annotations

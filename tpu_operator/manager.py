@@ -19,6 +19,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 from tpu_operator.kube.client import Client
+from tpu_operator.kube.frozen import thaw
 from tpu_operator.obs import flight
 
 log = logging.getLogger("tpu-operator.manager")
@@ -186,6 +187,12 @@ class LeaderElector:
                 datetime.now(timezone.utc) - then
             ).total_seconds() > spec.get("leaseDurationSeconds", 30)
         if holder == self.identity or expired or not holder:
+            # the lease may be a zero-copy informer view (frozen);
+            # thaw before the read-modify-write or update() dies with
+            # FrozenObjectError the first time the Lease kind is served
+            # from the cache
+            lease = thaw(lease)
+            spec = lease.get("spec", {})
             spec.update({"holderIdentity": self.identity, "renewTime": now})
             lease["spec"] = spec
             try:

@@ -38,28 +38,23 @@ from tpu_operator.kube.client import (
 
 log = logging.getLogger("tpu-operator.upgrade")
 
-# FSM states (reference consts.go:33-58)
-STATE_UNKNOWN = ""
-STATE_UPGRADE_REQUIRED = "upgrade-required"
-STATE_CORDON_REQUIRED = "cordon-required"
-STATE_WAIT_FOR_JOBS_REQUIRED = "wait-for-jobs-required"
-STATE_POD_DELETION_REQUIRED = "pod-deletion-required"
-STATE_DRAIN_REQUIRED = "drain-required"
-STATE_POD_RESTART_REQUIRED = "pod-restart-required"
-STATE_VALIDATION_REQUIRED = "validation-required"
-STATE_UNCORDON_REQUIRED = "uncordon-required"
-STATE_DONE = "upgrade-done"
-STATE_FAILED = "upgrade-failed"
+# FSM states (reference consts.go:33-58). Canonical values live in
+# consts.py beside UPGRADE_STATE_LABEL — they are node-label wire
+# protocol the disruption budget (kube/) also reads; these aliases keep
+# the FSM's working vocabulary.
+STATE_UNKNOWN = consts.UPGRADE_STATE_UNKNOWN
+STATE_UPGRADE_REQUIRED = consts.UPGRADE_STATE_UPGRADE_REQUIRED
+STATE_CORDON_REQUIRED = consts.UPGRADE_STATE_CORDON_REQUIRED
+STATE_WAIT_FOR_JOBS_REQUIRED = consts.UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED
+STATE_POD_DELETION_REQUIRED = consts.UPGRADE_STATE_POD_DELETION_REQUIRED
+STATE_DRAIN_REQUIRED = consts.UPGRADE_STATE_DRAIN_REQUIRED
+STATE_POD_RESTART_REQUIRED = consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+STATE_VALIDATION_REQUIRED = consts.UPGRADE_STATE_VALIDATION_REQUIRED
+STATE_UNCORDON_REQUIRED = consts.UPGRADE_STATE_UNCORDON_REQUIRED
+STATE_DONE = consts.UPGRADE_STATE_DONE
+STATE_FAILED = consts.UPGRADE_STATE_FAILED
 
-ACTIVE_STATES = [
-    STATE_CORDON_REQUIRED,
-    STATE_WAIT_FOR_JOBS_REQUIRED,
-    STATE_POD_DELETION_REQUIRED,
-    STATE_DRAIN_REQUIRED,
-    STATE_POD_RESTART_REQUIRED,
-    STATE_VALIDATION_REQUIRED,
-    STATE_UNCORDON_REQUIRED,
-]
+ACTIVE_STATES = list(consts.UPGRADE_ACTIVE_STATES)
 
 
 @dataclass
@@ -432,18 +427,10 @@ class ValidationManager:
         return out
 
 
-def pod_requests_tpu(pod: Obj) -> bool:
-    """reference ``gpuPodSpecFilter`` (``main.go:161-183``) for
-    ``google.com/tpu*`` resources."""
-    for container in pod.get("spec", {}).get("containers", []) or []:
-        res = container.get("resources", {}) or {}
-        for bucket in ("limits", "requests"):
-            for key in (res.get(bucket) or {}):
-                if key == consts.TPU_RESOURCE or key.startswith(
-                    consts.TPU_SUBSLICE_RESOURCE_PREFIX
-                ):
-                    return True
-    return False
+# canonical definition moved to kube/selector.py (the informer scope
+# filter needs it and kube/ may not import upward); re-exported here
+# for the FSM's own use and existing importers
+from tpu_operator.kube.selector import pod_requests_tpu  # noqa: E402,F401
 
 
 def parse_max_unavailable(value, total: int) -> int:

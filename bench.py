@@ -476,6 +476,7 @@ def run_fleet_convergence(
     join_storm: int = 0,
     preempt_pct: float = 0.0,
     warm_restart: bool = False,
+    rollout: bool = False,
 ) -> dict:
     """Fleet-scale time-to-Ready: an ``n_nodes`` pool converged by the
     full Manager against the kubesim apiserver with a faithful per-node
@@ -499,6 +500,8 @@ def run_fleet_convergence(
         args += ["--preempt-pct", str(preempt_pct)]
     if warm_restart:
         args += ["--warm-restart"]
+    if rollout:
+        args += ["--rollout"]
     # the script applies --timeout PER PHASE (initial converge, join
     # storm, preemption recovery and warm restart each get their own
     # deadline), so the subprocess wall budget must cover every enabled
@@ -509,6 +512,7 @@ def run_fleet_convergence(
         + (1 if join_storm else 0)
         + (1 if preempt_pct else 0)
         + (1 if warm_restart else 0)
+        + (1 if rollout else 0)
     )
     wall_timeout_s = timeout_s * phases + 60
     try:
@@ -800,6 +804,13 @@ def main() -> int:
     fleet_join_storm = run_fleet_convergence(
         n_nodes=16, join_storm=1000, preempt_pct=10.0, timeout_s=600
     )
+    # staged-roll axis (ISSUE 12): a clean health-gated libtpu roll —
+    # canary -> wave -> fleet through the upgrade FSM under the shared
+    # disruption budget — across 1000 nodes; rollout_time_s is the
+    # tracked fleet-wide completion metric
+    fleet_rollout = run_fleet_convergence(
+        n_nodes=1000, timeout_s=600, rollout=True
+    )
 
     # ICI axis last: it re-binds JAX to the CPU mesh
     ici = run_ici_on_cpu_mesh()
@@ -846,6 +857,7 @@ def main() -> int:
         "fleet_populated_20k_pods": fleet_populated,
         "alloc_churn_1000": alloc_churn,
         "fleet_join_storm_1000": fleet_join_storm,
+        "fleet_rollout_1000": fleet_rollout,
         "validator_cli": validator_cli,
         "flashattn": {
             "ok": bool(fa.ok),
@@ -931,6 +943,7 @@ def main() -> int:
         and fleet_populated.get("ok")
         and alloc_churn.get("ok")
         and fleet_join_storm.get("ok")
+        and fleet_rollout.get("ok")
         and validator_cli.get("ok")
         and fa.ok
         and fa_gate_ok

@@ -140,6 +140,14 @@ def main(argv=None) -> int:
         "preempt_recover_s (orphaned state must reconcile)",
     )
     p.add_argument(
+        "--rollout",
+        action="store_true",
+        help="after convergence, stage a clean health-gated libtpu "
+        "version roll (canary -> wave -> fleet, spec.rollout) through "
+        "the upgrade FSM and report rollout_time_s / rollout_stages — "
+        "the fleet-wide staged-roll completion axis",
+    )
+    p.add_argument(
         "--trace-out",
         default=None,
         help="enable reconcile tracing (tpu_operator/obs/trace.py) for "
@@ -178,6 +186,28 @@ def main(argv=None) -> int:
     server.sim.add_nodes(len(nodes), names=nodes)
     if args.pods:
         _seed_bulk_pods(client, args.pods, args.pod_namespaces)
+    if args.rollout:
+        # the staged-roll axis: converge at a pinned base version, then
+        # flip the fleet target and measure canary->wave->fleet
+        # completion. Short observation windows — the axis measures the
+        # roll machinery, not the soak clock.
+        from tpu_operator.kube.testing import edit_clusterpolicy
+
+        def _stage_spec(cp):
+            cp["spec"]["libtpu"]["version"] = "1.0.0"
+            cp["spec"]["libtpu"]["upgradePolicy"] = {
+                "autoUpgrade": True,
+                "maxParallelUpgrades": 256,
+                "maxUnavailable": "25%",
+            }
+            cp["spec"]["rollout"] = {
+                "enabled": True,
+                "canary": 1,
+                "waves": ["10%"],
+                "observeSeconds": 1,
+            }
+
+        edit_clusterpolicy(client, _stage_spec)
 
     warm_path = None
     if args.warm_restart:
@@ -422,6 +452,53 @@ def main(argv=None) -> int:
             time.sleep(0.2)
         ok = ok and preempt_recover is not None
 
+    # -- staged-roll axis (health-gated rollout, ISSUE 12): flip the
+    # fleet target and drive the canary->wave->fleet roll to complete
+    rollout_time = None
+    rollout_stages = None
+    if ok and args.rollout:
+        from tpu_operator.controllers.rollout import (
+            STATE_COMPLETE,
+            load_record,
+        )
+        from tpu_operator.kube.testing import edit_clusterpolicy
+        from tpu_operator.main import UPGRADE_KEY
+
+        t_roll = time.monotonic()
+        edit_clusterpolicy(
+            client, lambda cp: cp["spec"]["libtpu"].update(version="2.0.0")
+        )
+        pump_halt = threading.Event()
+
+        def upgrade_pump():
+            while not pump_halt.is_set():
+                mgr.enqueue(UPGRADE_KEY)
+                pump_halt.wait(0.3)
+
+        threading.Thread(target=upgrade_pump, daemon=True).start()
+        deadline_r = time.monotonic() + args.timeout
+        while time.monotonic() < deadline_r:
+            cp = (
+                client.get_or_none(CPV, "ClusterPolicy", "cluster-policy")
+                or {}
+            )
+            rec_roll = load_record(cp)
+            if rec_roll and rec_roll.get("state") == STATE_COMPLETE:
+                labels = _labels_by_name()
+                if all(
+                    labels.get(n, {}).get(_c.TFD_LIBTPU_VERSION_LABEL)
+                    == "2.0.0"
+                    for n in nodes
+                ):
+                    rollout_time = round(time.monotonic() - t_roll, 2)
+                    rollout_stages = (
+                        reconciler.rollout.stats()["promotions_total"] + 1
+                    )
+                    break
+            time.sleep(0.25)
+        pump_halt.set()
+        ok = ok and rollout_time is not None
+
     converge_requests = server.sim.requests_total()
     # write-volume view of the same converge: how many mutations it
     # took and what each one cost in wall time — the number the write
@@ -607,6 +684,10 @@ def main(argv=None) -> int:
         "join_phase_latency": join_phases,
         "preempt_pct": args.preempt_pct,
         "preempt_recover_s": preempt_recover,
+        # staged-roll axis: wall time for a clean canary->wave->fleet
+        # libtpu roll through the health gate (None when not requested)
+        "rollout_time_s": rollout_time,
+        "rollout_stages": rollout_stages,
         "converge_requests": converge_requests,
         "converge_writes": converge_writes,
         # the server-side-apply engine's own ledger: how many APPLYs the

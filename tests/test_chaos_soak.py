@@ -96,6 +96,54 @@ def test_soak_fast_zero_invariant_violations(seed):
     assert report["alloc_drain"]["chips_held"] == 0
 
 
+def test_soak_bad_version_roll_rolls_back_to_old_version():
+    """ISSUE 12 acceptance (fast tier): a seeded bad libtpu version is
+    injected mid-run and the fleet target flipped to it while joins,
+    preemptions, chip faults and churn are in flight. The health-gated
+    canary cohort must report the degraded validator TFLOPS, the
+    orchestrator must roll back automatically, and the soak must settle
+    with EVERY node on the old version, zero slices lost, zero
+    disruption-budget or allocation invariant violations, and a
+    flight-recorder dump naming the failing canary evidence."""
+    report = SoakRunner(
+        nodes=12,
+        slice_pairs=2,
+        seed=5,
+        duration_s=8.0,
+        bad_version_roll=True,
+        settle_timeout_s=180.0,
+    ).run()
+    assert report["converged_before_chaos"], report
+    assert report["events_executed"] == len(report["trace"]["events"])
+    kinds = {e["kind"] for e in report["trace"]["events"]}
+    assert {"bad_version", "libtpu_roll"} <= kinds
+    # the fleet settled: every node back on the OLD version with zero
+    # invariant violations (the settle predicate itself asserts the
+    # per-node version labels and idle upgrade FSMs)
+    assert report["settled"], report.get(
+        "settle_blockers", report.get("violations")
+    )
+    assert report["violations"] == [], report["violations"]
+    assert report["ok"], {
+        k: v for k, v in report.items() if k not in ("trace", "alloc")
+    }
+    # the rollback actually happened and is on the durable ledger
+    record = report.get("rollout_record")
+    assert record and record["state"] == "rolled-back", record
+    assert record["evidence"], record
+    assert report["rollout"]["rollbacks_total"] >= 1, report["rollout"]
+    # zero wave-2 admissions: every admitted node sits inside ONE slice
+    # cohort (the canary — 1 slice = at most 2 member hosts)
+    assert len(report.get("rollout_nodes_admitted", [])) <= 2, report[
+        "rollout_nodes_admitted"
+    ]
+    # the pause/rollback decision left a post-mortem dump naming the
+    # failing canary evidence
+    assert any(
+        "rollout-rollback" in p for p in report["flight_dumps"]
+    ), report["flight_dumps"]
+
+
 @pytest.mark.slow
 def test_soak_1000_nodes():
     """The acceptance soak: a 1000-node fleet (200 hosts in 2-host

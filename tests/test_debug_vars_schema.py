@@ -34,6 +34,7 @@ REQUIRED_KEYS = {
     # fleet FSMs
     "remediation",
     "repartition",
+    "rollout",
     # allocation traffic (placeholder until a churn harness registers
     # the live engine under the same key)
     "allocation",

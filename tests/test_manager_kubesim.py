@@ -384,13 +384,19 @@ def test_steady_state_reconcile_is_cache_served(cluster):
     try:
         assert cached.start_informers(stop, timeout_s=30)
 
-        # converge by pumping the reconciler directly (deterministic)
+        # converge by pumping the reconciler directly (deterministic).
+        # The short inter-round wait lets the watch streams deliver the
+        # kubelet's writes into the informer cache: without it, 60
+        # no-sleep rounds can burn through in under the one watch RTT
+        # the cache is behind, and the loop reads the same stale world
+        # sixty times (observed flaking on a loaded box).
         res = None
         for _ in range(60):
             res = mgr._reconcilers["clusterpolicy"]("clusterpolicy")
             simulate_kubelet_once(client, NS, node_name="tpu-node-1")
             if res.ready:
                 break
+            time.sleep(0.1)
         assert res is not None and res.ready
 
         # let the watches drain the kubelet's writes, then absorb any

@@ -1,0 +1,559 @@
+"""Health-gated progressive rollout matrix (``controllers/rollout.py``):
+canary → wave → fleet staging of a libtpu version roll with automatic
+rollback on failing canary evidence.
+
+The full-loop tests drive the REAL pair of reconcilers (ClusterPolicy +
+Upgrade) over a FakeClient fleet with the faithful-OnDelete kubelet sim
+— the same loop the kubesim e2es run, minus the wire — so admission
+gating, the rollback override, and the durable annotations are exercised
+end to end:
+
+* a clean roll promotes through every wave to ``complete``;
+* a canary whose new version tanks validator TFLOPS rolls back
+  automatically with ZERO wave-2 admissions (witnessed by the
+  per-node rollback annotations the FSM writes at admission);
+* rollback re-rolls respect the shared three-consumer disruption budget
+  with remediation active;
+* a restarted operator (fresh reconciler instances) resumes a rollback
+  from the persisted ledger + node annotations.
+"""
+
+import json
+import os
+
+import yaml
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+from tests.conftest import make_tpu_node
+from tpu_operator import consts
+from tpu_operator.api.v1.clusterpolicy_types import RolloutSpec
+from tpu_operator.controllers import rollout as ro
+from tpu_operator.controllers.clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+)
+from tpu_operator.kube import FakeClient
+from tpu_operator.kube.testing import (
+    clear_bad_versions,
+    inject_bad_version,
+    sample_clusterpolicy_path,
+    simulate_kubelet_nodes,
+)
+from tpu_operator.obs import flight
+from tpu_operator.upgrade.upgrade_controller import UpgradeReconciler
+
+NS = "tpu-operator"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ASSETS = os.path.join(REPO, "assets")
+
+SLICE_ID = "ro-slice-a"
+SLICE_NODES = ("ro-1", "ro-2")
+SOLO_NODES = ("ro-3", "ro-4", "ro-5")
+NODES = SLICE_NODES + SOLO_NODES  # 4 slice units
+SLICE_UNITS = (SLICE_ID,) + SOLO_NODES
+
+V_OLD = "1.0.0"
+V_NEW = "2.0.0"
+
+ROLLOUT_SPEC = {
+    "enabled": True,
+    "canary": 1,
+    "waves": ["50%"],
+    "observeSeconds": 0,
+}
+
+
+def tpu_node(name, extra=None):
+    node = make_tpu_node(name, extra_labels=extra)
+    node["status"]["capacity"][consts.TPU_RESOURCE] = "8"
+    node["status"]["allocatable"][consts.TPU_RESOURCE] = "8"
+    return node
+
+
+def build_rig(rollout=ROLLOUT_SPEC, max_unavailable="50%", remediation=None):
+    client = FakeClient(
+        [{"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}]
+    )
+    slice_extra = {
+        consts.TFD_SLICE_ID_LABEL: SLICE_ID,
+        consts.TFD_SLICE_HOSTS_LABEL: str(len(SLICE_NODES)),
+    }
+    for name in SLICE_NODES:
+        client.create(tpu_node(name, slice_extra))
+    for name in SOLO_NODES:
+        client.create(tpu_node(name))
+    with open(sample_clusterpolicy_path()) as f:
+        cr = yaml.safe_load(f)
+    cr["metadata"]["uid"] = "ro-uid"
+    cr["spec"]["libtpu"]["version"] = V_OLD
+    cr["spec"]["libtpu"]["upgradePolicy"] = {
+        "autoUpgrade": True,
+        "maxParallelUpgrades": 4,
+        "maxUnavailable": max_unavailable,
+        "drain": {"enable": True, "timeoutSeconds": 300},
+    }
+    cr["spec"]["rollout"] = dict(rollout)
+    if remediation:
+        cr["spec"]["remediation"] = dict(remediation)
+    client.create(cr)
+    rec = ClusterPolicyReconciler(client, assets_dir=ASSETS)
+    upg = UpgradeReconciler(client, NS)
+    return client, rec, upg
+
+
+def pump(client, rec, upg, rounds=1, each=None):
+    """One operator 'tick': CP pass (render + rollout orchestration),
+    kubelet sweep (pods + version/perf stamping), upgrade FSM pass."""
+    for _ in range(rounds):
+        rec.reconcile()
+        simulate_kubelet_nodes(client, NS, list(NODES))
+        upg.reconcile()
+        if each is not None:
+            each()
+
+
+def node_labels(client, name):
+    return client.get("v1", "Node", name)["metadata"].get("labels") or {}
+
+
+def node_ann(client, name):
+    return client.get("v1", "Node", name)["metadata"].get("annotations") or {}
+
+
+def versions(client):
+    return {n: node_labels(client, n).get(consts.TFD_LIBTPU_VERSION_LABEL) for n in NODES}
+
+
+def ledger(client):
+    cp = client.get(consts.API_VERSION, "ClusterPolicy", "cluster-policy")
+    return ro.load_record(cp)
+
+
+def flip_version(client, version):
+    from tpu_operator.kube.testing import edit_clusterpolicy
+
+    edit_clusterpolicy(
+        client, lambda cp: cp["spec"]["libtpu"].update(version=version)
+    )
+
+
+def converge(client, rec, upg, rounds=8):
+    pump(client, rec, upg, rounds=rounds)
+    assert all(v == V_OLD for v in versions(client).values()), versions(client)
+
+
+def canary_members(target=V_NEW, spec=None):
+    """The deterministic canary cohort the orchestrator will pick."""
+    stages = ro.cohort_stages(
+        SLICE_UNITS, target, spec or RolloutSpec.from_dict(ROLLOUT_SPEC)
+    )
+    sid = stages[0][0]
+    return stages, (SLICE_NODES if sid == SLICE_ID else (sid,))
+
+
+# ---------------------------------------------------------------------------
+# pure units
+# ---------------------------------------------------------------------------
+
+
+def test_cohorts_are_deterministic_and_cover_every_slice():
+    spec = RolloutSpec.from_dict(
+        {"enabled": True, "canary": 2, "waves": ["25%", "50%"]}
+    )
+    sids = [f"s{i}" for i in range(17)]
+    a = ro.cohort_stages(sids, "v9", spec)
+    assert a == ro.cohort_stages(list(reversed(sids)), "v9", spec)
+    flat = [s for stage in a for s in stage]
+    assert sorted(flat) == sorted(sids)  # exact cover, no repeats
+    assert len(a[0]) == 2
+    # a different target draws a different canary (content-addressed)
+    b = ro.cohort_stages(sids, "v10", spec)
+    assert a != b or a[0] != b[0]
+
+
+def test_planned_stages_pin_begun_cohorts_against_mid_roll_joins():
+    """Once a stage starts admitting, its membership is pinned in the
+    ledger: a slice joining mid-roll — even one that hashes AHEAD of
+    the live canary — lands in a future stage, never growing a begun
+    stage's blast radius."""
+    import hashlib
+
+    spec = RolloutSpec.from_dict(
+        {"enabled": True, "canary": 1, "waves": ["50%"]}
+    )
+    sids = [f"s{i}" for i in range(6)]
+    rec = {"target": "v2", "stage": 0}
+    plan = ro.planned_stages(rec, sids, spec)
+    rec["cohorts"] = [list(plan[0])]
+    canary = plan[0][0]
+    key = lambda s: hashlib.sha1(f"v2:{s}".encode()).hexdigest()  # noqa: E731
+    # find joiners that would hash BEFORE the pinned canary
+    joiners = [
+        name
+        for name in (f"j{i}" for i in range(200))
+        if key(name) < key(canary)
+    ][:2]
+    assert joiners, "no joiner hashed ahead; widen the search"
+    plan2 = ro.planned_stages(rec, sids + joiners, spec)
+    assert plan2[0] == plan[0], (plan2[0], plan[0])
+    assert not (set(joiners) & set(plan2[0]))
+    # the joiners still appear somewhere in the future stages
+    flat = {s for stage in plan2 for s in stage}
+    assert set(joiners) <= flat
+    # and the admission filter honors the pin at stage 0
+    cp = {
+        "spec": {"rollout": {"enabled": True}, "libtpu": {"version": "v2"}},
+        "metadata": {
+            "annotations": {
+                consts.ROLLOUT_STATE_ANNOTATION: json.dumps(
+                    dict(rec, kind="libtpu", state="rolling", previous="v1")
+                )
+            }
+        },
+    }
+    allowed = ro.admission_filter(cp, set(sids + joiners))
+    assert allowed == set(plan[0])
+
+
+def test_admission_filter_fails_closed_before_and_across_restaging():
+    cp = {
+        "spec": {"rollout": {"enabled": True}, "libtpu": {"version": "2.0"}}
+    }
+    # stageable target but no ledger yet: freeze (the CP pass stages it)
+    assert ro.admission_filter(cp, {"a", "b"}) == set()
+    # no version target: hash-only drift is not stageable -> unrestricted
+    cp_nov = {"spec": {"rollout": {"enabled": True}, "libtpu": {}}}
+    assert ro.admission_filter(cp_nov, {"a"}) is None
+    # staged: only the canary cohort admits
+    rec = {
+        "kind": "libtpu",
+        "target": "2.0",
+        "previous": "1.0",
+        "stage": 0,
+        "state": "rolling",
+    }
+    cp["metadata"] = {
+        "annotations": {
+            consts.ROLLOUT_STATE_ANNOTATION: json.dumps(rec)
+        }
+    }
+    sids = {f"s{i}" for i in range(8)}
+    allowed = ro.admission_filter(cp, sids)
+    assert allowed is not None and len(allowed) == 1
+    # the user moved the target: the stale ledger freezes admission
+    cp["spec"]["libtpu"]["version"] = "3.0"
+    assert ro.admission_filter(cp, sids) == set()
+    # ... but a spec reading as the recorded PREVIOUS version is the
+    # rollback override (or a user revert), not a move — never frozen
+    cp["spec"]["libtpu"]["version"] = "1.0"
+    rec_rb = dict(rec, state="rolled-back")
+    cp["metadata"]["annotations"][consts.ROLLOUT_STATE_ANNOTATION] = (
+        json.dumps(rec_rb)
+    )
+    assert ro.admission_filter(cp, sids) is None
+    # rolled-back: unrestricted (desired is pinned to previous; only the
+    # rolled cohort is stale, and the budget still caps concurrency)
+    cp["spec"]["libtpu"]["version"] = "2.0"
+    rec["state"] = "rolled-back"
+    cp["metadata"]["annotations"][consts.ROLLOUT_STATE_ANNOTATION] = (
+        json.dumps(rec)
+    )
+    assert ro.admission_filter(cp, sids) is None
+
+
+def test_apply_override_pins_previous_version_only_while_rolled_back():
+    rec = {
+        "kind": "libtpu",
+        "target": "2.0",
+        "previous": "1.0",
+        "state": "rolled-back",
+    }
+    cp = {
+        "metadata": {
+            "annotations": {
+                consts.ROLLOUT_STATE_ANNOTATION: json.dumps(rec)
+            }
+        },
+        "spec": {"libtpu": {"version": "2.0"}},
+    }
+    raw = ro.apply_override(cp)
+    assert raw[ro.KIND_LIBTPU] == "2.0"  # the user's target, preserved
+    assert cp["spec"]["libtpu"]["version"] == "1.0"  # effective: pinned
+    # the user moved on: the override lapses
+    cp2 = {
+        "metadata": dict(cp["metadata"]),
+        "spec": {"libtpu": {"version": "3.0"}},
+    }
+    ro.apply_override(cp2)
+    assert cp2["spec"]["libtpu"]["version"] == "3.0"
+
+
+def test_validator_payload_canonical_flat_with_legacy_fallback():
+    from tpu_operator.validator import metrics as vm
+
+    # canonical flat schema
+    assert vm.payload_perf({"tflops": 812.5, "gbps": 700}) == {
+        "tflops": 812.5,
+        "gbps": 700.0,
+    }
+    # one-release legacy nested fallback still reads (log-once)
+    assert vm.payload_perf({"result": {"tflops": 90}})["tflops"] == 90.0
+    # the workload path's pod-phase string is not a perf dict
+    assert vm.payload_perf({"result": "Succeeded"}) == {}
+    assert vm.payload_perf("garbage") == {}
+
+
+# ---------------------------------------------------------------------------
+# full-loop matrix
+# ---------------------------------------------------------------------------
+
+
+def test_clean_roll_promotes_through_all_waves_to_complete():
+    client, rec, upg = build_rig()
+    converge(client, rec, upg)
+
+    flip_version(client, V_NEW)
+    for _ in range(60):
+        pump(client, rec, upg)
+        led = ledger(client)
+        if (
+            led is not None
+            and led.get("state") == ro.STATE_COMPLETE
+            and all(v == V_NEW for v in versions(client).values())
+        ):
+            break
+    led = ledger(client)
+    assert led is not None and led["state"] == ro.STATE_COMPLETE, led
+    assert all(v == V_NEW for v in versions(client).values()), versions(client)
+    # canary + one 50% wave + remainder over 4 slice units = 3 stages,
+    # so exactly 2 promotions and zero rollbacks/pauses
+    stats = rec.rollout.stats()
+    assert stats["promotions_total"] == 2, stats
+    assert stats["rollbacks_total"] == 0 and stats["pauses_total"] == 0
+    # status mirrors the ledger
+    cp = client.get(consts.API_VERSION, "ClusterPolicy", "cluster-policy")
+    assert cp["status"]["rollout"]["state"] == ro.STATE_COMPLETE
+    assert cp["status"]["rollout"]["target"] == V_NEW
+    # every admitted node recorded its rollback target at admission
+    for name in NODES:
+        assert (
+            node_ann(client, name).get(
+                consts.UPGRADE_PREVIOUS_VERSION_ANNOTATION
+            )
+            == V_OLD
+        ), name
+
+
+def test_bad_canary_rolls_back_with_zero_wave2_admissions():
+    client, rec, upg = build_rig()
+    converge(client, rec, upg)
+    stages, canary_nodes = canary_members()
+    assert len(stages) == 3
+
+    was_interval = flight.RECORDER.min_interval_s
+    flight.RECORDER.min_interval_s = 0.0
+    dumps_before = set(flight.RECORDER.dump_paths_snapshot())
+    try:
+        inject_bad_version(V_NEW, tflops_factor=0.5)
+        flip_version(client, V_NEW)
+        for _ in range(60):
+            pump(client, rec, upg)
+            led = ledger(client)
+            if (
+                led is not None
+                and led.get("state") == ro.STATE_ROLLED_BACK
+                and all(v == V_OLD for v in versions(client).values())
+                and not any(
+                    node_labels(client, n).get(consts.UPGRADE_STATE_LABEL)
+                    in (consts.UPGRADE_STATE_UPGRADE_REQUIRED,)
+                    + tuple(consts.UPGRADE_ACTIVE_STATES)
+                    for n in NODES
+                )
+            ):
+                break
+        led = ledger(client)
+        assert led is not None and led["state"] == ro.STATE_ROLLED_BACK, led
+        assert led["previous"] == V_OLD and led["target"] == V_NEW
+        # the evidence names the regression
+        assert any("TFLOPS" in ev for ev in led.get("evidence", [])), led
+        # the fleet ENDED on the old version
+        assert all(v == V_OLD for v in versions(client).values()), versions(
+            client
+        )
+        # ZERO wave-2 admissions: only canary members ever entered the
+        # roll (the admission-time rollback annotation is the witness)
+        admitted = {
+            n
+            for n in NODES
+            if consts.UPGRADE_PREVIOUS_VERSION_ANNOTATION in node_ann(client, n)
+        }
+        assert admitted == set(canary_nodes), (admitted, canary_nodes)
+        # the decision was flight-recorded with an auto-dump naming the
+        # failing evidence
+        new_dumps = [
+            p
+            for p in flight.RECORDER.dump_paths_snapshot()
+            if p not in dumps_before and "rollout-rollback" in p
+        ]
+        assert new_dumps, "no rollout-rollback flight dump"
+        with open(new_dumps[-1]) as f:
+            dump = json.load(f)
+        assert "TFLOPS" in dump["detail"]
+        assert any(
+            e.get("kind") == "rollout.rollback" for e in dump["events"]
+        )
+        # ... and surfaced as a Warning Event
+        reasons = {e["reason"] for e in client.list("v1", "Event", NS)}
+        assert "RolloutRolledBack" in reasons
+        # status mirrors the pause/rollback picture
+        cp = client.get(
+            consts.API_VERSION, "ClusterPolicy", "cluster-policy"
+        )
+        assert cp["status"]["rollout"]["state"] == ro.STATE_ROLLED_BACK
+        assert cp["status"]["rollout"]["evidence"]
+    finally:
+        clear_bad_versions()
+        flight.RECORDER.min_interval_s = was_interval
+
+
+def test_rollback_respects_shared_budget_with_remediation_active():
+    """While a rollback re-rolls the canary, a remediation quarantine on
+    another slice consumes the SAME maxUnavailable pool: jointly they
+    must never exceed the cap, sampled every tick."""
+    client, rec, upg = build_rig(
+        max_unavailable="2",
+        remediation={
+            "enabled": True,
+            "maxAttempts": 4,
+            "backoffSeconds": 0,
+            "maxUnavailable": "2",
+            "systemicThreshold": "75%",
+        },
+    )
+    converge(client, rec, upg)
+    _, canary_nodes = canary_members()
+    victim = next(n for n in SOLO_NODES if n not in canary_nodes)
+
+    # chips die on a non-canary solo: remediation will quarantine it
+    node = client.get("v1", "Node", victim, copy=True)
+    node["status"]["allocatable"][consts.TPU_RESOURCE] = "0"
+    client.update_status(node)
+
+    over_cap = []
+
+    def sample():
+        disrupted = set()
+        for n in NODES:
+            labels = node_labels(client, n)
+            sid = SLICE_ID if n in SLICE_NODES else n
+            if (
+                labels.get(consts.UPGRADE_STATE_LABEL)
+                in consts.UPGRADE_ACTIVE_STATES
+                or labels.get(consts.UPGRADE_STATE_LABEL)
+                == consts.UPGRADE_STATE_FAILED
+                or labels.get(consts.REMEDIATION_STATE_LABEL)
+                in consts.REMEDIATION_DISRUPTED_STATES
+            ):
+                disrupted.add(sid)
+        if len(disrupted) > 2:
+            over_cap.append(sorted(disrupted))
+
+    victim_quarantined = [False]
+
+    def sample_all():
+        sample()
+        if (
+            node_labels(client, victim).get(consts.REMEDIATION_STATE_LABEL)
+            in consts.REMEDIATION_DISRUPTED_STATES
+        ):
+            victim_quarantined[0] = True
+
+    try:
+        inject_bad_version(V_NEW, tflops_factor=0.5)
+        flip_version(client, V_NEW)
+        # phase 1: remediation quarantines the victim while the canary
+        # rolls, regresses, and the orchestrator rolls back
+        for _ in range(60):
+            pump(client, rec, upg, each=sample_all)
+            led = ledger(client)
+            if (
+                led is not None
+                and led.get("state") == ro.STATE_ROLLED_BACK
+                and victim_quarantined[0]
+            ):
+                break
+        led = ledger(client)
+        assert led is not None and led["state"] == ro.STATE_ROLLED_BACK, led
+        assert victim_quarantined[0], "victim never quarantined"
+
+        # phase 2: the host is repaired; remediation releases its hold
+        # and the rollback re-roll (the victim's operand restart pulled
+        # it onto the bad version mid-quarantine) finishes — all under
+        # the one shared cap, sampled every tick
+        node = client.get("v1", "Node", victim, copy=True)
+        node["status"]["allocatable"][consts.TPU_RESOURCE] = "8"
+        client.update_status(node)
+        for _ in range(60):
+            pump(client, rec, upg, each=sample)
+            if all(
+                v == V_OLD for v in versions(client).values()
+            ) and not node_labels(client, victim).get(
+                consts.REMEDIATION_STATE_LABEL
+            ):
+                break
+        assert not over_cap, over_cap[:3]
+        assert all(v == V_OLD for v in versions(client).values()), versions(
+            client
+        )
+        led = ledger(client)
+        assert led is not None and led["state"] == ro.STATE_ROLLED_BACK
+    finally:
+        clear_bad_versions()
+
+
+def test_operator_restart_mid_rollback_resumes_from_persisted_state():
+    client, rec, upg = build_rig()
+    converge(client, rec, upg)
+    try:
+        inject_bad_version(V_NEW, tflops_factor=0.5)
+        flip_version(client, V_NEW)
+        # run only until the ledger flips to rolled-back, then "crash"
+        for _ in range(60):
+            pump(client, rec, upg)
+            led = ledger(client)
+            if led is not None and led.get("state") == ro.STATE_ROLLED_BACK:
+                break
+        led = ledger(client)
+        assert led is not None and led["state"] == ro.STATE_ROLLED_BACK
+        # some canary node still runs (or is mid-roll to/from) V_NEW —
+        # the restart must finish the rollback, not restart the roll
+        # fresh reconcilers = a restarted operator; everything it needs
+        # is in the CR annotation ledger + node labels/annotations
+        rec2 = ClusterPolicyReconciler(client, assets_dir=ASSETS)
+        upg2 = UpgradeReconciler(client, NS)
+        for _ in range(60):
+            pump(client, rec2, upg2)
+            if all(v == V_OLD for v in versions(client).values()) and not any(
+                node_labels(client, n).get(consts.UPGRADE_STATE_LABEL)
+                in (consts.UPGRADE_STATE_UPGRADE_REQUIRED,)
+                + tuple(consts.UPGRADE_ACTIVE_STATES)
+                for n in NODES
+            ):
+                break
+        assert all(v == V_OLD for v in versions(client).values()), versions(
+            client
+        )
+        led = ledger(client)
+        assert led is not None and led["state"] == ro.STATE_ROLLED_BACK
+        # the restarted operator kept gating: nothing outside the canary
+        # cohort was ever admitted
+        _, canary_nodes = canary_members()
+        admitted = {
+            n
+            for n in NODES
+            if consts.UPGRADE_PREVIOUS_VERSION_ANNOTATION in node_ann(client, n)
+        }
+        assert admitted == set(canary_nodes), (admitted, canary_nodes)
+    finally:
+        clear_bad_versions()

@@ -536,6 +536,46 @@ class RemediationSpec(SpecBase):
 
 
 @dataclass
+class RolloutSpec(SpecBase):
+    """Health-gated progressive rollouts (TPU-specific; the reference's
+    closest analogue is its second, upgrade-only reconciler —
+    ``controllers/upgrade_controller.go``). When enabled, any fleet-wide
+    version/layout change (``libtpu.version`` through the upgrade FSM,
+    ``sliceManager.config.default`` through the re-partition roller) is
+    staged through **canary → wave(s) → fleet** slice cohorts
+    (``controllers/rollout.py``), with a live health gate between stages:
+    validator TFLOPS/membw deltas vs the pre-roll per-node baseline, new
+    remediation quarantines, upgrade failures, operand crashloops,
+    Degraded conditions, and alloc-latency regression. A regressing
+    canary pauses the roll and — with ``autoRollback`` (default on) —
+    re-rolls the cohort to the recorded previous version.
+
+    ``canary``/``waves`` are int-or-percent of the fleet's SLICES (the
+    disruption unit): canary defaults to 1 slice, then one 25% wave,
+    then the rest of the fleet. ``observeSeconds`` is the per-stage soak
+    after the cohort finishes rolling before promotion. The degraded-
+    percent knobs are regression thresholds vs the recorded baseline."""
+
+    enabled: Optional[bool] = None
+    canary: str = "1"
+    waves: List[str] = field(default_factory=lambda: ["25%"])
+    observe_seconds: int = 60
+    tflops_degraded_pct: int = 10
+    membw_degraded_pct: int = 10
+    alloc_p99_degraded_pct: int = 100
+    auto_rollback: Optional[bool] = None
+
+    def is_enabled(self) -> bool:
+        # opt-in: staged rolls deliberately slow fleet-wide changes down
+        return bool(self.enabled)
+
+    def rollback_enabled(self) -> bool:
+        # default ON: a staged roll without automatic rollback only
+        # contains the blast radius, it doesn't undo it
+        return True if self.auto_rollback is None else bool(self.auto_rollback)
+
+
+@dataclass
 class SliceSpec(SpecBase):
     """Subslice exposure strategy — the reference's ``MIGSpec``.
 
@@ -795,6 +835,7 @@ class ClusterPolicySpec(SpecBase):
         default_factory=MaintenanceHandlerSpec
     )
     remediation: RemediationSpec = field(default_factory=RemediationSpec)
+    rollout: RolloutSpec = field(default_factory=RolloutSpec)
     slice: SliceSpec = field(default_factory=SliceSpec)
     slice_manager: SliceManagerSpec = field(default_factory=SliceManagerSpec)
     validator: ValidatorSpec = field(default_factory=ValidatorSpec)
@@ -835,6 +876,11 @@ class ClusterPolicyStatus(SpecBase):
     # glance; breakerOpen mirrors the Degraded/SystemicNodeFailure
     # condition
     remediation: Dict[str, Any] = field(default_factory=dict)
+    # health-gated rollout progress: {"kind": "libtpu"|"layout",
+    # "target": v, "state": "rolling"|"paused"|"rolledBack"|"complete",
+    # "stage": "k/n", "evidence": [...]} — mirrors the durable rollout
+    # ledger annotation (controllers/rollout.py)
+    rollout: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass

@@ -60,6 +60,22 @@ _FIELD_RULES: Dict[str, Dict[str, Any]] = {
     },
     "maxAttempts": {"minimum": 0},
     "backoffSeconds": {"minimum": 0},
+    # rollout stage sizes are int-or-percent of the fleet's slices, like
+    # maxUnavailable; the health-gate knobs are plain bounded integers
+    "canary": {
+        "x-kubernetes-int-or-string": True,
+        "pattern": r"^\d+%?$",
+    },
+    "waves": {
+        "items": {
+            "x-kubernetes-int-or-string": True,
+            "pattern": r"^\d+%?$",
+        }
+    },
+    "observeSeconds": {"minimum": 0},
+    "tflopsDegradedPct": {"minimum": 0, "maximum": 100},
+    "membwDegradedPct": {"minimum": 0, "maximum": 100},
+    "allocP99DegradedPct": {"minimum": 0},
     "hostPort": {"minimum": 1, "maximum": 65535},
     "tolerations": {"items": TOLERATION_SCHEMA},
     # k8s Quantities: `cpu: 2` and `cpu: "2"` are both valid, so these

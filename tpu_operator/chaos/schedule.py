@@ -24,7 +24,17 @@ fault       ``sim.inject_fault`` (verb/code/count)
 partition   ``sim.partition`` (short full-apiserver window)
 repartition flip ``spec.sliceManager.config.default`` to a profile —
             the live re-partition roll (third budget consumer)
+bad_version register a libtpu version as bad
+            (``kube.testing.inject_bad_version``: degraded validator
+            TFLOPS/membw, optional CrashLoopBackOff)
+libtpu_roll flip ``spec.libtpu.version`` — with ``spec.rollout``
+            enabled, a health-gated canary roll the injected bad
+            version must fail, driving automatic rollback
 ========== ==========================================================
+
+``bad_version``/``libtpu_roll`` are scheduled explicitly (like the one
+repartition) from the ``rollout`` knob and consume NO RNG draws, so
+schedules generated without the knob stay byte-identical to old seeds.
 """
 
 from __future__ import annotations
@@ -81,6 +91,7 @@ class ChaosSchedule:
         min_fleet: int = 4,
         slice_hosts: int = 2,
         repartition_profiles: Optional[List[str]] = None,
+        rollout: Optional[Dict[str, object]] = None,
         events: Optional[List[ChaosEvent]] = None,
     ):
         self.seed = seed
@@ -92,6 +103,10 @@ class ChaosSchedule:
         self.min_fleet = min_fleet
         self.slice_hosts = slice_hosts
         self.repartition_profiles = repartition_profiles or []
+        # {"version": str, "tflops_factor": float, "crashloop": bool}:
+        # schedule one seeded bad-version libtpu roll mid-run (the
+        # rollout orchestrator's rollback acceptance scenario)
+        self.rollout = dict(rollout) if rollout else {}
         self.events: List[ChaosEvent] = (
             events if events is not None else self._generate()
         )
@@ -187,6 +202,33 @@ class ChaosSchedule:
                         {"duration_s": round(rng.uniform(0.2, 0.6), 3)},
                     )
                 )
+        if self.rollout:
+            # seeded mid-roll bad version: the injection lands BEFORE
+            # the version flip so the canary cohort reports degraded
+            # perf the moment it rolls. Fixed fractions, zero RNG draws
+            # — pre-existing seeds replay byte-identically
+            events.append(
+                ChaosEvent(
+                    self.duration_s * 0.2,
+                    "bad_version",
+                    {
+                        "version": str(self.rollout["version"]),
+                        "tflops_factor": float(
+                            self.rollout.get("tflops_factor", 0.4)
+                        ),
+                        "crashloop": bool(
+                            self.rollout.get("crashloop", False)
+                        ),
+                    },
+                )
+            )
+            events.append(
+                ChaosEvent(
+                    self.duration_s * 0.25,
+                    "libtpu_roll",
+                    {"version": str(self.rollout["version"])},
+                )
+            )
         if self.repartition_profiles:
             # exactly one live re-partition roll, mid-run: the layout
             # flip lands while joins/preemptions/faults are in flight

@@ -401,6 +401,10 @@ class SoakRunner:
         time_scale: float = 1.0,
         preempt_fraction: float = 0.08,
         mean_gap_s: float = 0.6,
+        bad_version_roll: bool = False,
+        bad_version: str = "2025.9.9-bad",
+        bad_tflops_factor: float = 0.4,
+        observe_seconds: float = 2.0,
     ):
         self.n_nodes = nodes
         self.slice_pairs = slice_pairs
@@ -425,6 +429,19 @@ class SoakRunner:
         # whole passes to land
         self.preempt_fraction = preempt_fraction
         self.mean_gap_s = mean_gap_s
+        # health-gated rollout scenario (ISSUE 12 acceptance): enable
+        # autoUpgrade + spec.rollout, inject a seeded bad libtpu version
+        # mid-run and flip the fleet target to it — the canary cohort
+        # must report degraded validator TFLOPS, the orchestrator must
+        # roll back automatically, and the fleet must settle on the OLD
+        # version with zero slices lost
+        self.bad_version_roll = bad_version_roll
+        self.bad_version = bad_version
+        self.bad_tflops_factor = bad_tflops_factor
+        self.observe_seconds = observe_seconds
+        # set by the libtpu_roll executor: the version the fleet ran
+        # before the flip — the rollback target settle waits for
+        self._expect_version: Optional[str] = None
 
     # ------------------------------------------------------------------
     def _initial_nodes(self) -> List[tuple]:
@@ -463,7 +480,12 @@ class SoakRunner:
             sample_clusterpolicy_path,
             simulate_kubelet_nodes,
         )
-        from tpu_operator.main import CP_KEY, build_manager, wire_event_sources
+        from tpu_operator.main import (
+            CP_KEY,
+            UPGRADE_KEY,
+            build_manager,
+            wire_event_sources,
+        )
 
         server = KubeSimServer(KubeSim(bookmark_interval_s=1.0)).start()
         sim = server.sim
@@ -496,6 +518,31 @@ class SoakRunner:
                 }
             ),
         )
+        if self.bad_version_roll:
+            # staged health-gated rolls: canary of 1 slice, one 50%
+            # wave, then the fleet; short observation so the fast tier
+            # finishes. Drain is forced (churn pods are the workload)
+            # and bounded so a wedged drain can't stall the canary past
+            # the soak budget.
+            def _enable_rollout(cp):
+                cp["spec"]["libtpu"]["upgradePolicy"] = {
+                    "autoUpgrade": True,
+                    "maxParallelUpgrades": 4,
+                    "maxUnavailable": self.max_unavailable,
+                    "drain": {
+                        "enable": True,
+                        "force": True,
+                        "timeoutSeconds": 30,
+                    },
+                }
+                cp["spec"]["rollout"] = {
+                    "enabled": True,
+                    "canary": 1,
+                    "waves": ["50%"],
+                    "observeSeconds": int(self.observe_seconds),
+                }
+
+            edit_clusterpolicy(client, _enable_rollout)
 
         # the live fleet list the kubelet sim sweeps — lifecycle hooks
         # keep it current as joins/preemptions land
@@ -520,6 +567,19 @@ class SoakRunner:
         mgr.start()
         mgr.enqueue(CP_KEY)
         halt = threading.Event()
+        if self.bad_version_roll:
+            # the upgrade reconciler must actually run (non-rollout
+            # soaks never enqueue it): event wiring wakes it on FSM
+            # label/pod movement, and a pump provides the step clock at
+            # test cadence (production re-queues at 5 s while staged)
+            mgr.enqueue(UPGRADE_KEY)
+
+            def upgrade_pump():
+                while not halt.is_set():
+                    mgr.enqueue(UPGRADE_KEY)
+                    halt.wait(0.3)
+
+            threading.Thread(target=upgrade_pump, daemon=True).start()
 
         def kubelet():
             while not halt.is_set():
@@ -625,6 +685,14 @@ class SoakRunner:
                 repartition_profiles=(
                     ["balanced-2x2"] if self.repartition else []
                 ),
+                rollout=(
+                    {
+                        "version": self.bad_version,
+                        "tflops_factor": self.bad_tflops_factor,
+                    }
+                    if self.bad_version_roll
+                    else None
+                ),
             )
             report["trace"] = schedule.trace()
             self._applied_profile = None  # set by the repartition event
@@ -647,6 +715,30 @@ class SoakRunner:
                 report["settle_blockers"] = getattr(
                     self, "last_settle_blockers", []
                 )
+            if self.bad_version_roll:
+                from tpu_operator.controllers.rollout import load_record
+
+                report["rollout"] = reconciler.rollout.stats()
+                try:
+                    cp = (
+                        client.get_or_none(
+                            CPV, "ClusterPolicy", "cluster-policy"
+                        )
+                        or {}
+                    )
+                    report["rollout_record"] = load_record(cp)
+                    # the admission witness: only nodes the FSM actually
+                    # admitted carry the rollback-target annotation —
+                    # "zero wave-2 admissions" is this list staying
+                    # within the canary cohort
+                    report["rollout_nodes_admitted"] = sorted(
+                        n["metadata"]["name"]
+                        for n in client.list("v1", "Node")
+                        if consts.UPGRADE_PREVIOUS_VERSION_ANNOTATION
+                        in (n["metadata"].get("annotations") or {})
+                    )
+                except Exception:
+                    pass
         finally:
             checker_halt.set()
             checker_thread.join(timeout=10)
@@ -689,6 +781,10 @@ class SoakRunner:
             stop.set()
             mgr.stop()
             server.stop()
+            if self.bad_version_roll:
+                from tpu_operator.kube.testing import clear_bad_versions
+
+                clear_bad_versions()
 
         report["checker_samples"] = checker.samples
         report["checker_sample_errors"] = checker.sample_errors
@@ -774,6 +870,53 @@ class SoakRunner:
                     )
                 elif ev.kind == "partition":
                     sim.partition(float(ev.args["duration_s"]))
+                elif ev.kind == "bad_version":
+                    from tpu_operator.kube.testing import inject_bad_version
+
+                    inject_bad_version(
+                        str(ev.args["version"]),
+                        tflops_factor=float(
+                            ev.args.get("tflops_factor", 1.0)
+                        ),
+                        crashloop=bool(ev.args.get("crashloop", False)),
+                    )
+                elif ev.kind == "libtpu_roll":
+                    target = str(ev.args["version"])
+                    cur = (
+                        client.get_or_none(
+                            CPV, "ClusterPolicy", "cluster-policy"
+                        )
+                        or {}
+                    )
+                    # the version the fleet runs NOW is the rollback
+                    # target the settle predicate waits for (the bad
+                    # version above guarantees the gate trips)
+                    self._expect_version = (
+                        ((cur.get("spec") or {}).get("libtpu") or {}).get(
+                            "version"
+                        )
+                        or None
+                    )
+
+                    def flip_roll():
+                        edit_clusterpolicy(
+                            client,
+                            lambda cp: cp["spec"]["libtpu"].update(
+                                version=target
+                            ),
+                        )
+
+                    last_err: Optional[Exception] = None
+                    for _attempt in range(20):
+                        try:
+                            flip_roll()
+                            last_err = None
+                            break
+                        except Exception as e:  # 503s, breaker, 409s
+                            last_err = e
+                            time.sleep(0.2)
+                    if last_err is not None:
+                        raise last_err
                 elif ev.kind == "repartition":
                     profile = ev.args["profile"]
                     self._applied_profile = profile
@@ -884,6 +1027,22 @@ class SoakRunner:
                 == consts.REPARTITION_STATE_ROLLING
             ):
                 blockers.append(f"{name} still rolling")
+            ustate = labels.get(consts.UPGRADE_STATE_LABEL, "")
+            if ustate in consts.UPGRADE_ACTIVE_STATES or ustate in (
+                consts.UPGRADE_STATE_UPGRADE_REQUIRED,
+                consts.UPGRADE_STATE_FAILED,
+            ):
+                blockers.append(f"{name} upgrade={ustate}")
+            if (
+                self._expect_version
+                and labels.get(consts.TFD_LIBTPU_VERSION_LABEL)
+                != self._expect_version
+            ):
+                # a rolled-back fleet must actually END on the old
+                # version — not merely stop rolling the bad one
+                blockers.append(
+                    f"{name} awaiting libtpu {self._expect_version!r}"
+                )
             rstate = labels.get(consts.REMEDIATION_STATE_LABEL)
             if rstate and rstate != consts.REMEDIATION_STATE_EXHAUSTED:
                 blockers.append(f"{name} remediation={rstate}")

@@ -211,6 +211,26 @@ UPGRADE_ACTIVE_STATES = (
 # permanently consuming maxUnavailable budget (clear UPGRADE_STATE_LABEL or
 # set UPGRADE_SKIP_LABEL to intervene by hand)
 UPGRADE_RETRY_ANNOTATION = f"{GROUP}/libtpu-upgrade-retries"
+# the libtpu version the node ran BEFORE the FSM admitted it into the
+# current roll — written at admission (copied from TFD_LIBTPU_VERSION_LABEL)
+# so the health-gated rollout orchestrator's automatic rollback target
+# survives operator restarts (controllers/rollout.py)
+UPGRADE_PREVIOUS_VERSION_ANNOTATION = f"{GROUP}/libtpu-previous-version"
+
+# --- health-gated progressive rollouts (controllers/rollout.py) --------
+# the rollout ledger on the ClusterPolicy: JSON {kind, target, previous,
+# stage, state, ...} persisting canary→wave→fleet progress, the recorded
+# rollback target and any failing health evidence across restarts
+ROLLOUT_STATE_ANNOTATION = f"{GROUP}/rollout-state"
+# per-node validator performance readings, published by the node-status
+# exporter (validator/metrics.py) from the canonical jax/membw status
+# payloads: JSON {"tflops": x, "gbps": y, "version": v} — the live
+# evidence the rollout health gate compares against the baseline
+VALIDATOR_PERF_ANNOTATION = f"{GROUP}/validator-perf"
+# pre-roll copy of VALIDATOR_PERF_ANNOTATION, stamped when the upgrade
+# FSM admits the node — the per-node baseline TFLOPS/membw deltas are
+# measured against (survives restarts like every FSM fact)
+VALIDATOR_PERF_BASELINE_ANNOTATION = f"{GROUP}/validator-perf-baseline"
 # when the node entered its current FSM state (drives drain/validation
 # timeouts -> upgrade-failed)
 UPGRADE_STATE_SINCE_ANNOTATION = f"{GROUP}/libtpu-upgrade-state-since"

@@ -74,6 +74,13 @@ class ClusterPolicyReconciler:
         )
 
         self.repartition = SliceRepartitionController(client)
+        # health-gated progressive rollout orchestrator (canary waves +
+        # automatic rollback; no-op without spec.rollout.enabled). Runs
+        # after remediation (fresh quarantines are gate evidence) and
+        # before repartition (which consumes the computed cohort gate).
+        from tpu_operator.controllers.rollout import RolloutController
+
+        self.rollout = RolloutController(client)
         # (Node, Pod) store versions of the last clean slice aggregation
         # — while both hold, the per-node slice grouping and readiness
         # math is a pure recomputation over an unchanged world, so the
@@ -249,13 +256,24 @@ class ClusterPolicyReconciler:
         with trace.span("fsm.remediation"):
             remediation_summary = self._run_remediation()
 
+        # health-gated rollout orchestration (canary→wave→fleet staging
+        # of any fleet-wide version/layout change, with automatic
+        # rollback on failing canary evidence): consumes the fresh
+        # remediation verdicts as gate evidence and computes the cohort
+        # admission gate the re-partition roll (below) and the upgrade
+        # reconciler both honor
+        with trace.span("fsm.rollout"):
+            rollout_summary = self._run_rollout(primary, remediation_summary)
+
         # live slice re-partition roll (after remediation, and handed
         # remediation's in-pass disrupted set: the quarantine labels it
         # just wrote are on the wire but NOT in this pass's node
         # snapshot, and the label-derived joint set alone would let the
         # two consumers jointly over-admit past the one cap)
         with trace.span("fsm.repartition"):
-            repartition_summary = self._run_repartition(remediation_summary)
+            repartition_summary = self._run_repartition(
+                remediation_summary, rollout_summary
+            )
 
         with trace.span("pass.slices"):
             slice_summary = self._aggregate_slices()
@@ -304,7 +322,7 @@ class ClusterPolicyReconciler:
 
         self._set_status(
             primary, overall, slice_summary, errored_states,
-            remediation_summary,
+            remediation_summary, rollout_summary,
         )
         self._update_fleet_metrics()
         if errored_states:
@@ -326,6 +344,11 @@ class ClusterPolicyReconciler:
             # an in-flight/pending layout roll: budget headroom opens
             # when ANOTHER consumer releases a slice — no cluster event
             # of ours fires for that, so the requeue is the roll's clock
+            return Result(ready=True, requeue_after=REQUEUE_NOT_READY_S)
+        if rollout_summary is not None and rollout_summary.active:
+            # a staged roll in flight: the observation window and the
+            # rollback's re-roll elapse without any cluster event — the
+            # requeue is the rollout's clock
             return Result(ready=True, requeue_after=REQUEUE_NOT_READY_S)
         return Result(ready=True)
 
@@ -362,7 +385,35 @@ class ClusterPolicyReconciler:
         self._update_remediation_metrics(summary)
         return summary
 
-    def _run_repartition(self, remediation_summary=None):
+    def _run_rollout(self, primary, remediation_summary=None):
+        """Health-gated rollout orchestration pass. Failure-isolated
+        like remediation: an orchestrator exception must not abort the
+        reconcile — the 5s requeue retries it, and an errored pass
+        reports active so the clock keeps ticking."""
+        from tpu_operator.controllers.rollout import RolloutSummary
+        from tpu_operator.controllers.state_manager import has_tpu_labels
+
+        try:
+            tpu_nodes = [
+                n for n in (self.ctrl._nodes_cache or ()) if has_tpu_labels(n)
+            ]
+            return self.rollout.reconcile(
+                tpu_nodes,
+                primary,
+                self.ctrl.cp.spec.rollout,
+                getattr(self.ctrl, "raw_roll_targets", None) or {},
+                self.ctrl.namespace,
+                remediation_summary=remediation_summary,
+            )
+        except Exception:
+            log.exception("rollout orchestration pass failed")
+            # FAIL CLOSED: an errored orchestrator must freeze fresh
+            # staged admissions (admit_sids=set()), not leave the
+            # same-pass repartition roll unrestricted — the 5s errored
+            # retry re-opens the gate as soon as a pass succeeds
+            return RolloutSummary(errored=True, admit_sids=set())
+
+    def _run_repartition(self, remediation_summary=None, rollout_summary=None):
         """Live slice re-partition pass (third shared-budget consumer).
         Failure-isolated like remediation: a roll exception must not
         abort the reconcile; the 5s requeue retries it."""
@@ -379,6 +430,7 @@ class ClusterPolicyReconciler:
                 extra_disrupted=getattr(
                     remediation_summary, "disrupted_sids", None
                 ),
+                admit_filter=getattr(rollout_summary, "admit_sids", None),
             )
         except Exception:
             log.exception("slice re-partition pass failed")
@@ -568,6 +620,7 @@ class ClusterPolicyReconciler:
         slice_summary=None,
         errored=None,
         remediation_summary=None,
+        rollout_summary=None,
     ) -> None:
         """reference ``updateCRState`` (``:198``) + Ready and Degraded
         conditions, the per-state error block, the slice-readiness
@@ -597,6 +650,11 @@ class ClusterPolicyReconciler:
             block = remediation_summary.status_block()
             if any(block.values()):
                 remediation_block = block
+        rollout_block = (
+            rollout_summary.status_block()
+            if rollout_summary is not None
+            else None
+        )
         if (
             status.get("state") == state
             and status.get("namespace")
@@ -606,6 +664,10 @@ class ClusterPolicyReconciler:
             and (
                 remediation_summary is None
                 or status.get("remediation") == remediation_block
+            )
+            and (
+                rollout_summary is None
+                or status.get("rollout") == rollout_block
             )
         ):
             return
@@ -627,6 +689,11 @@ class ClusterPolicyReconciler:
                 status["remediation"] = remediation_block
             else:
                 status.pop("remediation", None)
+        if rollout_summary is not None:
+            if rollout_block is not None:
+                status["rollout"] = rollout_block
+            else:
+                status.pop("rollout", None)
 
         now = datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 

@@ -195,6 +195,12 @@ class RemediationSummary:
     # admission is not blind to quarantine labels still on the wire
     # (the pass-start node snapshot predates them)
     disrupted_sids: Set[str] = field(default_factory=set)
+    # hosts THIS pass escalated into a disrupted state (cordon-drain /
+    # exhausted entry): the same-pass rollout health gate reads these —
+    # the quarantine labels are on the wire but not in the pass-start
+    # node snapshot, and a canary quarantined in the very pass its
+    # observation window elapses must block the promotion
+    newly_disrupted_hosts: List[str] = field(default_factory=list)
 
     @property
     def active(self) -> bool:
@@ -727,6 +733,7 @@ class NodeRemediationController:
             self._set_state(name, consts.REMEDIATION_STATE_CORDON_DRAIN)
             v.state = consts.REMEDIATION_STATE_CORDON_DRAIN
             disrupted.add(sid)
+            summary.newly_disrupted_hosts.append(name)
             self._record_event(
                 "Warning",
                 "NodeQuarantined",
@@ -810,6 +817,7 @@ class NodeRemediationController:
         self._set_state(v.name, consts.REMEDIATION_STATE_EXHAUSTED)
         v.state = consts.REMEDIATION_STATE_EXHAUSTED
         disrupted.add(sid)
+        summary.newly_disrupted_hosts.append(v.name)
         # a quarantine without a drain would leave already-scheduled TPU
         # jobs riding the known-bad host (NoSchedule only gates NEW
         # placement); best-effort here, retried from the exhausted hold

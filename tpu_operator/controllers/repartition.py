@@ -105,6 +105,7 @@ class SliceRepartitionController:
         spec,
         namespace: str,
         extra_disrupted: Optional[Set[str]] = None,
+        admit_filter: Optional[Set[str]] = None,
     ) -> RepartitionSummary:
         """One roll pass over the labeled TPU node list. ``spec`` is
         ``cp.spec.slice_manager``; with no ``config.default`` the pass
@@ -112,7 +113,11 @@ class SliceRepartitionController:
         hold budget forever). ``extra_disrupted`` is the same-pass
         remediation disrupted slice set: its label writes are on the wire
         but not yet in ``tpu_nodes``, and counting them here is what
-        keeps the two same-pass consumers under the ONE shared cap."""
+        keeps the two same-pass consumers under the ONE shared cap.
+        ``admit_filter`` (optional set of slice ids) restricts FRESH
+        admissions to the named slices — the health-gated rollout
+        orchestrator's cohort gate (``controllers/rollout.py``); slices
+        already rolling always finish."""
         self.namespace = namespace
         desired = ""
         if spec is not None and spec.config is not None:
@@ -199,6 +204,11 @@ class SliceRepartitionController:
         # fresh admissions within the JOINT headroom, whole slices only
         admitted = 0
         for sid in sorted(pending_sids):
+            if admit_filter is not None and sid not in admit_filter:
+                # outside the rollout's current cohort: the slice waits
+                # for its wave (the orchestrator widens the gate when it
+                # promotes a stage)
+                continue
             if sid in disrupted:
                 # another actor (upgrade roll, quarantine) owns this
                 # slice's disruption: never double-disrupt — it becomes
@@ -314,6 +324,18 @@ class SliceRepartitionController:
                     if fl.get(key) != value:
                         fl[key] = value
                         changed = True
+                # rollback fact for the health-gated rollout: the
+                # pre-roll validator perf reading becomes the baseline
+                # its TFLOPS/membw deltas are measured against (the
+                # upgrade FSM records the same at ITS admission)
+                ann = fresh["metadata"].setdefault("annotations", {})
+                perf = ann.get(consts.VALIDATOR_PERF_ANNOTATION)
+                if perf and (
+                    ann.get(consts.VALIDATOR_PERF_BASELINE_ANNOTATION)
+                    != perf
+                ):
+                    ann[consts.VALIDATOR_PERF_BASELINE_ANNOTATION] = perf
+                    changed = True
                 return changed
 
             try:

@@ -248,6 +248,9 @@ class ClusterPolicyController:
         self.namespace = ""
         self.cp: ClusterPolicy = ClusterPolicy()
         self.cp_obj: Obj = {}
+        # user-authored fleet-wide targets (libtpu version / slice
+        # layout) BEFORE any rollout rollback override; set by init()
+        self.raw_roll_targets: Dict[str, str] = {}
         self.openshift = False
         self.runtime = ""
         self.k8s_version = ""
@@ -432,6 +435,16 @@ class ClusterPolicyController:
     # ------------------------------------------------------------------
     def init(self, cp_obj: Obj) -> None:
         self.cp_obj = cp_obj
+        # rollout rollback override (controllers/rollout.py): while the
+        # rollout ledger says rolled-back, the EFFECTIVE desired
+        # version/layout is the recorded previous value — applied to
+        # this pass's private CR copy BEFORE decoding/fingerprinting so
+        # rendering, the upgrade FSM's desired hashes and the
+        # re-partition roller all converge the fleet back. The raw
+        # user-authored targets are kept for the orchestrator.
+        from tpu_operator.controllers.rollout import apply_override
+
+        self.raw_roll_targets = apply_override(cp_obj)
         self.cp = clusterpolicy_from_obj(cp_obj)
         self.idx = 0
 

@@ -100,6 +100,101 @@ def _kubelet_lane(client: Client):
     return lane
 
 
+# ---------------------------------------------------------------------------
+# seeded bad-version fault primitive (ISSUE 12): nodes running a version
+# registered here report degraded validator TFLOPS/membw (and optionally
+# a crashlooping libtpu operand), so the rollout orchestrator's health
+# gate and automatic rollback are testable deterministically — the chaos
+# schedule's ``bad_version`` event kind lands in this registry.
+# ---------------------------------------------------------------------------
+
+#: healthy-node synthetic validator readings the kubelet sim publishes
+#: (v5e-class matmul TFLOPS / HBM GB/s); a bad version scales them
+PERF_BASE_TFLOPS = 900.0
+PERF_BASE_GBPS = 800.0
+
+_BAD_VERSIONS: dict = {}
+
+
+def inject_bad_version(
+    version: str, tflops_factor: float = 1.0, crashloop: bool = False
+) -> None:
+    """Register ``version`` as bad: every simulated node running it
+    reports validator perf scaled by ``tflops_factor`` (applied to both
+    TFLOPS and membw GB/s), and with ``crashloop`` its libtpu operand
+    pod flips to CrashLoopBackOff. Deterministic and process-local —
+    the replayable chaos trace carries the same args."""
+    _BAD_VERSIONS[str(version)] = {
+        "tflops_factor": float(tflops_factor),
+        "crashloop": bool(crashloop),
+    }
+
+
+def clear_bad_versions() -> None:
+    _BAD_VERSIONS.clear()
+
+
+def _version_of_image(image: str) -> str:
+    """The tag of an image ref ('' for digests/untagged refs)."""
+    if not image or "@" in image:
+        return ""
+    head, sep, tag = image.rpartition(":")
+    if not sep or "/" in tag:
+        return ""
+    return tag
+
+
+def _libtpu_ds_version(ds: Obj) -> str:
+    for c in ds["spec"]["template"]["spec"].get("containers") or []:
+        v = _version_of_image(c.get("image", "") or "")
+        if v:
+            return v
+    return ""
+
+
+# per-client batched node-agent lane: the TFD/validator role's version
+# label + perf annotation applies ride one update-only SSA batch lane
+# (resurrecting a preempted node via a plain apply would be an invariant
+# disaster, hence update_only)
+_node_agent_lanes: "weakref.WeakKeyDictionary[Client, object]" = (
+    weakref.WeakKeyDictionary()
+)
+
+
+def _node_agent_lane(client: Client):
+    from tpu_operator.kube.apply import batch_flush
+    from tpu_operator.kube.write_pipeline import BatchLane
+
+    lane = _node_agent_lanes.get(client)
+    if lane is None:
+        client_ref = weakref.ref(client)
+
+        def _flush(payloads):
+            c = client_ref()
+            if c is None:
+                raise RuntimeError("kubelet-sim client was garbage-collected")
+            return batch_flush(
+                c,
+                payloads,
+                field_manager=KUBELET_SIM_FIELD_MANAGER,
+                force=True,
+                prune=False,
+                update_only=True,
+            )
+
+        lane = _node_agent_lanes.setdefault(
+            client,
+            BatchLane(
+                _kubelet_pipeline(client),
+                _flush,
+                name="kubelet-node-agents",
+                max_batch=256,
+                shards=2,
+            ),
+        )
+    return lane
+
+
 def make_tpu_node(
     name: str,
     accelerator: str = "tpu-v5-lite-podslice",
@@ -337,6 +432,13 @@ def simulate_kubelet_nodes(
     lane = _kubelet_lane(client)
     futs = []
     halted = False
+    # TFD/validator role inputs gathered during the DS sweep: which
+    # libtpu version each node is effectively running (the version of
+    # its operand pod's revision — a stale OnDelete pod keeps the OLD
+    # version until the FSM restarts it), and its libtpu operand pod for
+    # the bad-version crashloop flip
+    libtpu_version_by_node: dict = {}
+    libtpu_pod_by_node: dict = {}
     for ds in client.list("apps/v1", "DaemonSet", namespace):
         if halted:
             break
@@ -359,6 +461,11 @@ def simulate_kubelet_nodes(
         _stamp_ds_status(client, ds, len(matching))
         on_delete = ds["spec"].get("updateStrategy", {}).get("type") == "OnDelete"
         app, h = _ds_app_and_hash(ds)
+        libtpu_version = (
+            _libtpu_ds_version(ds)
+            if app.startswith("tpu-libtpu-daemonset")
+            else ""
+        )
         # per-node kubelets act in parallel, so the pod fan-out rides
         # the kubelet pipeline's BATCH LANE: writes that are actually
         # needed (missing pod, stale RollingUpdate hash) group-commit
@@ -380,6 +487,22 @@ def simulate_kubelet_nodes(
                 break
             pod_name = f"{app}-{node}"
             existing = pods_by_name.get(pod_name)
+            if libtpu_version:
+                at_current = (
+                    existing is None
+                    or existing["metadata"]
+                    .get("annotations", {})
+                    .get(consts.LAST_APPLIED_HASH_ANNOTATION)
+                    == h
+                )
+                libtpu_pod_by_node[node] = existing
+                libtpu_version_by_node[node] = (
+                    libtpu_version
+                    if at_current
+                    else node_labels[node].get(
+                        consts.TFD_LIBTPU_VERSION_LABEL, ""
+                    )
+                )
             if existing is None:
                 # create-only: a racing create of the same pod (stale
                 # pre-sweep listing) answers AlreadyExists per-item,
@@ -425,6 +548,134 @@ def simulate_kubelet_nodes(
     # contract (sliceman/slice_manager.py reconcile_once), one sweep
     # late so the roll holds its budget unit for at least one interval
     _simulate_slice_manager(client, node_labels)
+    # TFD + node-status-exporter role: version labels, validator-perf
+    # annotations (scaled by injected bad versions), crashloop flips —
+    # write-on-change, so a converged fleet costs zero requests
+    _simulate_node_agents(
+        client, namespace, node_objs, libtpu_version_by_node,
+        libtpu_pod_by_node,
+    )
+
+
+def _simulate_node_agents(
+    client: Client,
+    namespace: str,
+    node_objs: dict,
+    version_by_node: dict,
+    libtpu_pod_by_node: dict,
+) -> None:
+    """TFD + node-status-exporter role for the sim fleet: publish each
+    node's effective libtpu version as ``TFD_LIBTPU_VERSION_LABEL`` and
+    its validator perf readings as the ``validator-perf`` annotation —
+    scaled down by any ``inject_bad_version`` registration — and flip
+    (or restore) CrashLoopBackOff on the libtpu operand of a
+    crashlooping bad version. Only nodes whose libtpu DS carries an
+    image TAG participate (a version-less spec stamps nothing), and
+    every write is on-change only: a converged fleet costs zero
+    requests. Applies ride an update-only batch lane so a node
+    preempted mid-sweep 404s instead of being resurrected."""
+    import json as _json
+
+    from tpu_operator.kube.client import ConflictError, NotFoundError
+
+    lane = None
+    futs = []
+    for name, version in sorted(version_by_node.items()):
+        if not version:
+            continue
+        node = node_objs.get(name)
+        if node is None:
+            continue
+        labels = node["metadata"].get("labels", {}) or {}
+        ann = node["metadata"].get("annotations", {}) or {}
+        fault = _BAD_VERSIONS.get(version) or {}
+        factor = float(fault.get("tflops_factor", 1.0))
+        perf = _json.dumps(
+            {
+                "gbps": round(PERF_BASE_GBPS * factor, 1),
+                "tflops": round(PERF_BASE_TFLOPS * factor, 1),
+                "version": version,
+            },
+            sort_keys=True,
+        )
+        if (
+            labels.get(consts.TFD_LIBTPU_VERSION_LABEL) != version
+            or ann.get(consts.VALIDATOR_PERF_ANNOTATION) != perf
+        ):
+            if lane is None:
+                lane = _node_agent_lane(client)
+            futs.append(
+                lane.submit(
+                    ("Node", "", name),
+                    (
+                        {
+                            "apiVersion": "v1",
+                            "kind": "Node",
+                            "metadata": {
+                                "name": name,
+                                "labels": {
+                                    consts.TFD_LIBTPU_VERSION_LABEL: version
+                                },
+                                "annotations": {
+                                    consts.VALIDATOR_PERF_ANNOTATION: perf
+                                },
+                            },
+                        },
+                        False,
+                    ),
+                )
+            )
+        # crashloop flip/restore on the node's libtpu operand pod (only
+        # a pre-existing pod: one just created this sweep flips on the
+        # NEXT sweep, like a real container needs a start to crash)
+        pod = libtpu_pod_by_node.get(name)
+        if pod is None:
+            continue
+        want_crash = bool(fault.get("crashloop"))
+        is_crash = any(
+            ((cs.get("state") or {}).get("waiting") or {}).get("reason")
+            == "CrashLoopBackOff"
+            for cs in pod.get("status", {}).get("containerStatuses") or []
+        )
+        if want_crash == is_crash:
+            continue
+        body = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": pod["metadata"]["name"],
+                "namespace": namespace,
+            },
+            "status": (
+                {
+                    "phase": "Running",
+                    "containerStatuses": [
+                        {
+                            "ready": False,
+                            "state": {
+                                "waiting": {"reason": "CrashLoopBackOff"}
+                            },
+                        }
+                    ],
+                }
+                if want_crash
+                else {
+                    "phase": "Running",
+                    "containerStatuses": [{"ready": True}],
+                }
+            ),
+        }
+        try:
+            client.update_status(body)
+        except (NotFoundError, ConflictError):
+            continue  # pod churned mid-sweep; next sweep retries
+    if futs:
+        _kubelet_pipeline(client).drain()
+        for fut in futs:
+            try:
+                fut.result()
+            except (NotFoundError, ConflictError):
+                pass  # preempted/contended mid-sweep: next sweep retries
 
 
 def _simulate_slice_manager(client: Client, node_labels: dict) -> None:

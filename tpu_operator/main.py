@@ -253,6 +253,9 @@ def build_manager(
     # live slice re-partition roll: desired layout, rolling/pending
     # slices, budget deferrals (third shared-budget consumer)
     mgr.register_debug_vars("repartition", reconciler.repartition.stats)
+    # health-gated rollout orchestrator: ledger state, stage, failing
+    # evidence, promotion/rollback counters (controllers/rollout.py)
+    mgr.register_debug_vars("rollout", reconciler.rollout.stats)
     # concurrent write pipeline: depth, in-flight, queue wait, errors —
     # one curl answers "are the convergence fan-outs actually wide?"
     mgr.register_debug_vars(
@@ -294,6 +297,16 @@ def wire_event_sources(mgr, client, namespace: str, stop_event=None) -> None:
     # event) a crashloop is a POD event nothing else watches — the
     # reconciler must wake on the transition, in either direction
     crashlooping = set()
+    # nodes with an in-flight upgrade FSM label: while any exist, tpu-*
+    # pod events (operand restarts at the new revision, validator pods
+    # coming up) gate FSM steps and must wake the upgrade reconciler —
+    # waiting out its 120 s requeue per step would stretch a staged
+    # rollout's canary wave to hours. Empty set (the common case) keeps
+    # pod churn from burning upgrade passes at fleet-converge scale.
+    upgrading = set()
+    _upgrade_wake_states = (
+        consts.UPGRADE_STATE_UPGRADE_REQUIRED,
+    ) + tuple(consts.UPGRADE_ACTIVE_STATES)
 
     def on_event(event, obj):
         from tpu_operator.controllers.remediation import pod_crashlooping
@@ -310,6 +323,7 @@ def wire_event_sources(mgr, client, namespace: str, stop_event=None) -> None:
                 # join/preemption storms of unique node names grew this
                 # cache without bound
                 node_cache.pop(name, None)
+                upgrading.discard(name)
                 # a node vanishing mid-upgrade must wake the upgrade
                 # reconciler too: its slice's budget hold releases on
                 # the next build_state, and waiting out the 120 s
@@ -317,6 +331,28 @@ def wire_event_sources(mgr, client, namespace: str, stop_event=None) -> None:
                 mgr.enqueue(UPGRADE_KEY)
             else:
                 node_cache[name] = obj
+                ustate = (
+                    (obj.get("metadata", {}).get("labels") or {}).get(
+                        consts.UPGRADE_STATE_LABEL
+                    )
+                    or ""
+                )
+                old_ustate = (
+                    ((old or {}).get("metadata", {}).get("labels") or {}).get(
+                        consts.UPGRADE_STATE_LABEL
+                    )
+                    or ""
+                )
+                (
+                    upgrading.add
+                    if ustate in _upgrade_wake_states
+                    else upgrading.discard
+                )(name)
+                if ustate != old_ustate:
+                    # an FSM transition landed (ours or another
+                    # replica's): the next step is level-triggered off
+                    # the labels — run it now, not at the 120 s resync
+                    mgr.enqueue(UPGRADE_KEY, delay=0.1)
             if node_event_needs_reconcile(event, old, obj):
                 mgr.enqueue(CP_KEY)
         elif kind == "Pod":
@@ -327,6 +363,11 @@ def wire_event_sources(mgr, client, namespace: str, stop_event=None) -> None:
             app = (meta.get("labels") or {}).get("app") or ""
             if not app.startswith("tpu-"):
                 return
+            if upgrading:
+                # operand/validator pod movement advances FSM steps
+                # (pod-restart completion, validation) — coalesced by
+                # the workqueue, and only while an upgrade is in flight
+                mgr.enqueue(UPGRADE_KEY, delay=0.25)
             key = f"{meta.get('namespace', '')}/{meta.get('name', '')}"
             was = key in crashlooping
             now = event != "DELETED" and pod_crashlooping(obj)

@@ -22,6 +22,12 @@ log = logging.getLogger("tpu-operator.upgrade")
 
 REQUEUE_S = 120.0  # reference :53,163
 
+# FSM states with in-flight work (active steps or awaiting admission):
+# the staged-rollout fast requeue only matters while any node is here
+ACTIVE_WITH_PENDING = tuple(us.ACTIVE_STATES) + (
+    us.STATE_UPGRADE_REQUIRED,
+)
+
 
 @dataclass
 class Result:
@@ -52,9 +58,48 @@ class UpgradeReconciler:
             self.manager.cleanup_state_labels()
             return Result()
 
-        state = self.manager.build_state()
-        self.manager.apply_state(state, pol)
+        # health-gated rollout cohort gate (controllers/rollout.py): a
+        # pure function of the CR's rollout ledger + the slice universe,
+        # so this reconciler and the orchestrator cannot drift and a
+        # restarted operator is gated from its first pass. None =
+        # unrestricted (no staged roll).
+        from tpu_operator.controllers import rollout as ro
+
+        rec = ro.load_record(primary)
+        rolled_back = bool(rec) and rec.get("state") == ro.STATE_ROLLED_BACK
+        # while a rollback is in force, a pending node whose pod already
+        # matches the (re-pinned previous) revision is reset to done
+        # instead of being needlessly cordoned/drained
+        state = self.manager.build_state(reset_in_sync_pending=rolled_back)
+        admit = ro.admission_filter(primary, state.slices.keys())
+        if admit is None:
+            # rolled-back refinement: only slices actually running (or
+            # mid-roll to) the abandoned version re-roll — see
+            # rollback_admission_filter for the window this closes
+            admit = ro.rollback_admission_filter(
+                primary,
+                {
+                    sid: [e.node for e in entries]
+                    for sid, entries in state.fsm_by_slice().items()
+                },
+            )
+        self.manager.apply_state(state, pol, admit_filter=admit)
         self._update_metrics(state, pol)
+        busy = any(
+            e.state in ACTIVE_WITH_PENDING for e in state.all()
+        )
+        if (bool(rec) and rec.get("state") == ro.STATE_ROLLING) or (
+            rolled_back and busy
+        ):
+            # staged roll in flight (or a rollback still re-rolling):
+            # stage promotions and rollback re-pins land as CR
+            # annotation edits (which wake this reconciler), but FSM
+            # step completions need a clock faster than the 2 min
+            # default to keep a canary wave moving. A CONVERGED parked
+            # rollback takes the slow path — days of 5 s full-fleet
+            # passes while the ledger waits for a human would be pure
+            # load.
+            return Result(requeue_after=5.0)
         return Result(requeue_after=REQUEUE_S)
 
     def _update_metrics(self, state: us.ClusterUpgradeState, pol) -> None:

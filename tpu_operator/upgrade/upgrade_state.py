@@ -218,6 +218,8 @@ class NodeStateProvider:
                 consts.UPGRADE_STATE_SINCE_ANNOTATION,
                 consts.UPGRADE_INITIAL_STATE_ANNOTATION,
                 consts.UPGRADE_RETRY_ANNOTATION,
+                consts.UPGRADE_PREVIOUS_VERSION_ANNOTATION,
+                consts.VALIDATOR_PERF_BASELINE_ANNOTATION,
             ):
                 if key in ann:
                     del ann[key]
@@ -478,6 +480,35 @@ FAILED_RETRY_BASE_S = 300.0
 FAILED_RETRY_CAP_S = 3600.0
 
 
+def failed_retry_count(node: Obj) -> int:
+    """The bounded-retry count from ``UPGRADE_RETRY_ANNOTATION`` (0 when
+    absent/garbled) — shared by the retry loop below and the rollout
+    health gate (``controllers/rollout.py``), which must read an
+    exhausted canary node as failure EVIDENCE instead of letting it park
+    silently past ``FAILED_RETRY_MAX`` while the roll stalls."""
+    import json
+
+    raw = (node["metadata"].get("annotations", {}) or {}).get(
+        consts.UPGRADE_RETRY_ANNOTATION, ""
+    )
+    if not raw:
+        return 0
+    try:
+        return int(json.loads(raw).get("count", 0))
+    except (ValueError, AttributeError, TypeError):
+        return 0
+
+
+def failed_retries_exhausted(node: Obj) -> bool:
+    """Whether this node is ``upgrade-failed`` with its auto-retry budget
+    spent — terminal without a human (or a rollout rollback)."""
+    labels = node.get("metadata", {}).get("labels", {}) or {}
+    return (
+        labels.get(consts.UPGRADE_STATE_LABEL) == STATE_FAILED
+        and failed_retry_count(node) >= FAILED_RETRY_MAX
+    )
+
+
 @dataclass
 class SliceBudget:
     """The slice-unit admission arithmetic, computed ONCE and shared by
@@ -582,10 +613,20 @@ class ClusterUpgradeStateManager:
         self.pinned_slices: set = set()
 
     # ------------------------------------------------------------------
-    def build_state(self) -> ClusterUpgradeState:
+    def build_state(
+        self, reset_in_sync_pending: bool = False
+    ) -> ClusterUpgradeState:
         """Group libtpu operand pods per node; nodes whose operand pod runs a
         stale revision (hash mismatch vs the DaemonSet template) need an
-        upgrade (reference ``BuildState``, ``upgrade_state.go:160-212``)."""
+        upgrade (reference ``BuildState``, ``upgrade_state.go:160-212``).
+
+        ``reset_in_sync_pending`` (set by the reconciler ONLY while a
+        rollout rollback is in force): a still-pending node whose pod
+        already matches the desired revision is reset to done — the
+        desired state moved back underneath it, so cordon/drain would be
+        pure disruption. Off by default: on a FORWARD roll a pending
+        node whose pod churned to the new revision must still walk the
+        FSM (slice-coordinated validation + rollback-fact recording)."""
         from tpu_operator.controllers.slice_status import group_slices
 
         state = ClusterUpgradeState()
@@ -670,6 +711,29 @@ class ClusterUpgradeStateManager:
                     current = STATE_DONE
                 else:
                     current = STATE_UNKNOWN
+            elif (
+                reset_in_sync_pending
+                and current == STATE_UPGRADE_REQUIRED
+                and pod is not None
+                and desired_hashes
+                and not self._pod_is_stale(pod, desired_hashes)
+            ):
+                # the desired revision moved back UNDER a still-pending
+                # node (the rollout rollback re-pinned the previous
+                # version before this node was ever admitted): there is
+                # nothing left to roll, and admitting it later would
+                # cordon/drain a current node for pure disruption.
+                # desired_hashes must be NON-empty: _pod_is_stale reads
+                # an empty table as "not stale", and a transient empty
+                # DS listing must not wipe pending nodes to done.
+                try:
+                    self.provider.set_state(node, STATE_DONE)
+                    current = STATE_DONE
+                except (NotFoundError, ConflictError):
+                    log.warning(
+                        "node %s: pending-reset write failed; deferring",
+                        node_name,
+                    )
             entry = NodeUpgradeState(node=node, driver_pod=pod, state=current)
             state.node_states.setdefault(current, []).append(entry)
         state.slices = group_slices(managed_nodes)
@@ -742,8 +806,37 @@ class ClusterUpgradeStateManager:
             )
             return False
 
+    def _admit_node(self, ns: NodeUpgradeState) -> bool:
+        """Promote one pending member into the roll. Before the state
+        flip, record the ROLLBACK FACTS as durable node annotations: the
+        version the node runs right now (the rollout orchestrator's
+        rollback target) and a pre-roll copy of the validator perf
+        readings (the baseline its health gate measures TFLOPS/membw
+        deltas against). Both survive operator restarts like every other
+        FSM fact."""
+
+        def step(ns):
+            node = ns.node
+            labels = node["metadata"].get("labels", {}) or {}
+            ann = node["metadata"].get("annotations", {}) or {}
+            prev = labels.get(consts.TFD_LIBTPU_VERSION_LABEL, "")
+            if prev:
+                self.provider.set_annotation(
+                    node, consts.UPGRADE_PREVIOUS_VERSION_ANNOTATION, prev
+                )
+            perf = ann.get(consts.VALIDATOR_PERF_ANNOTATION, "")
+            if perf:
+                self.provider.set_annotation(
+                    node, consts.VALIDATOR_PERF_BASELINE_ANNOTATION, perf
+                )
+            self.provider.set_state(node, STATE_CORDON_REQUIRED)
+
+        return self._node_step(ns, step)
+
     # ------------------------------------------------------------------
-    def apply_state(self, state: ClusterUpgradeState, policy) -> None:
+    def apply_state(
+        self, state: ClusterUpgradeState, policy, admit_filter=None
+    ) -> None:
         """Advance the FSM one step per pass, throttled by
         maxParallelUpgrades/maxUnavailable counted in SLICES (reference
         ``ApplyState`` redesigned at slice granularity): a multi-host
@@ -752,7 +845,12 @@ class ClusterUpgradeStateManager:
         arrives, advance past validation only when the WHOLE slice
         re-validates, and uncordon together. A PDB veto on any member
         pins the whole slice in drain. Single-host nodes are slices of
-        one, which degenerates to the reference's per-node behavior."""
+        one, which degenerates to the reference's per-node behavior.
+
+        ``admit_filter`` (optional set of slice ids) restricts FRESH
+        admissions to the named slices — the health-gated rollout
+        orchestrator's cohort gate (``controllers/rollout.py``). Slices
+        already mid-roll always finish; only entry is staged."""
         total = len(state.all())
         if total == 0:
             self.pinned_slices = set()
@@ -771,29 +869,24 @@ class ClusterUpgradeStateManager:
         for sid in sorted(active_sids):
             for ns in groups[sid]:
                 if ns.state == STATE_UPGRADE_REQUIRED:
-                    self._node_step(
-                        ns,
-                        lambda ns: self.provider.set_state(
-                            ns.node, STATE_CORDON_REQUIRED
-                        ),
-                    )
+                    self._admit_node(ns)
 
         # admission: a slice enters as ONE unit within the slice budget
         admit = budget.admit
         for sid in sorted(budget.pending_sids):
             if admit <= 0:
                 break
+            if admit_filter is not None and sid not in admit_filter:
+                # outside the rollout's current cohort: the slice waits
+                # for its wave (level-triggered — the gate widens when
+                # the orchestrator promotes a stage)
+                continue
             pending = [
                 e for e in groups[sid] if e.state == STATE_UPGRADE_REQUIRED
             ]
             promoted = 0
             for ns in pending:
-                if self._node_step(
-                    ns,
-                    lambda ns: self.provider.set_state(
-                        ns.node, STATE_CORDON_REQUIRED
-                    ),
-                ):
+                if self._admit_node(ns):
                     promoted += 1
             if promoted:
                 admit -= 1
@@ -1157,13 +1250,7 @@ class ClusterUpgradeStateManager:
                         name,
                     )
                 continue
-            raw = (node["metadata"].get("annotations", {}) or {}).get(
-                consts.UPGRADE_RETRY_ANNOTATION, ""
-            )
-            try:
-                count = int(json.loads(raw).get("count", 0)) if raw else 0
-            except (ValueError, AttributeError, TypeError):
-                count = 0
+            count = failed_retry_count(node)
             if count >= FAILED_RETRY_MAX:
                 continue  # retries exhausted: human intervention only
             delay = min(FAILED_RETRY_CAP_S, FAILED_RETRY_BASE_S * (2**count))

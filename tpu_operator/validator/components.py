@@ -270,6 +270,12 @@ def validate_jax(
 
         pod = workload_pods.jax_workload_pod(node_name, namespace)
         result = workload_pods.run_to_completion(client, pod)
+        # canonical FLAT payload schema: perf fields (tflops, ...) live
+        # top-level when known (validator/metrics.py payload_perf reads
+        # only that shape, with a one-release legacy-nested fallback).
+        # The workload-pod path records the pod OUTCOME only — the
+        # matmul numbers stay in the pod's own logs, so this payload
+        # carries no perf fields and the exporter publishes none
         info = {"workload": pod["metadata"]["name"], "result": result}
     else:
         from tpu_operator.workloads.matmul import run_matmul_validation

@@ -9,6 +9,7 @@ counting TPU PCI devices (60 s) (``validator/metrics.go:159-301``).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 
@@ -20,6 +21,47 @@ from tpu_operator.validator.components import (
 )
 
 log = logging.getLogger("tpu-validator.metrics")
+
+# one-release legacy-shape fallback noted once per process, not once per
+# 30 s watch tick
+_legacy_payload_logged = False
+
+
+def payload_perf(payload) -> dict:
+    """Canonical read of a validation status payload's performance
+    fields. The CANONICAL schema is FLAT: ``{"tflops": x, ...}`` for the
+    jax payload (``workloads/matmul.py`` ``to_dict``) and
+    ``{"gbps": y, ...}`` for the membw payload — every writer now emits
+    it (``validator/components.py``). One release of fallback remains
+    for the legacy nested ``{"result": {"tflops": ...}}`` shape some
+    older workload-pod payloads carried, with a log-once so operators
+    notice before the fallback is removed."""
+    global _legacy_payload_logged
+    if not isinstance(payload, dict):
+        return {}
+    out = {}
+    for key in ("tflops", "gbps"):
+        value = payload.get(key)
+        if value is None:
+            nested = payload.get("result")
+            if isinstance(nested, dict) and nested.get(key) is not None:
+                value = nested[key]
+                if not _legacy_payload_logged:
+                    _legacy_payload_logged = True
+                    log.warning(
+                        "validation payload uses the legacy nested "
+                        "result.%s shape; emit the flat canonical schema "
+                        "(top-level %s) — this fallback is removed next "
+                        "release",
+                        key,
+                        key,
+                    )
+        if value is not None:
+            try:
+                out[key] = float(value)
+            except (TypeError, ValueError):
+                pass
+    return out
 
 
 class NodeMetrics:
@@ -96,17 +138,72 @@ class NodeMetrics:
                     1 if self.status.exists(name) else 0
                 )
             # surface the recorded TFLOPS from the jax status payload
+            # (canonical flat schema; payload_perf keeps the one-release
+            # legacy-nested fallback with a log-once)
+            perf = {}
             try:
                 import json
 
                 with open(self.status.path(consts.STATUS_FILE_JAX)) as f:
                     payload = json.load(f)
-                tflops = payload.get("tflops") or payload.get("result", {}).get("tflops")
-                if tflops:
-                    self.g_jax_tflops.labels(node=self.node_name).set(float(tflops))
+                perf.update(payload_perf(payload))
+                if perf.get("tflops"):
+                    self.g_jax_tflops.labels(node=self.node_name).set(
+                        perf["tflops"]
+                    )
             except Exception:
                 pass
+            try:
+                import json
+
+                with open(self.status.path("membw-ready")) as f:
+                    perf.update(payload_perf(json.load(f)))
+            except Exception:
+                pass
+            self._publish_perf_annotation(perf)
             self._stop.wait(self.WATCH_STATUS_S)
+
+    def _publish_perf_annotation(self, perf: dict) -> None:
+        """Publish the node's live validator perf readings as the
+        ``tpu.k8s.io/validator-perf`` annotation — the evidence surface
+        the rollout health gate (``controllers/rollout.py``) compares
+        against its pre-roll baseline. The ``version`` field tags which
+        libtpu produced the readings (the gate only compares readings
+        taken AT the roll target): ``LIBTPU_VERSION`` env when the
+        deployment injects it, else the node's own TFD version label —
+        read inside the conflict-retried mutate so the tag always
+        matches the node revision the write lands on. One GET per 30 s
+        tick, a write only on change."""
+        if self.client is None or not self.node_name or not perf:
+            return
+        import json
+
+        from tpu_operator.kube.client import mutate_with_retry
+
+        base = {k: round(v, 1) for k, v in sorted(perf.items())}
+        env_version = os.environ.get("LIBTPU_VERSION", "")
+
+        def mutate(node):
+            doc = dict(base)
+            labels = node["metadata"].get("labels", {}) or {}
+            version = env_version or labels.get(
+                consts.TFD_LIBTPU_VERSION_LABEL, ""
+            )
+            if version:
+                doc["version"] = version
+            desired = json.dumps(doc, sort_keys=True)
+            ann = node["metadata"].setdefault("annotations", {})
+            if ann.get(consts.VALIDATOR_PERF_ANNOTATION) == desired:
+                return False
+            ann[consts.VALIDATOR_PERF_ANNOTATION] = desired
+            return True
+
+        try:
+            mutate_with_retry(
+                self.client, "v1", "Node", self.node_name, mutate=mutate
+            )
+        except Exception:
+            log.exception("validator-perf annotation publish failed")
 
     def _watch_libtpu(self):
         """Live re-validation: OPEN-probe every device, not just stat it.

@@ -28,7 +28,8 @@ DOCKER_PUSH_TARGETS = $(patsubst %,docker-push-%,$(IMAGES))
 # variable expands to nothing and silently un-phonies the fan-out
 .PHONY: all native test crd bundle release-bundle validate lint clean \
 	dev-run dev-run-kubesim soak bench bench-gate bench-converge \
-	bench-alloc obs-fast chaos-fast chaos-soak-fast chaos-soak \
+	bench-churn bench-alloc obs-fast chaos-fast chaos-soak-fast \
+	chaos-soak \
 	builder docker-build \
 	docker-push $(DOCKER_BUILD_TARGETS) $(DOCKER_PUSH_TARGETS)
 
@@ -77,6 +78,7 @@ validate:
 	$(MAKE) obs-fast
 	$(MAKE) bench-gate
 	$(MAKE) bench-converge
+	$(MAKE) bench-churn
 	$(MAKE) bench-warm
 	$(MAKE) bench-alloc
 	$(MAKE) chaos-fast
@@ -117,6 +119,14 @@ bench-converge:
 # loaded (a silent cold-start fallback trips the re-list assertion)
 bench-warm:
 	python -m pytest tests/test_warm_bench.py -q -m slow -p no:cacheprovider
+
+# CI churn-storm gate: 32 nodes' chip health flapping at 1000 nodes,
+# per-event reconcile self-time through the event-scoped delta router
+# vs the router-disabled full-pass-per-trigger baseline (same box,
+# min-of-rounds) — the delta path must win by >= 5x, with zero full
+# passes on the delta rounds and every flap converged in both modes
+bench-churn:
+	python -m pytest tests/test_churn_bench.py -q -m slow -p no:cacheprovider
 
 # CI allocation gate: 1000-node scheduling churn through the real
 # device-plugin path, concurrent with convergence and a remediation

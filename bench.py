@@ -477,6 +477,7 @@ def run_fleet_convergence(
     preempt_pct: float = 0.0,
     warm_restart: bool = False,
     rollout: bool = False,
+    churn_storm: int = 0,
 ) -> dict:
     """Fleet-scale time-to-Ready: an ``n_nodes`` pool converged by the
     full Manager against the kubesim apiserver with a faithful per-node
@@ -500,6 +501,8 @@ def run_fleet_convergence(
         args += ["--preempt-pct", str(preempt_pct)]
     if warm_restart:
         args += ["--warm-restart"]
+    if churn_storm:
+        args += ["--churn-storm", str(churn_storm)]
     if rollout:
         args += ["--rollout"]
     # the script applies --timeout PER PHASE (initial converge, join
@@ -811,6 +814,13 @@ def main() -> int:
     fleet_rollout = run_fleet_convergence(
         n_nodes=1000, timeout_s=600, rollout=True
     )
+    # churn-storm axis (ISSUE 13): 32 nodes' chip health flapping at
+    # 1000 nodes — per-event reconcile cost through the event-scoped
+    # delta router vs the full-pass-per-trigger baseline on the same
+    # box; churn_speedup is the tracked O(events)-not-O(fleet) metric
+    fleet_churn = run_fleet_convergence(
+        n_nodes=1000, timeout_s=600, churn_storm=32
+    )
 
     # ICI axis last: it re-binds JAX to the CPU mesh
     ici = run_ici_on_cpu_mesh()
@@ -858,6 +868,7 @@ def main() -> int:
         "alloc_churn_1000": alloc_churn,
         "fleet_join_storm_1000": fleet_join_storm,
         "fleet_rollout_1000": fleet_rollout,
+        "fleet_churn_storm_1000": fleet_churn,
         "validator_cli": validator_cli,
         "flashattn": {
             "ok": bool(fa.ok),
@@ -944,6 +955,7 @@ def main() -> int:
         and alloc_churn.get("ok")
         and fleet_join_storm.get("ok")
         and fleet_rollout.get("ok")
+        and fleet_churn.get("ok")
         and validator_cli.get("ok")
         and fa.ok
         and fa_gate_ok

@@ -148,6 +148,22 @@ def main(argv=None) -> int:
         "the fleet-wide staged-roll completion axis",
     )
     p.add_argument(
+        "--churn-storm",
+        type=int,
+        default=0,
+        help="after convergence, flap this many nodes' chips (kubelet "
+        "health edges -> watch events) twice each mode: once through "
+        "the event-scoped delta router and once with the router "
+        "disabled (full pass per trigger) — a same-box A/B of per-event "
+        "reconcile cost; churn_speedup reports delta's advantage",
+    )
+    p.add_argument(
+        "--churn-rounds",
+        type=int,
+        default=2,
+        help="storm rounds per mode; per-event cost is min-of-rounds",
+    )
+    p.add_argument(
         "--trace-out",
         default=None,
         help="enable reconcile tracing (tpu_operator/obs/trace.py) for "
@@ -499,6 +515,125 @@ def main(argv=None) -> int:
         pump_halt.set()
         ok = ok and rollout_time is not None
 
+    # -- churn-storm axis (ISSUE 13): N nodes' chip health flapping ->
+    # per-event reconcile cost, delta router vs full-pass-per-trigger on
+    # the same box. Cost is measured as reconcile SELF time (the delta
+    # sub-reconciles' cumulative wall + full passes' cumulative wall)
+    # divided by the storm's state transitions, min-of-rounds per mode.
+    churn = None
+    churn_ok = True
+    if ok and args.churn_storm > 0:
+        victims = nodes[: min(args.churn_storm, len(nodes))]
+        orig_chips = {}
+        for v in victims:
+            node = client.get_or_none("v1", "Node", v) or {}
+            try:
+                orig_chips[v] = int(
+                    (node.get("status", {}).get("capacity") or {}).get(
+                        "google.com/tpu", "8"
+                    )
+                )
+            except (TypeError, ValueError):
+                orig_chips[v] = 8
+
+        def _slice_verdict(victim):
+            node = client.get_or_none("v1", "Node", victim) or {}
+            return (
+                node.get("metadata", {}).get("labels") or {}
+            ).get(_c.SLICE_READY_LABEL)
+
+        def _wait_verdict(victim, want, timeout=30.0):
+            deadline_v = time.monotonic() + timeout
+            while time.monotonic() < deadline_v:
+                if _slice_verdict(victim) == want:
+                    return True
+                time.sleep(0.005)
+            return False
+
+        def _storm_round():
+            delta0 = reconciler.delta.stats()
+            full_ms0 = reconciler.full_ms_total
+            passes0 = reconciler.passes_total
+            events = 0
+            round_ok = True
+            t0_round = time.monotonic()
+            for v in victims:
+                server.sim.kill_node_chips(v)
+                round_ok = _wait_verdict(v, "false") and round_ok
+                server.sim.restore_node_chips(v, orig_chips[v])
+                round_ok = _wait_verdict(v, "true") and round_ok
+                events += 2
+            delta1 = reconciler.delta.stats()
+            spent_ms = (
+                delta1["delta_ms_total"]
+                - delta0["delta_ms_total"]
+                + reconciler.full_ms_total
+                - full_ms0
+            )
+            return {
+                "ok": round_ok,
+                "events": events,
+                "wall_s": round(time.monotonic() - t0_round, 2),
+                "reconcile_ms": round(spent_ms, 1),
+                "per_event_ms": round(spent_ms / max(1, events), 3),
+                "delta_passes": delta1["delta_passes"]
+                - delta0["delta_passes"],
+                "full_passes": reconciler.passes_total - passes0,
+            }
+
+        def _quiesce(timeout=90.0):
+            # drain the workqueue (convergence-tail events, the other
+            # mode's stragglers) so a round measures ONLY its own storm
+            deadline_q = time.monotonic() + timeout
+            while time.monotonic() < deadline_q:
+                # busy_len is the queue's own processing set — unlike
+                # the watchdog bracket it can't report idle between a
+                # worker's get() and its in-flight bookkeeping
+                if mgr.queue.due_len() == 0 and mgr.queue.busy_len() == 0:
+                    return True
+                time.sleep(0.05)
+            return False
+
+        def _storm(mode_enabled):
+            mgr.router.enabled = mode_enabled
+            rounds_out = []
+            for _ in range(max(1, args.churn_rounds)):
+                # a round that never drained is contaminated by the
+                # previous mode's stragglers — flag it instead of
+                # letting it skew the A/B as if it measured cleanly
+                drained = _quiesce()
+                result = _storm_round()
+                result["ok"] = result["ok"] and drained
+                rounds_out.append(result)
+            return rounds_out
+
+        # delta mode first (the shipped default), then the baseline:
+        # router off routes every event to the full-pass barrier key
+        was_enabled = mgr.router.enabled
+        delta_rounds = _storm(True)
+        baseline_rounds = _storm(False)
+        mgr.router.enabled = was_enabled
+        delta_cost = min(r["per_event_ms"] for r in delta_rounds)
+        baseline_cost = min(r["per_event_ms"] for r in baseline_rounds)
+        churn_ok = all(
+            r["ok"] for r in delta_rounds + baseline_rounds
+        )
+        churn = {
+            "churn_storm_nodes": len(victims),
+            "churn_events_per_round": delta_rounds[0]["events"],
+            "churn_delta_per_event_ms": delta_cost,
+            "churn_baseline_per_event_ms": baseline_cost,
+            "churn_speedup": (
+                round(baseline_cost / delta_cost, 1)
+                if delta_cost > 0
+                else None
+            ),
+            "churn_delta_rounds": delta_rounds,
+            "churn_baseline_rounds": baseline_rounds,
+            "churn_delta_stats": reconciler.delta.stats(),
+        }
+        ok = ok and churn_ok
+
     converge_requests = server.sim.requests_total()
     # write-volume view of the same converge: how many mutations it
     # took and what each one cost in wall time — the number the write
@@ -607,7 +742,13 @@ def main(argv=None) -> int:
         # aborted by the halt can leave trailing drift that the next
         # pass or two repairs) and re-save the journal against the
         # settled world; only then is a restarted operator's write an
-        # actual warm-path bug
+        # actual warm-path bug. mgr.stop() froze the informer watch
+        # threads with whatever events were still on the wire — repair
+        # the cache from live LISTs first so the settle passes converge
+        # the REAL world, not the freeze-time snapshot
+        resync_fn = getattr(mgr.client, "resync_once", None)
+        if callable(resync_fn):
+            resync_fn(ignore_stop=True)
         for _ in range(10):
             before_q = server.sim.requests_total()
             try:
@@ -731,6 +872,9 @@ def main(argv=None) -> int:
             out["trace_out"] = args.trace_out
         except Exception:
             out["trace_out"] = None
+    if churn is not None:
+        out.update(churn)
+        out["churn_ok"] = churn_ok
     if warm is not None:
         out.update(warm)
         out["warm_ok"] = warm_ok

@@ -41,6 +41,9 @@ REQUIRED_KEYS = {
     # observability subsystem (ISSUE 10)
     "trace",
     "flight",
+    # event-scoped delta reconciliation (ISSUE 13): delta-vs-full pass
+    # counts, cumulative self-time, router trigger/drop disposition
+    "delta_reconcile",
 }
 
 

@@ -1,0 +1,375 @@
+"""Event-scoped delta reconciliation (ISSUE 13): router predicates,
+targeted node/slice sub-reconciles converging WITHOUT a full pass,
+event-speed ledger pruning on node deletes, and the resync safety net
+converging a delta the router never saw."""
+
+import os
+import threading
+import time
+
+import pytest
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+from tpu_operator import consts
+from tpu_operator.controllers import delta as delta_mod
+from tpu_operator.kube import FakeClient
+from tpu_operator.kube.testing import (
+    make_tpu_node,
+    sample_clusterpolicy_path,
+    simulate_kubelet_once,
+)
+
+NS = "tpu-operator"
+CPV = consts.API_VERSION
+
+
+def _make_client(node_names=("fake-tpu-node-1",), topology="2x2"):
+    import yaml
+
+    client = FakeClient(
+        [
+            {
+                "apiVersion": "v1",
+                "kind": "Namespace",
+                "metadata": {"name": NS},
+            },
+            *[
+                make_tpu_node(n, topology=topology) for n in node_names
+            ],
+        ]
+    )
+    with open(sample_clusterpolicy_path()) as f:
+        cr = yaml.safe_load(f)
+    cr["metadata"]["uid"] = "fake-uid"
+    client.create(cr)
+    return client
+
+
+def _converge(reconciler, client, rounds=30):
+    res = None
+    for _ in range(rounds):
+        res = reconciler.reconcile()
+        simulate_kubelet_once(client, NS)
+        if res.ready:
+            break
+    assert res is not None and res.ready, "fake cluster never converged"
+    return res
+
+
+def _reconciler(client):
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        ClusterPolicyReconciler,
+    )
+
+    return ClusterPolicyReconciler(client)
+
+
+def _node_labels(client, name):
+    return (
+        client.get("v1", "Node", name).get("metadata", {}).get("labels")
+        or {}
+    )
+
+
+# ---------------------------------------------------------------------------
+# router predicates
+# ---------------------------------------------------------------------------
+
+
+class _MgrStub:
+    def __init__(self):
+        self.enqueued = []
+
+    def enqueue(self, key, delay=0.0):
+        self.enqueued.append(key)
+
+    def take(self):
+        out, self.enqueued = self.enqueued, []
+        return out
+
+
+def _router():
+    client = _make_client()
+    rec = _reconciler(client)
+    mgr = _MgrStub()
+    router = delta_mod.EventRouter(mgr, rec.delta, "cp", "upgrade")
+    router.enabled = True  # independent of the env knob
+    return client, rec, mgr, router
+
+
+def test_router_drops_noop_and_status_only_deliveries():
+    client, rec, mgr, router = _router()
+    cp = client.get(CPV, "ClusterPolicy", "cluster-policy", copy=True)
+    router.on_event("MODIFIED", cp)
+    assert mgr.take() == ["cp", "upgrade"]  # first sighting: full
+    # status-only echo (our own status writer bouncing back): dropped
+    cp2 = client.get(CPV, "ClusterPolicy", "cluster-policy", copy=True)
+    cp2.setdefault("status", {})["state"] = "ready"
+    cp2["metadata"]["resourceVersion"] = "999999"
+    router.on_event("MODIFIED", cp2)
+    assert mgr.take() == []
+    # a spec edit IS significant
+    cp3 = client.get(CPV, "ClusterPolicy", "cluster-policy", copy=True)
+    cp3["spec"]["metricsExporter"] = {"enabled": False}
+    router.on_event("MODIFIED", cp3)
+    assert mgr.take() == ["cp", "upgrade"]
+
+    node = client.get("v1", "Node", "fake-tpu-node-1", copy=True)
+    router.on_event("MODIFIED", node)
+    assert mgr.take() == ["cp"]  # unknown node: full (safe)
+    # byte-identical re-delivery: dropped by the predicate
+    router.on_event("MODIFIED", node)
+    assert mgr.take() == []
+    stats = router.stats()
+    assert stats["dropped_total"] >= 2
+
+
+def test_router_maps_events_to_minimal_keys():
+    client, rec, mgr, router = _router()
+    name = "fake-tpu-node-1"
+    node = client.get("v1", "Node", name, copy=True)
+    router.on_event("MODIFIED", node)  # seed the cache
+    mgr.take()
+    # kubelet-derived chip health change -> that node + its slice, NOT
+    # the fleet-wide pass
+    import copy
+
+    souring = copy.deepcopy(node)
+    souring["status"]["capacity"] = {consts.TPU_RESOURCE: "4"}
+    souring["status"]["allocatable"] = {consts.TPU_RESOURCE: "0"}
+    router.on_event("MODIFIED", souring)
+    keys = mgr.take()
+    # a status-only chip-health change routes straight to the slice
+    # aggregate: the node's own label step has nothing to recompute
+    assert keys == [(delta_mod.SLICE_KIND, name)]
+    # an operator-label-only change -> node key only
+    relabel = copy.deepcopy(souring)
+    relabel["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "stale"
+    router.on_event("MODIFIED", relabel)
+    keys = mgr.take()
+    assert keys == [(delta_mod.NODE_KIND, name)]
+    # generation flip changes cluster facts -> full pass
+    regen = copy.deepcopy(relabel)
+    regen["metadata"]["labels"][
+        consts.GKE_TPU_ACCELERATOR_LABEL
+    ] = "tpu-v5p-slice"
+    router.on_event("MODIFIED", regen)
+    assert "cp" in mgr.take()
+    # DELETE routes through the keyed queue (ledger prune + slice
+    # regroup at event speed) plus the upgrade wake
+    router.on_event("DELETED", regen)
+    keys = mgr.take()
+    assert "upgrade" in keys
+    assert (delta_mod.NODE_KIND, name) in keys
+    assert any(
+        k for k in keys if isinstance(k, tuple) and k[0] == delta_mod.SLICE_KIND
+    )
+    assert "cp" not in keys
+
+
+def test_router_routes_validator_pod_flips_to_slice_key():
+    client, rec, mgr, router = _router()
+    name = "fake-tpu-node-1"
+    node = client.get("v1", "Node", name, copy=True)
+    router.on_event("MODIFIED", node)
+    mgr.take()
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": "tpu-operator-validator-x",
+            "namespace": NS,
+            "labels": {"app": "tpu-operator-validator"},
+        },
+        "spec": {"nodeName": name},
+        "status": {"phase": "Running"},
+    }
+    router.on_event("MODIFIED", pod)
+    keys = mgr.take()
+    assert len(keys) == 1 and keys[0][0] == delta_mod.SLICE_KIND
+    # re-delivery with no transition: dropped
+    router.on_event("MODIFIED", pod)
+    assert mgr.take() == []
+    # not-Running transition flips back -> slice key again
+    gone = dict(pod, status={"phase": "Pending"})
+    router.on_event("MODIFIED", gone)
+    keys = mgr.take()
+    assert len(keys) == 1 and keys[0][0] == delta_mod.SLICE_KIND
+    # a non-operand pod never routes anywhere
+    router.on_event(
+        "MODIFIED",
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "web", "labels": {"app": "web"}},
+            "spec": {"nodeName": name},
+        },
+    )
+    assert mgr.take() == []
+
+
+# ---------------------------------------------------------------------------
+# targeted sub-reconciles: converge the keyed unit, never the fleet
+# ---------------------------------------------------------------------------
+
+
+def test_delta_node_step_restores_labels_without_full_pass():
+    client = _make_client()
+    rec = _reconciler(client)
+    _converge(rec, client)
+    name = "fake-tpu-node-1"
+    assert _node_labels(client, name).get(consts.TPU_PRESENT_LABEL) == "true"
+    passes = rec.passes_total
+    # an external actor strips the operator label
+    node = client.get("v1", "Node", name, copy=True)
+    del node["metadata"]["labels"][consts.TPU_PRESENT_LABEL]
+    client.update(node)
+    rec.delta.reconcile_node(name)
+    assert _node_labels(client, name).get(consts.TPU_PRESENT_LABEL) == "true"
+    assert rec.passes_total == passes, "delta path ran a full pass"
+    assert rec.delta.stats()["node_passes"] >= 1
+
+
+def test_delta_slice_flip_updates_verdict_and_status():
+    from tpu_operator.kube.testing import make_validator_pod
+
+    client = _make_client()
+    rec = _reconciler(client)
+    _converge(rec, client)
+    name = "fake-tpu-node-1"
+    client.create(make_validator_pod(name, True, NS))
+    rec.reconcile()  # full pass seeds the slice mirror as ready
+    assert _node_labels(client, name).get(consts.SLICE_READY_LABEL) == "true"
+    cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
+    ready_before = cp["status"]["slices"]["ready"]
+    assert ready_before >= 1
+    passes = rec.passes_total
+    # the validator pod dies -> its slice (and only it) must flip
+    pods = client.list(
+        "v1", "Pod", NS, label_selector={"app": "tpu-operator-validator"}
+    )
+    assert pods
+    victim = pods[0]
+    client.delete("v1", "Pod", victim["metadata"]["name"], NS)
+    sid = name  # single-host slice: the node is its own slice
+    rec.delta.reconcile_slice(sid)
+    assert _node_labels(client, name).get(consts.SLICE_READY_LABEL) == "false"
+    cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
+    assert cp["status"]["slices"]["ready"] == ready_before - 1
+    # the validator returns; the delta pass restores the verdict
+    client.create(make_validator_pod(name, True, NS))
+    rec.delta.reconcile_slice(sid)
+    assert _node_labels(client, name).get(consts.SLICE_READY_LABEL) == "true"
+    cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
+    assert cp["status"]["slices"]["ready"] == ready_before
+    assert rec.passes_total == passes, "delta path ran a full pass"
+    assert rec.delta.stats()["slice_passes"] >= 2
+
+
+def test_node_delete_prunes_stale_verdicts_at_event_speed():
+    """Regression (ISSUE 13 satellite): a deleted node's remediation
+    log-once ledger and its slice's status entry must prune when the
+    DELETE event lands — not when the next full pass happens by."""
+    client = _make_client(("fleet-a", "fleet-b"))
+    rec = _reconciler(client)
+    _converge(rec, client)
+    cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
+    assert cp["status"]["slices"]["total"] == 2
+    passes = rec.passes_total
+    # a quarantine-era suppression entry for the node
+    rec.remediation._logged.add(("fleet-b", "interlock"))
+    rec.remediation._logged.add(("fleet-b", "budget"))
+    rec.remediation._logged.add(("fleet-a", "pdb"))
+    client.delete("v1", "Node", "fleet-b")
+    rec.delta.reconcile_node("fleet-b")
+    assert ("fleet-b", "interlock") not in rec.remediation._logged
+    assert ("fleet-b", "budget") not in rec.remediation._logged
+    assert ("fleet-a", "pdb") in rec.remediation._logged  # untouched
+    cp = client.get(CPV, "ClusterPolicy", "cluster-policy")
+    assert cp["status"]["slices"]["total"] == 1
+    assert rec.passes_total == passes, "delta path ran a full pass"
+
+
+def test_delta_without_context_escalates_to_full():
+    client = _make_client()
+    rec = _reconciler(client)
+    woken = []
+    rec.delta.wake_full = lambda delay=0.0: woken.append(delay)
+    rec.delta.reconcile_node("fake-tpu-node-1")
+    assert woken, "missing-context delta did not wake the full pass"
+    assert rec.delta.stats()["escalations"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# resync safety net
+# ---------------------------------------------------------------------------
+
+
+def test_resync_safety_net_converges_dropped_delta(monkeypatch):
+    """With NO event wiring at all (every delta 'dropped'), the
+    low-frequency full-pass resync alone must still converge an external
+    change — the delta path is an accelerator, never a correctness
+    dependency."""
+    monkeypatch.setenv("RECONCILE_RESYNC_S", "0.3")
+    from tpu_operator.main import build_manager
+
+    client = _make_client()
+    mgr, rec, _ = build_manager(
+        client, NS, metrics_port=0, probe_port=0
+    )
+    halt = threading.Event()
+
+    def kubelet():
+        while not halt.is_set():
+            try:
+                simulate_kubelet_once(client, NS)
+            except Exception:
+                pass
+            halt.wait(0.05)
+
+    threading.Thread(target=kubelet, daemon=True).start()
+    mgr.start()
+    try:
+        mgr.enqueue("clusterpolicy")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            cp = client.get_or_none(CPV, "ClusterPolicy", "cluster-policy")
+            if (cp or {}).get("status", {}).get("state") == "ready":
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("never converged")
+        # external label strip with no watcher feeding the queue:
+        # only the resync re-add can notice
+        node = client.get("v1", "Node", "fake-tpu-node-1", copy=True)
+        del node["metadata"]["labels"][consts.TPU_PRESENT_LABEL]
+        client.update(node)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if (
+                _node_labels(client, "fake-tpu-node-1").get(
+                    consts.TPU_PRESENT_LABEL
+                )
+                == "true"
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("resync safety net never converged the strip")
+    finally:
+        halt.set()
+        mgr.stop()
+
+
+def test_worker_pool_env_knobs(monkeypatch):
+    from tpu_operator.manager import Manager, default_workers
+
+    assert default_workers() >= 1
+    monkeypatch.setenv("RECONCILE_WORKERS", "1")
+    mgr = Manager(FakeClient(), NS, metrics_port=0, probe_port=0)
+    assert mgr.workers == 1
+    monkeypatch.setenv("RECONCILE_WORKERS", "6")
+    mgr = Manager(FakeClient(), NS, metrics_port=0, probe_port=0)
+    assert mgr.workers == 6

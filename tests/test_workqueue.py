@@ -91,6 +91,145 @@ def test_add_wakes_blocked_getter():
 
 
 # ---------------------------------------------------------------------------
+# multi-worker semantics (ISSUE 13): processing set, barrier keys,
+# same-key coalescing across task_done
+# ---------------------------------------------------------------------------
+
+
+def test_readd_while_processing_coalesces_to_one_rerun():
+    """A burst of same-key events landing while a worker runs that key
+    must produce exactly ONE re-execution after completion — never a
+    concurrent one, never five."""
+    q = WorkQueue()
+    q.add("a")
+    assert q.get(timeout=0) == "a"  # in flight now
+    for _ in range(5):
+        q.add("a")
+    # the key is processing: nothing dispatchable yet
+    assert q.get(timeout=0) is None
+    q.task_done("a")
+    assert q.get(timeout=0) == "a"  # exactly one coalesced re-run
+    q.task_done("a")
+    assert q.get(timeout=0) is None
+
+
+def test_same_key_never_concurrent_under_workers():
+    """N workers hammering a small key set: the processing set must keep
+    one key on one worker at a time while different keys overlap."""
+    q = WorkQueue()
+    active = {}
+    overlaps = []
+    distinct_concurrency = []
+    lock = threading.Lock()
+    done = threading.Event()
+    executed = [0]
+
+    def worker():
+        while not done.is_set():
+            item = q.get(timeout=0.05)
+            if item is None:
+                continue
+            with lock:
+                if active.get(item):
+                    overlaps.append(item)
+                active[item] = True
+                distinct_concurrency.append(
+                    sum(1 for v in active.values() if v)
+                )
+            time.sleep(0.002)
+            with lock:
+                active[item] = False
+                executed[0] += 1
+            q.task_done(item)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for i in range(120):
+        q.add(f"k{i % 3}")
+        time.sleep(0.001)
+    deadline = time.monotonic() + 10
+    while executed[0] < 30 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    done.set()
+    for t in threads:
+        t.join(timeout=2)
+    assert not overlaps, f"same key ran concurrently: {overlaps}"
+    assert executed[0] >= 30
+    # different keys genuinely overlapped at least once (3 keys, 4
+    # workers, adds faster than execution)
+    assert max(distinct_concurrency) >= 2
+
+
+def test_barrier_key_gets_exclusive_occupancy():
+    """A due barrier item (the full fleet pass) must wait for every
+    in-flight item to drain, then run ALONE: nothing dispatches while it
+    is due or running."""
+    q = WorkQueue()
+    q.mark_barrier("full")
+    q.add("n1")
+    q.add("n2")
+    a = q.get(timeout=0)
+    b = q.get(timeout=0)
+    assert {a, b} == {"n1", "n2"}
+    q.add("full")
+    q.add("n3")
+    # barrier due: the queued non-barrier item must NOT dispatch, and
+    # the barrier itself waits for the two in-flight items
+    assert q.get(timeout=0) is None
+    q.task_done(a)
+    assert q.get(timeout=0) is None  # one still in flight
+    q.task_done(b)
+    assert q.get(timeout=0) == "full"
+    # barrier running: exclusive occupancy
+    assert q.get(timeout=0) is None
+    q.task_done("full")
+    assert q.get(timeout=0) == "n3"
+    q.task_done("n3")
+
+
+def test_mixed_key_types_with_identical_due_times_dispatch():
+    """Regression: two due entries tying on a coarse monotonic clock
+    used to fall through tuple comparison into item comparison —
+    str vs tuple raised TypeError inside get() on EVERY worker forever
+    (nothing in flight, so the stall watchdog never tripped either)."""
+    q = WorkQueue()
+    q.add("clusterpolicy")
+    q.add(("node", "n1"))
+    q.add(("slice", "s1"))
+    # force the exact-tie shape regardless of clock granularity
+    with q._cond:
+        due = q._ready[0][0]
+        q._ready = [(due, item) for _, item in q._ready]
+    got = {q.get(timeout=0) for _ in range(3)}
+    assert got == {"clusterpolicy", ("node", "n1"), ("slice", "s1")}
+    for item in got:
+        q.task_done(item)
+
+
+def test_barrier_blocked_getter_wakes_on_task_done():
+    """A blocking get parked behind barrier discipline must wake when
+    task_done resolves the blockage (not only on a timer)."""
+    q = WorkQueue()
+    q.mark_barrier("full")
+    q.add("n1")
+    assert q.get(timeout=0) == "n1"
+    q.add("full")
+    got = []
+
+    def getter():
+        got.append(q.get(timeout=5.0))
+
+    t = threading.Thread(target=getter)
+    t.start()
+    time.sleep(0.05)
+    q.task_done("n1")
+    t.join(timeout=2.0)
+    assert got == ["full"]
+    q.task_done("full")
+
+
+# ---------------------------------------------------------------------------
 # RateLimiter
 # ---------------------------------------------------------------------------
 

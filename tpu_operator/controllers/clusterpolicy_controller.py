@@ -96,6 +96,15 @@ class ClusterPolicyReconciler:
         # passes" is meaningful on any box, "stale for N seconds" only
         # on an idle one
         self.passes_total = 0
+        # cumulative full-pass wall time (ms): the churn-storm bench's
+        # delta-vs-full A/B reads this next to delta.delta_ms_total
+        self.full_ms_total = 0.0
+        # event-scoped delta sub-reconciles (controllers/delta.py):
+        # targeted node/slice entry points the keyed workqueue drives
+        # between full passes; each full pass re-seeds its slice mirror
+        from tpu_operator.controllers.delta import DeltaReconciler
+
+        self.delta = DeltaReconciler(self)
         # Degraded-transition tracker: the flight recorder dumps once
         # per NEW errored-state picture, not once per 5 s requeue
         self._last_errored_states: frozenset = frozenset()
@@ -158,9 +167,11 @@ class ClusterPolicyReconciler:
         finally:
             self.ctrl.end_pass()
             self.passes_total += 1
+            pass_ms = (_time.perf_counter() - t0) * 1000.0
+            self.full_ms_total += pass_ms
             hist = getattr(self.metrics, "reconcile_pass_ms_hist", None)
             if hist is not None:
-                hist.observe((_time.perf_counter() - t0) * 1000.0)
+                hist.observe(pass_ms)
             if trace.TRACER.enabled:
                 self.last_trace_summary = trace.TRACER.mark_pass()
             self._update_snapshot_metrics()
@@ -505,6 +516,13 @@ class ClusterPolicyReconciler:
         if self.metrics and getattr(self.metrics, "slices_total", None):
             self.metrics.slices_total.set(summary.total)
             self.metrics.slices_ready.set(summary.ready)
+        # re-seed the delta path's slice mirror IMMEDIATELY (not at pass
+        # end): the aggregation just published its verdict labels, and
+        # every publish echoes back through the watch as a node event —
+        # the router's echo predicate can only drop those once the
+        # mirror agrees, so a late seed turns a 1000-node flip into a
+        # 1000-key no-op backlog on the delta workers
+        self.delta.note_full_pass(summary)
         return summary
 
     def _store_versions(self):

@@ -64,6 +64,10 @@ RENDER_MS_BUCKETS = (0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100)
 QUEUE_WAIT_MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 500)
 RTT_MS_BUCKETS = (0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000)
 ALLOC_MS_BUCKETS = (1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500)
+# a delta sub-reconcile touches ONE node's label step or ONE slice's
+# readiness aggregate: sub-ms to low-ms healthy, tens of ms only when a
+# status write conflicts — an order of magnitude under the full pass
+DELTA_MS_BUCKETS = (0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100)
 
 
 class OperatorMetrics:
@@ -82,7 +86,7 @@ class OperatorMetrics:
         ns = "tpu_operator"
         if HAVE_PROM:
             g = lambda name, doc, labels=(): Gauge(f"{ns}_{name}", doc, labels)  # noqa: E731
-            c = lambda name, doc: Counter(f"{ns}_{name}", doc)  # noqa: E731
+            c = lambda name, doc, labels=(): Counter(f"{ns}_{name}", doc, labels)  # noqa: E731
             h = lambda name, doc, buckets, labels=(): Histogram(  # noqa: E731
                 f"{ns}_{name}", doc, labels, buckets=buckets
             )
@@ -408,6 +412,23 @@ class OperatorMetrics:
             "Device-plugin allocation latency (GetPreferredAllocation -> "
             "Allocate -> ledger hold) in ms",
             ALLOC_MS_BUCKETS,
+        )
+        # event-scoped delta reconciliation (ISSUE 13): router trigger
+        # disposition + sub-reconcile cost. source = watch kind that
+        # fired (node/pod/clusterpolicy/daemonset); key_kind = routed
+        # target (node/slice/full/upgrade, or drop for predicate-killed
+        # no-op deliveries)
+        self.reconcile_triggers = c(
+            "reconcile_trigger_total",
+            "Watch-event reconcile triggers routed by the delta router, "
+            "by event source and target key kind",
+            ("source", "key_kind"),
+        )
+        self.delta_reconcile_ms_hist = h(
+            "delta_reconcile_duration_ms",
+            "One event-scoped delta sub-reconcile (node label step or "
+            "slice readiness aggregate) wall time in ms",
+            DELTA_MS_BUCKETS,
         )
         # the kube layer feeds the queue-wait and write-RTT histograms
         # through module hooks (the on_conflict_retry convention: kube/

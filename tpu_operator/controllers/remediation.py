@@ -241,6 +241,14 @@ class NodeRemediationController:
         self._logged = LogOnce()
         self._breaker_was_open = False
 
+    def forget_node(self, name: str) -> None:
+        """Event-speed ledger prune for a deleted node (the keyed delta
+        path routes node DELETEs here, controllers/delta.py): its
+        log-once suppressions die with it so a same-named rejoin starts
+        clean, without waiting for the next full pass's liveness
+        ``prune``."""
+        self._logged.discard_subject(name)
+
     # ------------------------------------------------------------------
     # observability
     # ------------------------------------------------------------------

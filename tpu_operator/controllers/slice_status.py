@@ -31,7 +31,7 @@ from __future__ import annotations
 import logging
 from dataclasses import dataclass, field
 from functools import lru_cache
-from typing import Dict, List, Optional, Set
+from typing import Dict, Iterable, List, Optional, Set
 
 from tpu_operator import consts
 from tpu_operator.kube.apply import ApplyConflictError
@@ -146,26 +146,61 @@ def _hosts_from_labels(raw: str, topology: str, acc: str, gen: str) -> int:
     return 0
 
 
+def validator_pod_ready(pod: Obj) -> bool:
+    """THE validator-pod readiness predicate — phase Running with every
+    container ready (initContainer chain passed — reference semantics:
+    validator Running == node validated). One implementation shared by
+    the fleet scan, the per-node delta scan and the event router's
+    transition detection, so the three sites cannot drift on what
+    counts as validated."""
+    status = pod.get("status", {}) or {}
+    if status.get("phase") != "Running":
+        return False
+    statuses = status.get("containerStatuses")
+    return statuses is None or all(
+        cs.get("ready", True) for cs in statuses
+    )
+
+
 def validator_ready_nodes(
     client: Client, namespace: str, app: str = VALIDATOR_APP
 ) -> Set[str]:
-    """Nodes whose operator-validator pod is Running (initContainer chain
-    passed — reference semantics: validator Running == node validated)."""
+    """Nodes whose operator-validator pod passes ``validator_pod_ready``."""
     ready: Set[str] = set()
     # selector pushed into the list: the informer's app-label index
     # answers this in O(validator pods) instead of scanning (and then
     # discarding most of) every namespace pod
     for pod in client.list("v1", "Pod", namespace, label_selector={"app": app}):
-        if pod.get("status", {}).get("phase") != "Running":
-            continue
-        statuses = pod.get("status", {}).get("containerStatuses")
-        if statuses is not None and not all(
-            cs.get("ready", True) for cs in statuses
-        ):
+        if not validator_pod_ready(pod):
             continue
         node = pod.get("spec", {}).get("nodeName")
         if node:
             ready.add(node)
+    return ready
+
+
+def validated_on_nodes(
+    client: Client,
+    namespace: str,
+    node_names: Iterable[str],
+    app: str = VALIDATOR_APP,
+) -> Set[str]:
+    """Per-node variant of ``validator_ready_nodes`` for the delta path
+    (controllers/delta.py): one indexed ``(app, spec.nodeName)`` pod
+    list per member, so a single slice's readiness costs O(members ×
+    pods-per-member) — never O(fleet validator pods)."""
+    ready: Set[str] = set()
+    for name in node_names:
+        for pod in client.list(
+            "v1",
+            "Pod",
+            namespace,
+            label_selector={"app": app},
+            field_selector={"spec.nodeName": name},
+        ):
+            if validator_pod_ready(pod):
+                ready.add(name)
+                break
     return ready
 
 

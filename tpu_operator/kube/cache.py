@@ -110,7 +110,15 @@ def default_index_spec(kind: str) -> Dict[str, Tuple[str, ...]]:
             "index_fields": ("spec.nodeName",),
         }
     if kind == "Node":
-        return {"index_label_prefixes": (consts.GROUP + "/",)}
+        # the GKE node-pool key joins the operator-label prefix: the
+        # keyed slice sub-reconcile (controllers/delta.py) resolves one
+        # slice's membership by selector — explicit tpu.k8s.io/ slice-id
+        # label (prefix-covered) or the node-pool fallback — in
+        # O(members) instead of scanning the fleet per event
+        return {
+            "index_label_keys": (consts.GKE_NODEPOOL_LABEL,),
+            "index_label_prefixes": (consts.GROUP + "/",),
+        }
     return {}
 
 
@@ -828,23 +836,37 @@ class CachedClient(Client):
                 return items, None
         return self.live.list(api_version, kind, namespace), None
 
-    def resync_once(self, stop_event: Optional[threading.Event] = None) -> int:
+    def resync_once(
+        self,
+        stop_event: Optional[threading.Event] = None,
+        ignore_stop: bool = False,
+    ) -> int:
         """One repair pass over every synced informer: fresh LIST, diff,
         repair, and re-dispatch repair events through the hooks so the
         workqueue reconciles anything a swallowed watch event hid.
         Returns the number of repairs applied. Concurrent calls coalesce
-        (the second returns 0 immediately)."""
+        (the second returns 0 immediately).
+
+        ``ignore_stop=True`` runs the repair even after ``stop()`` froze
+        the watch threads: the warm journal's FINAL save uses it so a
+        clean shutdown's snapshot reflects the live world, not whatever
+        watch backlog was un-ingested at freeze time (a busy stop could
+        otherwise journal a world a few events behind, and the restarted
+        operator's resume-rv replay would pay warm-start writes for
+        state that never actually changed)."""
         from tpu_operator.kube.client import NotFoundError as _NF
 
         if not self._resync_lock.acquire(blocking=False):
             return 0
         try:
-            return self._resync_once_locked(stop_event, _NF)
+            return self._resync_once_locked(stop_event, _NF, ignore_stop)
         finally:
             self._resync_lock.release()
 
-    def _resync_once_locked(self, stop_event, _NF) -> int:
+    def _resync_once_locked(self, stop_event, _NF, ignore_stop=False) -> int:
         def stopping() -> bool:
+            if ignore_stop:
+                return False
             return self._stop_event.is_set() or (
                 stop_event is not None and stop_event.is_set()
             )

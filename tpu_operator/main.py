@@ -20,6 +20,7 @@ import time
 import yaml
 
 from tpu_operator import consts
+from tpu_operator.controllers import delta as delta_mod
 from tpu_operator.controllers.clusterpolicy_controller import (
     ClusterPolicyReconciler,
     node_event_needs_reconcile,
@@ -192,6 +193,12 @@ def build_manager(
         save_running = threading.Lock()
 
         def _save_now():
+            # the journal may capture a world a few watch events behind
+            # live (a busy stop freezes ingestion mid-stream) — that is
+            # the design contract: the resume rv replays the gap on the
+            # next start. Harnesses that need a bit-coherent snapshot
+            # (the warm bench's zero-write claim) repair the frozen
+            # cache first via resync_once(ignore_stop=True).
             # every save path holds save_running: a background save
             # caught mid-export by shutdown must not os.replace() its
             # OLDER snapshot over the stop hook's fresh final save
@@ -222,7 +229,9 @@ def build_manager(
                 ).start()
             return res
 
-        mgr.add_reconciler(CP_KEY, _cp_reconcile)
+        mgr.add_reconciler(
+            CP_KEY, _cp_reconcile, resync_s=delta_mod.default_resync_s()
+        )
         mgr.add_stop_hook(_save_now)
         # explicit save for harnesses that quiesce the world after
         # mgr.stop() and want the journal to reflect the settled state
@@ -236,7 +245,29 @@ def build_manager(
             ),
         )
     else:
-        mgr.add_reconciler(CP_KEY, lambda _key: reconciler.reconcile())
+        mgr.add_reconciler(
+            CP_KEY,
+            lambda _key: reconciler.reconcile(),
+            resync_s=delta_mod.default_resync_s(),
+        )
+    # event-scoped delta reconciliation (controllers/delta.py): typed
+    # (kind, name) queue keys dispatch targeted sub-reconciles — a node
+    # event pays that node's label step, a pod event its slice's
+    # readiness aggregate — while the full pass above is demoted to the
+    # low-frequency resync safety net (RECONCILE_RESYNC_S). The queue
+    # serializes per key and barriers the full-pass keys, so M workers
+    # only ever overlap independent deltas.
+    delta = reconciler.delta
+    delta.wake_full = lambda delay=0.0: mgr.enqueue(CP_KEY, delay)
+    delta.enqueue_slice = lambda sid, delay=0.0: mgr.enqueue(
+        (delta_mod.SLICE_KIND, sid), delay
+    )
+    mgr.add_keyed_reconciler(delta_mod.NODE_KIND, delta.reconcile_node)
+    mgr.add_keyed_reconciler(delta_mod.SLICE_KIND, delta.reconcile_slice)
+    # wire_event_sources builds its router against this handle
+    mgr.delta = delta
+    # delta-vs-full pass counts + router trigger/drop disposition
+    mgr.register_debug_vars("delta_reconcile", delta.stats)
     # /debug/vars: the per-pass snapshot's hit/miss profile sits next to
     # cache_info so one curl answers "is the read path actually shared?"
     mgr.register_debug_vars(
@@ -290,93 +321,22 @@ def build_manager(
 def wire_event_sources(mgr, client, namespace: str, stop_event=None) -> None:
     """Watches feed the workqueue (reference watch wiring,
     controllers/clusterpolicy_controller.go:317-344). Shared by main()
-    and the kubesim manager e2e so the tested path IS the shipped path."""
-    node_cache = {}
-    # pods currently in CrashLoopBackOff (namespace/name): remediation's
-    # health derivation keys on this, and unlike chip death (a Node
-    # event) a crashloop is a POD event nothing else watches — the
-    # reconciler must wake on the transition, in either direction
-    crashlooping = set()
-    # nodes with an in-flight upgrade FSM label: while any exist, tpu-*
-    # pod events (operand restarts at the new revision, validator pods
-    # coming up) gate FSM steps and must wake the upgrade reconciler —
-    # waiting out its 120 s requeue per step would stretch a staged
-    # rollout's canary wave to hours. Empty set (the common case) keeps
-    # pod churn from burning upgrade passes at fleet-converge scale.
-    upgrading = set()
-    _upgrade_wake_states = (
-        consts.UPGRADE_STATE_UPGRADE_REQUIRED,
-    ) + tuple(consts.UPGRADE_ACTIVE_STATES)
+    and the kubesim manager e2e so the tested path IS the shipped path.
 
-    def on_event(event, obj):
-        from tpu_operator.controllers.remediation import pod_crashlooping
-
-        kind = obj.get("kind")
-        if kind == "ClusterPolicy":
-            mgr.enqueue(CP_KEY)
-            mgr.enqueue(UPGRADE_KEY)
-        elif kind == "Node":
-            name = obj["metadata"]["name"]
-            old = node_cache.get(name)
-            if event == "DELETED":
-                # drop the entry entirely: a tombstone-per-name under
-                # join/preemption storms of unique node names grew this
-                # cache without bound
-                node_cache.pop(name, None)
-                upgrading.discard(name)
-                # a node vanishing mid-upgrade must wake the upgrade
-                # reconciler too: its slice's budget hold releases on
-                # the next build_state, and waiting out the 120 s
-                # requeue starves pending sibling slices meanwhile
-                mgr.enqueue(UPGRADE_KEY)
-            else:
-                node_cache[name] = obj
-                ustate = (
-                    (obj.get("metadata", {}).get("labels") or {}).get(
-                        consts.UPGRADE_STATE_LABEL
-                    )
-                    or ""
-                )
-                old_ustate = (
-                    ((old or {}).get("metadata", {}).get("labels") or {}).get(
-                        consts.UPGRADE_STATE_LABEL
-                    )
-                    or ""
-                )
-                (
-                    upgrading.add
-                    if ustate in _upgrade_wake_states
-                    else upgrading.discard
-                )(name)
-                if ustate != old_ustate:
-                    # an FSM transition landed (ours or another
-                    # replica's): the next step is level-triggered off
-                    # the labels — run it now, not at the 120 s resync
-                    mgr.enqueue(UPGRADE_KEY, delay=0.1)
-            if node_event_needs_reconcile(event, old, obj):
-                mgr.enqueue(CP_KEY)
-        elif kind == "Pod":
-            meta = obj.get("metadata", {})
-            # same tpu-* operand filter the remediator's health verdict
-            # applies: a user pod's crashloop is not a node-health signal
-            # and must not burn reconcile passes
-            app = (meta.get("labels") or {}).get("app") or ""
-            if not app.startswith("tpu-"):
-                return
-            if upgrading:
-                # operand/validator pod movement advances FSM steps
-                # (pod-restart completion, validation) — coalesced by
-                # the workqueue, and only while an upgrade is in flight
-                mgr.enqueue(UPGRADE_KEY, delay=0.25)
-            key = f"{meta.get('namespace', '')}/{meta.get('name', '')}"
-            was = key in crashlooping
-            now = event != "DELETED" and pod_crashlooping(obj)
-            (crashlooping.add if now else crashlooping.discard)(key)
-            if was != now:
-                mgr.enqueue(CP_KEY, delay=0.1)
-        elif kind == "DaemonSet":
-            # owned-operand drift (reference watch on owned DaemonSets)
-            mgr.enqueue(CP_KEY, delay=0.1)
+    Routing lives in ``controllers/delta.EventRouter``: each event maps
+    to the minimal affected unit as a typed queue key (node label step,
+    slice readiness aggregate, or the full pass for anything that moves
+    cluster facts), with predicates dropping no-op deliveries before
+    they enqueue. ``TPU_DELTA_RECONCILE=0`` — or a manager built without
+    the delta handle — routes every relevant event to the full-pass
+    keys, the pre-delta behavior."""
+    router = delta_mod.EventRouter(
+        mgr, getattr(mgr, "delta", None), CP_KEY, UPGRADE_KEY
+    )
+    # harnesses (the churn-storm bench's delta-vs-full A/B) flip
+    # router.enabled at runtime through this handle
+    mgr.router = router
+    on_event = router.on_event
 
     # when the manager runs behind the informer cache, the workqueue is
     # fed from the SAME list+watch streams that keep the cache warm —

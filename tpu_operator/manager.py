@@ -55,16 +55,54 @@ class RateLimiter:
 
 class WorkQueue:
     """Deduplicating delayed workqueue (client-go semantics: an item queued
-    while pending coalesces into one execution)."""
+    while pending coalesces into one execution).
+
+    Multi-worker extensions (ISSUE 13):
+
+    * **processing set** — an item handed to a worker stays tracked until
+      ``task_done``; ``add`` on an in-flight item parks the re-add in a
+      dirty slot instead of queueing, so the SAME key is never dispatched
+      to two workers (per-key serialization at any worker count) and a
+      burst of same-key events landing mid-execution coalesces into
+      exactly ONE re-run after the current one completes;
+    * **barrier keys** (``mark_barrier``) — keys requiring EXCLUSIVE
+      queue occupancy (the fleet-wide full passes): a due barrier item
+      dispatches only once every in-flight item finished, and while one
+      is due or running nothing else dispatches. Keyed delta items
+      (node/slice sub-reconciles) overlap freely with each other.
+
+    Callers that never invoke ``task_done`` (direct test drivers) keep
+    the historical single-consumer behavior for distinct items; only
+    re-adds of an in-flight item need the completion signal.
+    """
 
     def __init__(self):
         self._cond = threading.Condition()
         self._ready = []  # (due_time, item)
         self._pending = set()
+        # items currently executing on a worker (client-go "processing")
+        self._processing = set()
+        # item -> due time for the post-completion re-add (client-go
+        # "dirty"): a re-add while processing coalesces here
+        self._dirty = {}
+        # keys with exclusive-occupancy semantics (full fleet passes)
+        self._barriers = set()
+
+    def mark_barrier(self, item) -> None:
+        """Give ``item`` full-pass barrier semantics: it runs alone."""
+        with self._cond:
+            self._barriers.add(item)
 
     def add(self, item, delay: float = 0.0) -> None:
         due = time.monotonic() + delay
         with self._cond:
+            if item in self._processing:
+                # re-add while a worker runs this key: coalesce into one
+                # re-execution after completion — never a concurrent one
+                prev = self._dirty.get(item)
+                if prev is None or due < prev:
+                    self._dirty[item] = due
+                return
             if item in self._pending:
                 # an Add supersedes a pending AddAfter with a later due time
                 # (client-go semantics): a watch event must not wait out a
@@ -72,11 +110,46 @@ class WorkQueue:
                 for i, (t, existing) in enumerate(self._ready):
                     if existing == item and due < t:
                         self._ready[i] = (due, item)
-                        self._cond.notify()
+                        self._cond.notify_all()
                 return
             self._pending.add(item)
             self._ready.append((due, item))
-            self._cond.notify()
+            self._cond.notify_all()
+
+    def task_done(self, item) -> None:
+        """Worker completion signal: releases the key for re-dispatch,
+        activating any re-add that coalesced while it ran."""
+        with self._cond:
+            self._processing.discard(item)
+            due = self._dirty.pop(item, None)
+            if due is not None and item not in self._pending:
+                self._pending.add(item)
+                self._ready.append((due, item))
+            self._cond.notify_all()
+
+    def _pick_locked(self, now: float):
+        """The dispatch decision under ``_cond``: returns a due entry
+        honoring barrier discipline, or None. A due barrier item blocks
+        newer non-barrier dispatches (no starvation) and waits for the
+        in-flight set to drain before running alone."""
+        if self._barriers and not self._barriers.isdisjoint(self._processing):
+            return None  # a full pass holds exclusive occupancy
+        due = [e for e in self._ready if e[0] <= now]
+        if not due:
+            return None
+        # key on the due time ONLY: entries tie on coarse clocks, and a
+        # bare tuple min would then compare the items — a str full-pass
+        # key against a tuple delta key raises TypeError, wedging every
+        # worker's get() forever while healthz stays green
+        due_barriers = [e for e in due if e[1] in self._barriers]
+        if due_barriers:
+            # drain-then-run: nothing new dispatches past a due barrier
+            return (
+                min(due_barriers, key=lambda e: e[0])
+                if not self._processing
+                else None
+            )
+        return min(due, key=lambda e: e[0])
 
     def get(self, timeout: Optional[float] = None):
         # `is not None`, NOT truthiness: get(timeout=0) is a non-blocking
@@ -86,14 +159,18 @@ class WorkQueue:
         with self._cond:
             while True:
                 now = time.monotonic()
-                due = [e for e in self._ready if e[0] <= now]
-                if due:
-                    entry = min(due)
+                entry = self._pick_locked(now)
+                if entry is not None:
                     self._ready.remove(entry)
                     self._pending.discard(entry[1])
+                    self._processing.add(entry[1])
                     return entry[1]
+                # blocked on barrier discipline (due work exists but may
+                # not dispatch): only task_done/add can change the
+                # picture, so wait for the notify, not a timer
+                blocked = any(e[0] <= now for e in self._ready)
                 wait = None
-                if self._ready:
+                if not blocked and self._ready:
                     wait = max(0.0, min(e[0] for e in self._ready) - now)
                 if deadline is not None:
                     remaining = deadline - now
@@ -101,6 +178,20 @@ class WorkQueue:
                         return None
                     wait = min(wait, remaining) if wait is not None else remaining
                 self._cond.wait(wait)
+
+    def due_len(self) -> int:
+        """Items dispatchable right now (future-dated resync/requeue
+        timers excluded) — the quiescence signal harnesses poll."""
+        with self._cond:
+            now = time.monotonic()
+            return sum(1 for e in self._ready if e[0] <= now)
+
+    def busy_len(self) -> int:
+        """Items handed to workers and not yet task_done — the
+        authoritative in-flight count (the manager's watchdog bracket
+        lags it by a few instructions)."""
+        with self._cond:
+            return len(self._processing)
 
     def __len__(self):
         with self._cond:
@@ -265,6 +356,18 @@ def _dump_stacks() -> str:
     return "\n".join(out) + "\n"
 
 
+def default_workers() -> int:
+    """Reconcile worker count (``RECONCILE_WORKERS``, default 4): M
+    workers consume the keyed workqueue so independent node/slice delta
+    sub-reconciles overlap, while per-key serialization and the
+    full-pass barrier keys keep every historical ordering guarantee.
+    1 restores the strict MaxConcurrentReconciles=1 behavior."""
+    try:
+        return max(1, int(os.environ.get("RECONCILE_WORKERS", "4")))
+    except ValueError:
+        return 4
+
+
 class Manager:
     """Runs reconcilers off a shared watch-fed workqueue."""
 
@@ -277,6 +380,7 @@ class Manager:
         leader_election: bool = False,
         debug_endpoints: bool = False,
         pass_deadline_s: Optional[float] = None,
+        workers: Optional[int] = None,
     ):
         self.client = client
         self.namespace = namespace
@@ -287,20 +391,35 @@ class Manager:
         self.queue = WorkQueue()
         self.rate_limiter = RateLimiter()
         self._reconcilers = {}
+        # tuple-key families: ("node", name) dispatches the registered
+        # "node" handler with the name — the delta sub-reconcile path
+        self._keyed_reconcilers = {}
+        # plain key -> low-frequency resync interval: the safety-net
+        # re-add applied after a completed pass that asked for no
+        # requeue, so the full pass still converges anything the delta
+        # router dropped
+        self._resync_s = {}
         self._stop = threading.Event()
         self._last_reconcile_ok = True
         self._threads = []
-        # stall watchdog: MaxConcurrentReconciles=1 means a single hung
-        # state check used to wedge ALL reconciling while probes stayed
-        # green forever; healthy() now flips once the in-flight pass
-        # outlives this deadline, so the kubelet restarts the pod
+        self.workers = workers if workers is not None else default_workers()
+        # stall watchdog: a single hung state check used to wedge ALL
+        # reconciling while probes stayed green forever; healthy() now
+        # flips once any in-flight pass outlives this deadline, so the
+        # kubelet restarts the pod
         self.pass_deadline_s = (
             pass_deadline_s
             if pass_deadline_s is not None
             else float(os.environ.get("RECONCILE_STALL_DEADLINE_S", "300"))
         )
+        # legacy single-slot in-flight bracket (tests wedge the watchdog
+        # by poking these directly); the worker pool tracks its own
+        # per-worker brackets in _inflight below
         self._inflight_item: Optional[str] = None
         self._inflight_since: Optional[float] = None
+        # worker index -> (item, since_monotonic) under _inflight_lock
+        self._inflight = {}
+        self._inflight_lock = threading.Lock()
         self._last_progress = time.monotonic()
         # extra /debug/vars payload fragments: name -> zero-arg callable
         # returning a JSON-serializable value (e.g. the reconciler's
@@ -316,9 +435,49 @@ class Manager:
         self._stall_dumps = 0
         self._metrics_httpd = None
 
-    def add_reconciler(self, key: str, fn: Callable[[str], object]) -> None:
-        """``fn(name) -> Result`` (with optional ``requeue_after``)."""
+    def add_reconciler(
+        self,
+        key: str,
+        fn: Callable[[str], object],
+        resync_s: Optional[float] = None,
+    ) -> None:
+        """``fn(name) -> Result`` (with optional ``requeue_after``).
+
+        Plain-key reconcilers are the fleet-wide full passes: they get
+        BARRIER semantics on the queue (exclusive occupancy — no delta
+        sub-reconcile overlaps a full pass, and the two full passes
+        never overlap each other, preserving the historical
+        MaxConcurrentReconciles=1 ordering between them). ``resync_s``
+        installs the low-frequency safety-net re-add applied whenever a
+        completed pass requested no requeue."""
         self._reconcilers[key] = fn
+        self.queue.mark_barrier(key)
+        if resync_s:
+            self._resync_s[key] = float(resync_s)
+
+    def add_keyed_reconciler(
+        self, kind: str, fn: Callable[[str], object]
+    ) -> None:
+        """Register the handler for ``(kind, name)`` queue keys — the
+        event-scoped delta sub-reconciles (``("node", name)``,
+        ``("slice", sid)``). Keyed items are NOT barriers: different
+        keys overlap across workers; the queue's processing set keeps
+        the same key strictly serial."""
+        self._keyed_reconcilers[kind] = fn
+
+    def _resolve(self, item):
+        """Dispatch: ``(fn, arg)`` for a queue item, or ``(None, None)``."""
+        if isinstance(item, tuple) and len(item) == 2:
+            fn = self._keyed_reconcilers.get(item[0])
+            return fn, item[1]
+        return self._reconcilers.get(item), item
+
+    @staticmethod
+    def format_key(item) -> str:
+        """Display form of a queue key (tuple keys as ``kind/name``)."""
+        if isinstance(item, tuple):
+            return "/".join(str(p) for p in item)
+        return str(item)
 
     def register_debug_vars(self, name: str, fn: Callable[[], object]) -> None:
         """Attach a provider to the /debug/vars payload."""
@@ -329,35 +488,59 @@ class Manager:
         cache shuts down (so hooks can still read it)."""
         self._stop_hooks.append(fn)
 
-    def enqueue(self, key: str, delay: float = 0.0) -> None:
+    def enqueue(self, key, delay: float = 0.0) -> None:
+        """Queue a reconcile key: a plain full-pass key or a typed
+        ``(kind, name)`` delta key."""
         self.queue.add(key, delay)
 
     def healthy(self) -> bool:
         return not self._stop.is_set() and not self.stalled()
 
-    def stalled(self) -> bool:
-        """True when the single worker's in-flight reconcile has
-        outlived the pass deadline — a wedged pass (hung socket, deadlock
-        in a state check) that would otherwise keep probes green while
-        nothing reconciles."""
+    def _oldest_inflight(self):
+        """``(item, since)`` of the longest-running in-flight reconcile
+        across the worker pool (plus the legacy single-slot bracket), or
+        ``None`` when every worker is idle."""
+        with self._inflight_lock:
+            entries = list(self._inflight.values())
         since = self._inflight_since
+        if since is not None:
+            entries.append((self._inflight_item, since))
+        if not entries:
+            return None
+        return min(entries, key=lambda e: e[1])
+
+    def stalled(self) -> bool:
+        """True when any worker's in-flight reconcile has outlived the
+        pass deadline — a wedged pass (hung socket, deadlock in a state
+        check) that would otherwise keep probes green while that worker
+        reconciles nothing."""
+        oldest = self._oldest_inflight()
         return (
-            since is not None
-            and time.monotonic() - since > self.pass_deadline_s
+            oldest is not None
+            and time.monotonic() - oldest[1] > self.pass_deadline_s
         )
 
     def watchdog_stats(self) -> dict:
         """Stall-watchdog disposition for /debug/vars."""
         now = time.monotonic()
-        since = self._inflight_since
+        oldest = self._oldest_inflight()
+        with self._inflight_lock:
+            inflight_count = len(self._inflight)
+        if self._inflight_since is not None:
+            inflight_count += 1
         return {
             "pass_deadline_s": self.pass_deadline_s,
-            "inflight": self._inflight_item,
-            "inflight_for_s": (
-                round(now - since, 3) if since is not None else None
+            "inflight": (
+                self.format_key(oldest[0]) if oldest is not None else None
             ),
+            "inflight_for_s": (
+                round(now - oldest[1], 3) if oldest is not None else None
+            ),
+            "inflight_count": inflight_count,
+            "workers": self.workers,
             "stalled": bool(
-                since is not None and now - since > self.pass_deadline_s
+                oldest is not None
+                and now - oldest[1] > self.pass_deadline_s
             ),
             "last_progress_age_s": round(now - self._last_progress, 3),
             "stall_dumps": self._stall_dumps,
@@ -439,15 +622,21 @@ class Manager:
                 if stalled and not tripped:
                     tripped = True
                     self._stall_dumps += 1
+                    oldest = self._oldest_inflight()
+                    wedged = (
+                        self.format_key(oldest[0])
+                        if oldest is not None
+                        else None
+                    )
                     flight.record(
                         "watchdog.stall",
-                        inflight=self._inflight_item,
+                        inflight=wedged,
                         deadline_s=self.pass_deadline_s,
                     )
                     flight.RECORDER.dump(
                         "watchdog-stall",
                         detail=(
-                            f"reconcile {self._inflight_item!r} in flight "
+                            f"reconcile {wedged!r} in flight "
                             f"> {self.pass_deadline_s}s"
                         ),
                         extra=self.watchdog_stats(),
@@ -512,9 +701,15 @@ class Manager:
             synced = self.client.start_informers(self._stop)
             if not synced:
                 log.warning("informer cache did not fully sync; reads degrade to live")
-        worker = threading.Thread(target=self._run_worker, daemon=True)
-        worker.start()
-        self._threads.append(worker)
+        for i in range(self.workers):
+            worker = threading.Thread(
+                target=self._run_worker,
+                args=(i,),
+                name=f"reconcile-worker-{i}",
+                daemon=True,
+            )
+            worker.start()
+            self._threads.append(worker)
 
     def install_signal_handlers(self) -> None:
         for sig in (signal.SIGTERM, signal.SIGINT):
@@ -554,43 +749,59 @@ class Manager:
             time.sleep(0.5)
 
     # ------------------------------------------------------------------
-    def _run_worker(self) -> None:
-        """MaxConcurrentReconciles=1 — one worker serializes everything
-        (reference ``controllers/clusterpolicy_controller.go:319``)."""
+    def _run_worker(self, widx: int = 0) -> None:
+        """One of M queue consumers (reference: MaxConcurrentReconciles,
+        ``controllers/clusterpolicy_controller.go:319`` runs 1; the keyed
+        delta queue runs ``self.workers``). Ordering safety lives in the
+        QUEUE, not here: the processing set keeps one key on one worker,
+        and full-pass barrier keys drain the pool before running alone —
+        so N workers only ever overlap INDEPENDENT node/slice deltas."""
         while not self._stop.is_set():
             item = None
+            got = False
             try:
                 item = self.queue.get(timeout=0.5)
                 if item is None:
                     continue
-                fn = self._reconcilers.get(item)
+                got = True
+                fn, arg = self._resolve(item)
                 if fn is None:
                     continue
                 # watchdog bracket: the probe thread reads these to tell
                 # a wedged pass from a busy one
-                self._inflight_item = item
-                self._inflight_since = time.monotonic()
+                with self._inflight_lock:
+                    self._inflight[widx] = (item, time.monotonic())
                 try:
-                    result = fn(item)
+                    result = fn(arg)
                     self.rate_limiter.forget(item)
                     requeue = getattr(result, "requeue_after", None)
                     if requeue:
                         self.queue.add(item, requeue)
+                    else:
+                        resync = self._resync_s.get(item)
+                        if resync:
+                            # converged full pass: park the safety-net
+                            # re-add — the low-frequency resync must
+                            # still converge anything the delta router
+                            # dropped (an event supersedes the timer)
+                            self.queue.add(item, resync)
                     self._last_reconcile_ok = True
                 except Exception:
-                    log.exception("reconcile %s failed", item)
+                    log.exception(
+                        "reconcile %s failed", self.format_key(item)
+                    )
                     self._last_reconcile_ok = False
                     self.queue.add(item, self.rate_limiter.when(item))
                 finally:
-                    self._inflight_since = None
-                    self._inflight_item = None
+                    with self._inflight_lock:
+                        self._inflight.pop(widx, None)
                     self._last_progress = time.monotonic()
             except Exception:
                 # a bug in the queue/limiter machinery must never silently
-                # kill the ONLY worker while probes keep reporting healthy
+                # kill a worker while probes keep reporting healthy
                 # (controller-runtime's panic would crash the whole process
                 # and restart the pod; a dead daemon thread here would just
-                # stop all reconciling forever)
+                # shrink the pool forever)
                 log.exception("worker loop error; continuing")
                 self._last_reconcile_ok = False
                 if item is not None:
@@ -600,5 +811,13 @@ class Manager:
                     try:
                         self.queue.add(item, 1.0)
                     except Exception:
-                        log.exception("failed to requeue %s", item)
+                        log.exception(
+                            "failed to requeue %s", self.format_key(item)
+                        )
                 self._stop.wait(1)
+            finally:
+                if got:
+                    try:
+                        self.queue.task_done(item)
+                    except Exception:
+                        log.exception("task_done bookkeeping failed")

@@ -71,6 +71,20 @@ class LogOnce:
             else:
                 self._seen.discard(key)
 
+    def discard_subject(self, subject: Hashable) -> int:
+        """Retire every key whose subject IS ``subject`` (the inverse of
+        ``prune``'s liveness sweep — event-speed cleanup when one
+        subject leaves the world, e.g. a deleted node's remediation
+        entries). Returns how many were dropped."""
+        with self._lock:
+            before = len(self._seen)
+            self._seen = {
+                k
+                for k in self._seen
+                if (k[0] if isinstance(k, tuple) and k else k) != subject
+            }
+            return before - len(self._seen)
+
     def prune(self, live: Iterable[Hashable]) -> int:
         """Retire keys whose subject is not in ``live``; returns how
         many were dropped. A tuple key's subject is ``key[0]`` (the

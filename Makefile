@@ -28,8 +28,8 @@ DOCKER_PUSH_TARGETS = $(patsubst %,docker-push-%,$(IMAGES))
 # variable expands to nothing and silently un-phonies the fan-out
 .PHONY: all native test crd bundle release-bundle validate lint clean \
 	dev-run dev-run-kubesim soak bench bench-gate bench-converge \
-	bench-churn bench-alloc obs-fast chaos-fast chaos-soak-fast \
-	chaos-soak \
+	bench-churn bench-shard bench-alloc obs-fast chaos-fast \
+	chaos-soak-fast chaos-soak \
 	builder docker-build \
 	docker-push $(DOCKER_BUILD_TARGETS) $(DOCKER_PUSH_TARGETS)
 
@@ -79,6 +79,7 @@ validate:
 	$(MAKE) bench-gate
 	$(MAKE) bench-converge
 	$(MAKE) bench-churn
+	$(MAKE) bench-shard
 	$(MAKE) bench-warm
 	$(MAKE) bench-alloc
 	$(MAKE) chaos-fast
@@ -128,6 +129,15 @@ bench-warm:
 bench-churn:
 	python -m pytest tests/test_churn_bench.py -q -m slow -p no:cacheprovider
 
+# CI sharded scale-out gate: 3 operator replica SUBPROCESSES over 6
+# per-shard leases against one kubesim (BENCH_SHARD_NODES, default
+# 2000) — replicated converge with per-shard event balance within 2x,
+# and a shard-0 leader kill that reaches zero-write steady state in
+# <= 15 s seeded from the shared warm journal (cold re-list asserted
+# unused)
+bench-shard:
+	python -m pytest tests/test_shard_bench.py -q -m slow -p no:cacheprovider
+
 # CI allocation gate: 1000-node scheduling churn through the real
 # device-plugin path, concurrent with convergence and a remediation
 # wave — min-of-rounds p99 allocate latency under a fixed ceiling,
@@ -163,7 +173,7 @@ chaos-fast:
 # with the invariant checker on, plus the seed-replay regression — the
 # same seed must reproduce the identical event schedule
 chaos-soak-fast:
-	TPU_LOCKWATCH=1 python -m pytest tests/test_chaos_soak.py tests/test_lifecycle.py tests/test_repartition.py -q -m 'not slow' -p no:cacheprovider
+	TPU_LOCKWATCH=1 python -m pytest tests/test_chaos_soak.py tests/test_lifecycle.py tests/test_repartition.py tests/test_shard_splitbrain.py -q -m 'not slow' -p no:cacheprovider
 
 # the 1000-node acceptance soak (slow; not part of validate)
 chaos-soak:

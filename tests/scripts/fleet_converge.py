@@ -101,6 +101,256 @@ def _seed_bulk_pods(client, count: int, namespaces: int) -> None:
         list(ex.map(mk, range(count)))
 
 
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _debug_vars(probe_port: int, timeout: float = 3.0):
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{probe_port}/debug/vars", timeout=timeout
+        ) as r:
+            return json.loads(r.read())
+    except Exception:
+        return None
+
+
+def _run_replicated(args) -> int:
+    """Sharded scale-out axis: N operator replicas as SUBPROCESSES over
+    one kubesim, consistent-hash sharded with per-shard leases. Prints
+    one JSON line (time_to_ready_s, per-shard event balance, failover
+    block) and exits 0 on a clean run."""
+    import signal
+    import subprocess
+    import tempfile
+
+    replicas = max(1, args.replicas)
+    shards = args.shards if args.shards > 0 else max(2, 2 * replicas)
+    max_shards = -(-shards // replicas)  # ceil: balanced greedy split
+    nodes = [f"fleet-{i}" for i in range(args.nodes)]
+    server = KubeSimServer(KubeSim(compact_keep=65536)).start()
+    client = make_client(server.port)
+    client.GET_RETRY_BACKOFF_S = 0.05
+    seed_cluster(client, NS, node_names=())
+    server.sim.add_nodes(len(nodes), names=nodes)
+    warm_path = os.path.join(
+        tempfile.mkdtemp(prefix="shard-warm-"), "warm.json"
+    )
+
+    script = os.path.join(os.path.dirname(__file__), "shard_replica.py")
+    procs = []
+    probes = []
+    t0 = time.monotonic()
+    for i in range(replicas):
+        probe = _free_port()
+        probes.append(probe)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    script,
+                    "--port",
+                    str(server.port),
+                    "--shards",
+                    str(shards),
+                    "--max-shards",
+                    str(max_shards),
+                    "--lease-s",
+                    "3",
+                    "--probe-port",
+                    str(probe),
+                    "--warm-state",
+                    warm_path,
+                    "--identity",
+                    f"replica-{i}",
+                ],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+        )
+
+    halt = threading.Event()
+
+    def kubelet():
+        idle_sleep = 0.05
+
+        def writes_now():
+            return sum(
+                server.sim.request_counts.get(v, 0)
+                for v in ("POST", "PUT", "APPLY")
+            )
+
+        while not halt.is_set():
+            before = writes_now()
+            t_sweep = time.monotonic()
+            try:
+                simulate_kubelet_nodes(client, NS, nodes, halt_event=halt)
+            except (ConflictError, NotFoundError, TransientAPIError, OSError):
+                pass
+            sweep_s = time.monotonic() - t_sweep
+            idle_sleep = (
+                0.05
+                if writes_now() > before
+                else min(max(idle_sleep * 2, 2.0 * sweep_s), 5.0)
+            )
+            halt.wait(idle_sleep)
+
+    kubelet_thread = threading.Thread(target=kubelet, daemon=True)
+    kubelet_thread.start()
+
+    def cp_ready():
+        cp = client.get_or_none(CPV, "ClusterPolicy", "cluster-policy") or {}
+        return cp.get("status", {}).get("state") == "ready"
+
+    ok = False
+    deadline = time.monotonic() + args.timeout
+    while time.monotonic() < deadline:
+        if cp_ready():
+            ok = True
+            break
+        time.sleep(0.2)
+    elapsed = time.monotonic() - t0
+
+    def shard_views():
+        out = {}
+        for i, probe in enumerate(probes):
+            if procs[i].poll() is not None:
+                continue
+            payload = _debug_vars(probe)
+            if payload and isinstance(payload.get("shards"), dict):
+                out[i] = payload["shards"]
+        return out
+
+    views = shard_views()
+    routed = {}
+    for view in views.values():
+        for shard, n in (view.get("events_routed") or {}).items():
+            routed[shard] = routed.get(shard, 0) + n
+    balance = None
+    if routed and min(routed.values()) > 0:
+        balance = round(max(routed.values()) / min(routed.values()), 2)
+    dropped = sum(v.get("events_dropped_total", 0) for v in views.values())
+    owners = {
+        i: v.get("owned", []) for i, v in views.items()
+    }
+    leader = next(
+        (i for i, v in views.items() if v.get("owns_full_pass")), None
+    )
+
+    # -- leader-kill failover axis --------------------------------------
+    failover = None
+    if args.kill_leader and (leader is None or replicas < 2):
+        # the axis was REQUESTED but cannot run (scrape never saw a
+        # shard-0 owner, or nothing to fail over to): that is a failed
+        # run, not a silently-skipped assertion
+        ok = False
+        failover = {
+            "error": "kill-leader requested but no leader identified"
+            if leader is None
+            else "kill-leader needs >= 2 replicas"
+        }
+    if ok and args.kill_leader and leader is not None and replicas > 1:
+        # let the leader publish a fresh post-READY journal first
+        time.sleep(3.0)
+        writes_before = server.sim.writes_total(exclude_plurals=("leases",))
+        procs[leader].send_signal(signal.SIGKILL)
+        procs[leader].wait()
+        t_kill = time.monotonic()
+        new_owner = None
+        deadline_f = time.monotonic() + args.timeout
+        while time.monotonic() < deadline_f:
+            views = shard_views()
+            new_owner = next(
+                (
+                    i
+                    for i, v in views.items()
+                    if i != leader and v.get("owns_full_pass")
+                ),
+                None,
+            )
+            if new_owner is not None:
+                break
+            time.sleep(0.2)
+        steady_s = None
+        if new_owner is not None:
+            # zero-write steady state: no write verbs over a 2 s window
+            # and the CR ready — the journal-seeded takeover complete.
+            # Lease renewals are the shard control plane's heartbeat
+            # (one PUT per owned shard per renew interval, forever) and
+            # are excluded: they are not convergence work
+            def writes_total():
+                return server.sim.writes_total(exclude_plurals=("leases",))
+
+            last = writes_total()
+            quiet_since = time.monotonic()
+            while time.monotonic() < deadline_f:
+                time.sleep(0.25)
+                now_w = writes_total()
+                if now_w != last:
+                    last = now_w
+                    quiet_since = time.monotonic()
+                    continue
+                if time.monotonic() - quiet_since >= 2.0 and cp_ready():
+                    steady_s = round(time.monotonic() - t_kill - 2.0, 2)
+                    break
+        view = shard_views().get(new_owner) if new_owner is not None else None
+        failover = {
+            "killed_leader": leader,
+            "new_owner": new_owner,
+            "time_to_steady_s": steady_s,
+            "failover_stats": (view or {}).get("failover"),
+            "writes_during_failover": server.sim.writes_total(
+                exclude_plurals=("leases",)
+            )
+            - writes_before,
+        }
+        ok = ok and steady_s is not None and steady_s <= 15.0
+        # the cold re-list path must be UNUSED: journal-seeded adoption
+        fo = (view or {}).get("failover") or {}
+        failover["journal_seeded"] = bool(fo.get("seeded_from_journal"))
+        failover["relists"] = fo.get("relists", 0)
+        ok = ok and failover["journal_seeded"] and not failover["relists"]
+
+    halt.set()
+    kubelet_thread.join(timeout=60)
+    for proc in procs:
+        if proc.poll() is None:
+            proc.terminate()
+    for proc in procs:
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    converge_requests = server.sim.requests_total()
+    server.stop()
+
+    out = {
+        "ok": ok,
+        "nodes": args.nodes,
+        "replicas": replicas,
+        "shards": shards,
+        "time_to_ready_s": round(elapsed, 2),
+        "converge_requests": converge_requests,
+        "shard_events_routed": dict(sorted(routed.items())),
+        "shard_balance": balance,
+        "shard_events_dropped": dropped,
+        "owners": owners,
+        "leader": leader,
+    }
+    if failover is not None:
+        out["failover"] = failover
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("fleet-converge")
     p.add_argument("--nodes", type=int, default=16)
@@ -172,6 +422,30 @@ def main(argv=None) -> int:
         "measured and reported either way",
     )
     p.add_argument(
+        "--replicas",
+        type=int,
+        default=0,
+        help="sharded scale-out (ISSUE 15): run the operator as N "
+        "replica SUBPROCESSES (tests/scripts/shard_replica.py) against "
+        "this kubesim, sharded over --shards consistent-hash shards "
+        "with per-shard leases; reports per-shard event balance and "
+        "(with --kill-leader) journal-seeded failover time",
+    )
+    p.add_argument(
+        "--shards",
+        type=int,
+        default=0,
+        help="shard count for --replicas (default: 2x replicas)",
+    )
+    p.add_argument(
+        "--kill-leader",
+        action="store_true",
+        help="(with --replicas) SIGKILL the shard-0 leader after "
+        "convergence and measure time back to an owned, zero-write "
+        "steady state (journal-seeded: the survivor must adopt from "
+        "the shared warm journal, not re-list the world)",
+    )
+    p.add_argument(
         "--warm-restart",
         action="store_true",
         help="after the steady-state measurement, restart the operator "
@@ -181,6 +455,9 @@ def main(argv=None) -> int:
         "zero-list",
     )
     args = p.parse_args(argv)
+
+    if args.replicas > 0:
+        return _run_replicated(args)
 
     # a list, not a tuple: the join storm grows it mid-run and the
     # kubelet sweep reads the latest membership each pass

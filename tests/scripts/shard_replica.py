@@ -1,0 +1,103 @@
+"""One sharded operator replica as a PROCESS — the cross-process half
+of the scale-out bench (ISSUE 15).
+
+``fleet_converge --replicas N`` spawns N of these against its kubesim
+apiserver port: each runs the full shipped wiring (build_manager +
+wire_event_sources, per-shard leases, scoped informers) in its own
+interpreter, so the replicas genuinely overlap on CPU instead of
+convoying on one GIL. The probe port serves /debug/vars (shards block,
+warm state, delta router disposition) for the parent to scrape."""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+sys.path.insert(0, REPO)
+
+NS = "tpu-operator"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("shard-replica")
+    p.add_argument("--port", type=int, required=True, help="kubesim port")
+    p.add_argument("--shards", type=int, required=True)
+    p.add_argument("--max-shards", type=int, default=0)
+    p.add_argument("--lease-s", type=int, default=3)
+    p.add_argument("--probe-port", type=int, default=0)
+    p.add_argument("--warm-state", default=None)
+    p.add_argument("--identity", default=None)
+    p.add_argument("--workers", type=int, default=0)
+    args = p.parse_args(argv)
+
+    os.environ.setdefault("OPERATOR_NAMESPACE", NS)
+    os.environ.setdefault("UNIT_TEST", "true")
+    # same-box rationale as fleet_converge: the apiserver is another
+    # local process, keep the fan-out modest
+    os.environ.setdefault("WRITE_PIPELINE_DEPTH", "4")
+    os.environ["TPU_SHARDS"] = str(args.shards)
+    if args.max_shards > 0:
+        os.environ["TPU_SHARD_MAX"] = str(args.max_shards)
+    os.environ["TPU_SHARD_LEASE_S"] = str(args.lease_s)
+    if args.identity:
+        os.environ.setdefault("POD_NAME", args.identity)
+    if args.workers > 0:
+        os.environ["RECONCILE_WORKERS"] = str(args.workers)
+    # aggressive journal cadence: the failover axis needs a fresh
+    # journal when the leader is killed mid-run
+    os.environ.setdefault("WARM_STATE_SAVE_INTERVAL_S", "2")
+
+    from tpu_operator.kube.kubesim import make_client
+    from tpu_operator.main import (
+        CP_KEY,
+        UPGRADE_KEY,
+        build_manager,
+        wire_event_sources,
+    )
+
+    client = make_client(args.port)
+    client.GET_RETRY_BACKOFF_S = 0.05
+    mgr, reconciler, _ = build_manager(
+        client,
+        NS,
+        metrics_port=0,
+        probe_port=args.probe_port,
+        debug_endpoints=bool(args.probe_port),
+        warm_state=args.warm_state,
+    )
+    stop = threading.Event()
+    wire_event_sources(mgr, client, NS, stop_event=stop)
+    mgr.start()
+    mgr.enqueue(CP_KEY)
+    mgr.enqueue(UPGRADE_KEY)
+
+    def _stop(*_):
+        stop.set()
+        mgr.stop()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    print(
+        json.dumps(
+            {
+                "replica": mgr.shard_state.identity
+                if mgr.shard_state
+                else None,
+                "probe_port": args.probe_port,
+            }
+        ),
+        flush=True,
+    )
+    while not mgr._stop.is_set():
+        time.sleep(0.2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

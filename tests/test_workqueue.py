@@ -230,6 +230,60 @@ def test_barrier_blocked_getter_wakes_on_task_done():
 
 
 # ---------------------------------------------------------------------------
+# shard-handoff drain primitives (ISSUE 15): remove_if / wait_idle and
+# their interplay with full-pass BARRIER keys
+# ---------------------------------------------------------------------------
+
+
+def test_remove_if_spares_barriers_and_coalesced_dirty_readds():
+    q = WorkQueue()
+    q.mark_barrier("clusterpolicy")
+    q.add("clusterpolicy")
+    q.add(("node", "a"))
+    q.add(("node", "b"), delay=5.0)  # future-dated requeue drains too
+    # a re-add coalesced behind an in-flight key lives in the dirty
+    # slot — the drain must clear it or the key resurrects post-handoff
+    q.add(("slice", "s1"))
+    inflight = q.get(timeout=0)
+    # barrier discipline: the due barrier item blocks other dispatches,
+    # so the first get may hand us the barrier itself
+    while inflight == "clusterpolicy":
+        q.task_done(inflight)
+        inflight = q.get(timeout=0)
+    assert inflight == ("node", "a") or inflight == ("slice", "s1")
+    q.add(inflight)  # coalesces into dirty while processing
+    removed = q.remove_if(lambda k: isinstance(k, tuple))
+    assert inflight in removed  # the dirty re-add was cleared
+    q.task_done(inflight)
+    # nothing keyed may dispatch anymore; the barrier still runs
+    assert q.wait_idle(lambda k: isinstance(k, tuple), timeout=1.0)
+    leftover = q.get(timeout=0)
+    assert leftover in (None, "clusterpolicy")
+    while leftover is not None:
+        assert not isinstance(leftover, tuple)
+        q.task_done(leftover)
+        leftover = q.get(timeout=0)
+
+
+def test_wait_idle_blocks_until_matching_inflight_completes():
+    q = WorkQueue()
+    q.add(("node", "x"))
+    item = q.get(timeout=0)
+    done = []
+
+    def finisher():
+        time.sleep(0.15)
+        q.task_done(item)
+        done.append(True)
+
+    threading.Thread(target=finisher, daemon=True).start()
+    t0 = time.monotonic()
+    assert q.wait_idle(lambda k: isinstance(k, tuple), timeout=2.0)
+    assert time.monotonic() - t0 >= 0.1
+    assert done
+
+
+# ---------------------------------------------------------------------------
 # RateLimiter
 # ---------------------------------------------------------------------------
 

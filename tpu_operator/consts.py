@@ -256,6 +256,12 @@ TFD_ICI_WRAP_LABEL = f"{TFD_LABEL_PREFIX}ici-wraparound"
 TFD_LIBTPU_VERSION_LABEL = f"{TFD_LABEL_PREFIX}libtpu-version"
 TFD_SLICE_ID_LABEL = f"{TFD_LABEL_PREFIX}slice-id"
 
+# sharded scale-out (tpu_operator/shard.py): the node's consistent-hash
+# shard, stamped by the owning replica's label pass — the server-side
+# selector a journal-stale failover uses to re-list ONE shard's nodes
+# instead of the world
+SHARD_LABEL = f"{GROUP}/shard"
+
 # slice-scoped aggregate readiness (no reference analogue — SURVEY.md §7
 # "readiness semantics on multi-host slices"): all hosts of a pod-slice
 # validated => every member node gets slice.ready=true

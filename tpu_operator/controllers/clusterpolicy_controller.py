@@ -105,6 +105,16 @@ class ClusterPolicyReconciler:
         from tpu_operator.controllers.delta import DeltaReconciler
 
         self.delta = DeltaReconciler(self)
+        # sharded scale-out (tpu_operator/shard.py): the replica's shard
+        # ownership view, or None (single-process default). Non-owners
+        # of shard 0 run the SCOPED pass (label + verdict work for
+        # owned shards only); the shard-0 owner runs the global pass
+        # with every budgeted section behind a live lease re-check.
+        self.shard_state = None
+        # True while a scoped pass body runs (the CP barrier key keeps
+        # passes serial): note_full_pass reads it to tell a scoped
+        # aggregate from a global one across a mid-pass takeover
+        self._scoped_pass_active = False
         # Degraded-transition tracker: the flight recorder dumps once
         # per NEW errored-state picture, not once per 5 s requeue
         self._last_errored_states: frozenset = frozenset()
@@ -177,6 +187,23 @@ class ClusterPolicyReconciler:
             self._update_snapshot_metrics()
 
     def _reconcile_pass(self, policies) -> Result:
+        ss = self.shard_state
+        if ss is not None:
+            if not ss.owns_full_pass():
+                return self._shard_scoped_pass(policies)
+            if not ss.confirm_full_pass_owner():
+                # split-brain guard: this replica BELIEVED it held
+                # shard 0 but a live lease read says otherwise (taken
+                # over mid-window). Running the budget arbiter now
+                # would double-drain against the new owner — degrade
+                # to scoped-worker work instead (confirm already
+                # demoted our ownership view).
+                log.warning(
+                    "shard-0 lease lost mid-window; fencing the "
+                    "budgeted full pass and degrading to scoped work"
+                )
+                flight.record("shard.fenced", identity=ss.identity)
+                return self._shard_scoped_pass(policies)
         primary, extras = select_primary(policies)
         for extra in extras:
             self._set_status(extra, State.IGNORED)
@@ -364,6 +391,43 @@ class ClusterPolicyReconciler:
         return Result(ready=True)
 
     # ------------------------------------------------------------------
+    def _shard_scoped_pass(self, policies) -> Result:
+        """The non-shard-0 replica's pass (sharded scale-out): label and
+        slice-verdict convergence for the shards THIS replica owns —
+        O(owned nodes), riding the scoped informer stores — while CR
+        render, operand deployment, the three budgeted FSMs and status
+        stay pinned to the shard-0 owner. Also seeds the delta
+        reconciler's context so keyed sub-reconciles run here at event
+        speed between passes."""
+        primary, _ = select_primary(policies)
+        ctrl = self.ctrl
+        # the SAME decode preamble as the owner's init (rollback
+        # override included): label decisions must agree across
+        # replicas, so the preamble is shared, not mirrored
+        ctrl.decode_primary(primary)
+        # marks this pass's aggregate as SCOPED for note_full_pass: a
+        # shard-0 takeover landing mid-pass must not let the partial
+        # mirror masquerade as global context
+        self._scoped_pass_active = True
+        try:
+            with trace.span("pass.shard_scope"):
+                ctrl.label_tpu_nodes()
+                ctrl.writes.drain()
+                self._aggregate_slices()
+        finally:
+            self._scoped_pass_active = False
+        self.metrics.observe_reconcile(1)
+        return Result(ready=True)
+
+    def _slice_owns_gate(self):
+        """The verdict-publish gate for ``slice_status.aggregate``:
+        ``covers_slice`` of the shard view, or None (publish all) for
+        the single-process operator."""
+        ss = self.shard_state
+        if ss is None:
+            return None
+        return ss.covers_slice
+
     def _run_remediation(self):
         """Node-health remediation pass (tentpole of the robustness
         story): derives per-node health from the pass's in-hand node
@@ -503,6 +567,7 @@ class ClusterPolicyReconciler:
                     tpu_nodes,
                     pipeline=self.ctrl.writes,
                     lane=self.ctrl.label_lane,
+                    owns=self._slice_owns_gate(),
                 )
             except Exception:
                 log.exception("slice readiness aggregation failed")

@@ -108,9 +108,11 @@ class DeltaReconciler:
         self.rec = reconciler
         self.client = reconciler.client
         # wired by build_manager: wake the full pass / enqueue a slice
-        # key (the delta path itself has no queue handle)
+        # key / schedule a coalesced status publish (the delta path
+        # itself has no queue handle)
         self.wake_full = None
         self.enqueue_slice = None
+        self.enqueue_status = None
         self._lock = threading.Lock()
         # one status.slices writer at a time: concurrent slice workers
         # would otherwise trade 409s on the CR for no information
@@ -118,7 +120,15 @@ class DeltaReconciler:
         # sid -> SliceInfo: mirror of the last authoritative aggregate,
         # per-slice entries replaced by slice sub-reconciles
         self._slices: Dict[str, object] = {}
+        # sid -> ready: verdicts INGESTED from other replicas' label
+        # writes (sharded mode, full-pass owner only) — status.slices
+        # stays event-fresh for shards this replica doesn't recompute;
+        # cleared whenever a full aggregation re-seeds the mirror
+        self._foreign: Dict[str, bool] = {}
         self._have_full = False
+        # sub-reconciles dispatched for keys this replica no longer
+        # owns (a handoff raced the queue): skipped, counted
+        self.shard_skips = 0
         # counters (under _lock: sub-reconciles run on N workers)
         self.node_passes = 0
         self.slice_passes = 0
@@ -132,12 +142,92 @@ class DeltaReconciler:
     # ------------------------------------------------------------------
     def note_full_pass(self, slice_summary) -> None:
         """Seed the slice mirror from a completed full aggregation —
-        the delta path refines per-slice entries from here on."""
+        the delta path refines per-slice entries from here on.
+
+        Sharded takeover race: a SCOPED pass that was already in flight
+        when this replica gained shard 0 would otherwise re-mark its
+        one-shard mirror as full context right after the takeover's
+        ``invalidate_context`` — and the new owner would publish a
+        shrunken global ``status.slices`` from it. A scoped summary may
+        only seed context while this replica is NOT the full-pass
+        owner."""
         if slice_summary is None:
+            return
+        sm = self._shard_state()
+        if (
+            getattr(self.rec, "_scoped_pass_active", False)
+            and sm is not None
+            and sm.owns_full_pass()
+        ):
             return
         with self._lock:
             self._slices = dict(slice_summary.slices)
+            self._foreign.clear()
             self._have_full = True
+
+    # ------------------------------------------------------------------
+    # sharded scale-out helpers
+    # ------------------------------------------------------------------
+    def _shard_state(self):
+        return getattr(self.rec, "shard_state", None)
+
+    def _owns(self, kind: str, key: str) -> bool:
+        """Dispatch-time ownership re-check: a key enqueued before a
+        handoff may dispatch after it — skipping is always safe (the
+        new owner re-derives from its own events/resync), running is
+        the overlap the handoff contract forbids."""
+        sm = self._shard_state()
+        if sm is None:
+            return True
+        owned = (
+            sm.owns_node_name(key)
+            if kind == NODE_KIND
+            else sm.owns_slice(key)
+        )
+        if not owned:
+            with self._lock:
+                self.shard_skips += 1
+        return owned
+
+    def invalidate_context(self) -> None:
+        """Drop the full-pass context (sharded takeover of shard 0): a
+        mirror seeded by a SCOPED pass holds a partial world, and
+        publishing global status from it would shrink ``status.slices``
+        to one shard's counts — every delta path escalates/holds until
+        the first GLOBAL aggregation re-seeds."""
+        with self._lock:
+            self._have_full = False
+            self._slices = {}
+            self._foreign.clear()
+
+    def ingest_foreign_verdict(self, sid: str, ready: bool) -> None:
+        """A non-owned slice's verdict label, written by its owning
+        replica and observed through the watch: fold it into
+        ``status.slices`` without recomputing the slice (O(1) — the
+        owner already did the O(members) work). Context-gated like
+        every other status path: before the first GLOBAL aggregation
+        the mirror is empty/partial and publishing from it would
+        overwrite a correct block with a shrunken one."""
+        if not self._context_ready():
+            return
+        with self._lock:
+            if self._foreign.get(sid) == ready:
+                return
+            self._foreign[sid] = ready
+        enq = self.enqueue_status
+        if enq is not None:
+            # this runs on the WATCH-DISPATCH hook thread: a blocking
+            # CR status write here would stall event ingestion for
+            # every kind behind one slow apiserver round-trip — hand
+            # the publish to the workqueue (same-key bursts coalesce)
+            enq()
+        else:
+            self._publish_status()
+
+    def publish_status_now(self):
+        """Keyed-queue entry point for the coalesced status publish."""
+        self._publish_status()
+        return None
 
     def _context_ready(self) -> bool:
         ctrl = self.rec.ctrl
@@ -185,6 +275,8 @@ class DeltaReconciler:
         on deletion — event-speed ledger pruning. Fleet context
         (remediation budget math, join-driven cluster facts) escalates
         to the full pass."""
+        if not self._owns(NODE_KIND, name):
+            return None
         if not self._context_ready():
             self._escalate(f"node/{name}: no full-pass context yet")
             return None
@@ -237,6 +329,9 @@ class DeltaReconciler:
         counted it as a member (the delete storm satellite — stale
         verdicts must not wait out the resync)."""
         self.rec.remediation.forget_node(name)
+        sm = self._shard_state()
+        if sm is not None:
+            sm.forget_node(name)
         with self._lock:
             sids = [
                 sid
@@ -258,6 +353,8 @@ class DeltaReconciler:
         slice's aggregate from live member reads, publish its verdict
         labels through the batched label lane, and fold the result into
         ``status.slices`` — O(slice members), never O(fleet)."""
+        if not self._owns(SLICE_KIND, sid):
+            return None
         if not self._context_ready():
             self._escalate(f"slice/{sid}: no full-pass context yet")
             return None
@@ -348,14 +445,25 @@ class DeltaReconciler:
         )
         from tpu_operator.kube.client import ConflictError
 
+        sm = self._shard_state()
+        if sm is not None and not sm.owns_full_pass():
+            # CR status belongs to the shard-0 owner (one writer for the
+            # global aggregate); this replica's verdict labels are its
+            # contribution — the owner ingests them from the watch
+            return
         with self._lock:
             infos = list(self._slices.values())
+            foreign = dict(self._foreign)
             block = {
                 "total": len(infos),
-                "ready": sum(1 for s in infos if s.ready),
+                "ready": sum(
+                    1 for s in infos if foreign.get(s.slice_id, s.ready)
+                ),
             }
             degraded = sorted(
-                s.slice_id for s in infos if not s.ready
+                s.slice_id
+                for s in infos
+                if not foreign.get(s.slice_id, s.ready)
             )
             if degraded:
                 block["degraded"] = degraded
@@ -443,6 +551,7 @@ class DeltaReconciler:
                 "delta_ms_total": round(self.delta_ms_total, 3),
                 "escalations": self.escalations,
                 "status_writes": self.status_writes,
+                "shard_skips": self.shard_skips,
                 "slices_tracked": len(self._slices),
                 "last": dict(self.last),
             }
@@ -470,6 +579,11 @@ class EventRouter:
         self.cp_key = cp_key
         self.upgrade_key = upgrade_key
         self.enabled = delta_enabled() and delta is not None
+        # sharded scale-out (tpu_operator/shard.py): when the manager
+        # carries a shard-ownership view, events for keys outside the
+        # replica's owned shards are dropped BEFORE they enqueue — the
+        # other replica that owns them sees the same watch stream
+        self.shard = getattr(mgr, "shard_state", None)
         if delta is not None:
             delta.router = self
         self._lock = threading.Lock()
@@ -512,8 +626,47 @@ class EventRouter:
             kind = "upgrade"
         else:
             kind = key[0]
+        if not self._shard_allows(key):
+            # outside this replica's owned shards: the owning replica's
+            # router enqueues it from the same watch stream
+            self._count(source, "shard_drop")
+            self.shard.note_event_dropped()
+            return
         self._count(source, kind)
         self.mgr.enqueue(key, delay)
+
+    def _shard_allows(self, key) -> bool:
+        """Shard routing discipline (single choke point):
+
+        * full-pass key — every replica (the non-owner dispatch runs
+          the SCOPED shard pass: its own shards' label/verdict work);
+        * upgrade key — shard-0 owner only (the FSM admits against the
+          global disruption budget);
+        * ``(node, name)`` / ``(slice, sid)`` — the owning replica only.
+        """
+        sm = self.shard
+        if sm is None:
+            return True
+        if key == self.cp_key:
+            return True
+        if key == self.upgrade_key:
+            return sm.owns_full_pass()
+        if isinstance(key, tuple) and len(key) == 2:
+            kind, name = key
+            if kind == NODE_KIND:
+                allowed = sm.owns_node_name(name)
+            elif kind == SLICE_KIND:
+                allowed = sm.owns_slice(name)
+            else:
+                return True
+            if allowed:
+                sm.note_event_routed(
+                    sm.shard_of_node_name(name)
+                    if kind == NODE_KIND
+                    else sm.shard_of_slice(name)
+                )
+            return allowed
+        return True
 
     def stats(self) -> Dict[str, object]:
         with self._lock:
@@ -610,11 +763,37 @@ class EventRouter:
                     self._fire("node", (SLICE_KIND, sid))
             elif node_event_needs_reconcile(event, old, obj):
                 self._fire("node", self.cp_key)
+            if self.shard is not None and (
+                not self.enabled or not self.shard.owns_node_name(name)
+            ):
+                # prune the name→shard mapping wherever no delta
+                # (node, name) prune will ever dispatch for it: on
+                # non-owners the router just dropped the key, and with
+                # the delta router disabled (TPU_DELTA_RECONCILE=0) the
+                # keyed path is off EVERYWHERE — without this,
+                # unique-name churn leaks one map entry per deleted
+                # node. The delta-enabled owner keeps its entry until
+                # its delta prune runs, so the dispatch-time ownership
+                # re-check stays exact.
+                self.shard.forget_node(name)
             return
         self._track_upgrade_state(name, old, obj)
+        if self.shard is not None:
+            # keep the name→shard map current (the slice identity needs
+            # the node's labels, which only this hook sees)
+            self.shard.shard_of_node_obj(obj)
         if not node_event_needs_reconcile(event, old, obj):
             self._count("node", "drop")
             return
+        if (
+            self.shard is not None
+            and self.shard.owns_full_pass()
+            and old is not None
+        ):
+            # another replica's verdict write on a shard we don't own:
+            # fold it into status.slices at O(1) instead of letting the
+            # shard filter silently stale the global aggregate
+            self._maybe_ingest_foreign_verdict(old, obj)
         if not self.enabled:
             self._fire("node", self.cp_key)
             return
@@ -728,6 +907,19 @@ class EventRouter:
         return sid is not None and (
             self.delta.expected_verdict(sid) == verdict
         )
+
+    def _maybe_ingest_foreign_verdict(self, old: dict, new: dict) -> None:
+        if self.delta is None:
+            return
+        sid = self._sid_of(new)
+        if sid is None or self.shard.owns_slice(sid):
+            return
+        verdict = _labels(new).get(consts.SLICE_READY_LABEL)
+        if verdict is None:
+            return
+        if _labels(old).get(consts.SLICE_READY_LABEL) == verdict:
+            return
+        self.delta.ingest_foreign_verdict(sid, verdict == "true")
 
     def _health_transition(self, old: dict, new: dict) -> bool:
         from tpu_operator.controllers.slice_status import host_allocatable_ok

@@ -253,6 +253,27 @@ class OperatorMetrics:
             "p99 device-plugin allocation latency (GetPreferredAllocation "
             "-> Allocate -> ledger hold) in milliseconds",
         )
+        # sharded scale-out (tpu_operator/shard.py): per-shard lease
+        # ownership from THIS replica's view, handoffs it lost, and
+        # watch events its router dropped as another replica's work —
+        # the balance/health surface the bench gate and the failover
+        # post-mortems read
+        self.shard_ownership = g(
+            "shard_ownership",
+            "1 while this replica holds the shard's lease "
+            "(tpu-operator-shard-<i>), 0 otherwise",
+            ("shard",),
+        )
+        self.shard_handoff_total = g(
+            "shard_handoff_total",
+            "Shard leases this replica lost (renewal lost, fenced, or "
+            "released at shutdown) — each one is a handoff to a peer",
+        )
+        self.shard_events_dropped_total = g(
+            "shard_events_dropped_total",
+            "Watch events dropped before enqueue because their key "
+            "belongs to a shard another replica owns",
+        )
         # informer health (client-go reflector resync analogue): nonzero
         # means a watch stream silently swallowed an event and the
         # periodic re-list repaired the cache

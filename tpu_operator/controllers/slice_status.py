@@ -266,11 +266,19 @@ def aggregate(
     validated: Optional[Set[str]] = None,
     pipeline=None,
     lane=None,
+    owns=None,
 ) -> SliceSummary:
     """Compute per-slice readiness and publish it to member node labels.
 
     ``validated`` overrides the validator-pod scan (used by tests and by
     callers that already listed pods this pass).
+
+    ``owns`` (sharded scale-out, ``tpu_operator/shard.py``): an optional
+    ``owns(slice_id) -> bool`` write gate — slices another replica owns
+    are still COMPUTED (the full-pass owner's status aggregate needs
+    them) but their verdict labels and degradation events are that
+    replica's to publish. ``None`` (the default single-process
+    operator) publishes everything.
 
     ``lane`` (a ``kube.write_pipeline.BatchLane`` over the label-apply
     flush — the reconciler's label lane) group-commits the per-node
@@ -330,6 +338,10 @@ def aggregate(
             and n not in info.repartitioning_hosts
         )
         verdict = "true" if info.ready else "false"
+        if owns is not None and not owns(info.slice_id):
+            # another replica's shard: computed for the aggregate,
+            # published by its owner
+            continue
         was_ready = any(
             (cached[n].get("metadata", {}).get("labels", {}) or {}).get(
                 consts.SLICE_READY_LABEL

@@ -296,6 +296,13 @@ class ClusterPolicyController:
         self.snapshot_hits_total = 0
         self.snapshot_misses_total = 0
         self.last_snapshot_stats: Dict[str, float] = {}
+        # sharded scale-out (tpu_operator/shard.py): the replica's shard
+        # ownership view, or None (default single-process operator).
+        # When set, the label fan-out writes ONLY nodes this replica
+        # covers (owned shards, plus orphaned shards for the shard-0
+        # owner) and every node gets its consistent-hash shard stamped
+        # as a label (the scoped-re-list selector).
+        self.shard_state = None
         # bounded-concurrency write pipeline (kube/write_pipeline.py):
         # the label fan-out and every control's apply ride it; per-key
         # ordering keeps same-object writes serialized while independent
@@ -433,21 +440,24 @@ class ClusterPolicyController:
     # ------------------------------------------------------------------
     # init (reference controllers/state_manager.go:743-887)
     # ------------------------------------------------------------------
-    def init(self, cp_obj: Obj) -> None:
-        self.cp_obj = cp_obj
-        # rollout rollback override (controllers/rollout.py): while the
-        # rollout ledger says rolled-back, the EFFECTIVE desired
-        # version/layout is the recorded previous value — applied to
-        # this pass's private CR copy BEFORE decoding/fingerprinting so
-        # rendering, the upgrade FSM's desired hashes and the
-        # re-partition roller all converge the fleet back. The raw
-        # user-authored targets are kept for the orchestrator.
+    def decode_primary(self, cp_obj: Obj) -> None:
+        """The CR-decode preamble shared by the full pass (``init``)
+        and the sharded scoped pass: the two MUST agree on the
+        effective desired state or scoped replicas' label decisions
+        diverge from the owner's.
+
+        Applies the rollout rollback override (controllers/rollout.py):
+        while the rollout ledger says rolled-back, the EFFECTIVE
+        desired version/layout is the recorded previous value — applied
+        to this pass's private CR copy BEFORE decoding/fingerprinting
+        so rendering, the upgrade FSM's desired hashes and the
+        re-partition roller all converge the fleet back. The raw
+        user-authored targets are kept for the orchestrator."""
         from tpu_operator.controllers.rollout import apply_override
 
+        self.cp_obj = cp_obj
         self.raw_roll_targets = apply_override(cp_obj)
         self.cp = clusterpolicy_from_obj(cp_obj)
-        self.idx = 0
-
         self.namespace = os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "")
         if not self.namespace:
             # reference exits the process so the pod CrashLoops by design
@@ -455,6 +465,10 @@ class ClusterPolicyController:
             raise RuntimeError(
                 f"{consts.OPERATOR_NAMESPACE_ENV} environment variable not set"
             )
+
+    def init(self, cp_obj: Obj) -> None:
+        self.decode_primary(cp_obj)
+        self.idx = 0
 
         self.k8s_version = self._get_kubernetes_version()
 
@@ -581,7 +595,26 @@ class ClusterPolicyController:
                 to_write.append((i, node, changes))
             else:
                 results[i] = node
-        wrote = bool(to_write)
+        if self.shard_state is not None and to_write:
+            # sharded write partition: another replica owns (and
+            # converges) the skipped nodes' labels; carrying the
+            # unmodified view forward keeps THIS pass's aggregation
+            # honest about the world it actually read
+            kept = []
+            for i, node, changes in to_write:
+                if self.shard_state.covers_node_obj(node):
+                    kept.append((i, node, changes))
+                else:
+                    results[i] = node
+            skipped_foreign = len(to_write) - len(kept)
+            to_write = kept
+        else:
+            skipped_foreign = 0
+        # a pass that SKIPPED foreign-owned deltas must not memoize as
+        # clean: if that owner dies, its lease expiring moves no store
+        # version, and a memoized skip would never hand those nodes to
+        # the shard-0 safety net
+        wrote = bool(to_write) or skipped_foreign > 0
         # phase 2 — the write fan-out rides the batched label lane: each
         # node's delta is ONE apply payload, and the lane group-commits
         # whatever queued while the previous batch was on the wire into
@@ -713,6 +746,12 @@ class ClusterPolicyController:
                 changes[f"{consts.GROUP}/tpu.generation"] = gen
             if labels.get(consts.TPU_PRESENT_LABEL) != "true":
                 changes[consts.TPU_PRESENT_LABEL] = "true"
+            if self.shard_state is not None:
+                # consistent-hash shard stamp: the server-side selector
+                # a journal-stale failover re-lists ONE shard with
+                want = str(self.shard_state.shard_of_node_obj(node))
+                if labels.get(consts.SHARD_LABEL) != want:
+                    changes[consts.SHARD_LABEL] = want
             changes.update(self._state_label_changes(node, labels))
         elif labels.get(consts.TPU_PRESENT_LABEL):
             # TPU removed from node: strip all operator labels

@@ -273,6 +273,11 @@ class Informer:
         # symmetric add guard). Pruned on a timer — entries only need to
         # outlive one resync pass.
         self._graveyard: Dict[Tuple[str, str], Tuple[Optional[int], float]] = {}
+        # graveyard keys whose tombstone came from the SCOPE predicate
+        # (the object exists, it just is not ours) rather than a real
+        # DELETE: a widened scope (shard takeover adopt) may revive
+        # these, never the real-delete class
+        self._scope_dropped: Set[Tuple[str, str]] = set()
         self._graveyard_next_prune = 0.0
 
     # -- store bookkeeping (caller holds ``_lock``) ----------------------
@@ -355,6 +360,7 @@ class Informer:
             if now - t > GRAVEYARD_TTL_S
         ]:
             del self._graveyard[k]
+            self._scope_dropped.discard(k)
 
     # -- event ingestion -------------------------------------------------
     def on_event(self, etype: str, obj: Obj) -> None:
@@ -362,6 +368,7 @@ class Informer:
         key = (meta.get("namespace", ""), meta.get("name", ""))
         if not key[1]:
             return
+        scope_drop = False
         if etype != "DELETED" and self.keep is not None and not self.keep(obj):
             # out of scope — and an in-scope object mutated OUT of scope
             # must leave the store, like a label-selector cache would drop
@@ -369,10 +376,16 @@ class Informer:
             # PRE-sync the fall-through must happen even on a store miss:
             # the DELETED path records the tombstone that stops replace()
             # from reseeding the snapshot's stale in-scope version.
+            # scope_drop marks the tombstone's CLASS: the object exists,
+            # it is just not ours — adopt() (shard takeover, when the
+            # scope widens) may revive it, where a real-delete tombstone
+            # must stay authoritative
+            scope_drop = True
+            etype = "DELETED"
             with self._lock:
                 if self.synced.is_set() and key not in self._store:
+                    self._scope_dropped.add(key)
                     return
-            etype = "DELETED"
         with self._lock:
             have = self._store.get(key)
             # monotonicity guard: a watch event older than what a
@@ -396,10 +409,17 @@ class Informer:
                     self._graveyard_next_prune = now + GRAVEYARD_PRUNE_EVERY_S
                     self._prune_graveyard_locked(now)
                 self._graveyard[key] = (_rv_int(obj), now)
+                # a REAL delete overrides any earlier scope-drop class
+                (
+                    self._scope_dropped.add
+                    if scope_drop
+                    else self._scope_dropped.discard
+                )(key)
                 if not self.synced.is_set():
                     self._tombstones[key] = _rv_int(obj) or 0
             elif etype in ("ADDED", "MODIFIED"):
                 self._set_locked(key, _slim(obj))
+                self._scope_dropped.discard(key)
 
     def replace(self, objs: List[Obj]) -> None:
         """Guarded seed from an initial list. Events may already have
@@ -501,6 +521,54 @@ class Informer:
             self.drift_repairs += len(repairs)
             self._sorted_keys_locked()
         return repairs
+
+    def adopt(self, obj: Obj) -> bool:
+        """Journal-seed ONE object into a RUNNING store via the normal
+        ingest path, honoring deletion tombstones (``resync``'s rule):
+        a journal snapshot older than a watch-delivered DELETE must not
+        resurrect the object — ``on_event('ADDED')`` alone would, since
+        only replace/resync consult the graveyard. Returns whether the
+        object was newly adopted."""
+        meta = obj.get("metadata", {})
+        key = (meta.get("namespace", ""), meta.get("name", ""))
+        with self._lock:
+            dead = self._graveyard.get(key)
+            # a SCOPE-class tombstone never blocks adoption: the keep
+            # predicate dropped the object because it wasn't ours, and
+            # the adopt is happening precisely because the scope just
+            # widened (shard takeover) — only a real watch-delivered
+            # DELETE is authoritative against a journal snapshot
+            if key in self._scope_dropped:
+                dead = None
+            before = key in self._store
+        if dead is not None and not before:
+            dead_rv, o_rv = dead[0], _rv_int(obj)
+            if dead_rv is None or o_rv is None or o_rv <= dead_rv:
+                return False  # deleted at/after the journal snapshot
+        self.on_event("ADDED", obj)
+        with self._lock:
+            return not before and key in self._store
+
+    def refilter(self) -> int:
+        """Re-apply the keep predicate over the whole store — for
+        DYNAMIC scope predicates (sharded scale-out: a lost shard's
+        nodes leave this replica's mirror at handoff instead of aging
+        out event-by-event). Keep runs OUTSIDE the store lock (the
+        shard predicate takes its own lock; no nested order edge)."""
+        if self.keep is None:
+            return 0
+        with self._lock:
+            items = list(self._store.items())
+        drop = [k for k, o in items if not self.keep(o)]
+        if not drop:
+            return 0
+        n = 0
+        with self._lock:
+            for k in drop:
+                if self._del_locked(k) is not None:
+                    n += 1
+            self._sorted_keys_locked()
+        return n
 
     # -- reads -----------------------------------------------------------
     def get(self, name: str, namespace: str = "", copy: bool = False) -> Obj:
@@ -644,11 +712,27 @@ class CachedClient(Client):
         namespace: str = "",
         specs: Optional[List[Tuple[str, str, str]]] = None,
         resync_interval_s: float = 300.0,
+        keep_overrides: Optional[Dict[str, Callable[[Obj], bool]]] = None,
+        world_scoped: Iterable[str] = ("Node",),
     ):
+        """``keep_overrides``: per-KIND scope predicates composed (AND)
+        with the defaults — the sharded operator scopes its Node and Pod
+        mirrors to owned shards this way (controller-runtime ByObject
+        selector, expressed dynamically).
+
+        ``world_scoped``: kinds whose keep-override-scoped store IS the
+        authoritative world view for this replica (reads never fall
+        through live on account of the filter). The sharded Node mirror
+        is the canonical case: a scoped replica's "fleet" is by design
+        its shards — falling through would re-LIST the whole cluster on
+        every pass, the exact cost sharding removes. Only consulted for
+        kinds carrying a keep override."""
         from tpu_operator import consts
 
         self.live = client
         self.namespace = namespace
+        self._world_scoped = frozenset(world_scoped or ())
+        self._keep_overridden = frozenset(keep_overrides or ())
         # client-go reflector resync analogue: every interval each synced
         # informer re-LISTs and repairs divergence (a dropped/misdelivered
         # watch event becomes a bounded-staleness incident with a metric,
@@ -657,16 +741,25 @@ class CachedClient(Client):
         self.resync_interval_s = resync_interval_s
         if specs is None:
             specs = default_cache_specs(consts.API_VERSION, namespace)
+        def _keep_for(kind: str, ns: str):
+            base = (
+                pod_scope_filter(namespace)
+                if kind == "Pod" and not ns and namespace
+                else None
+            )
+            extra = (keep_overrides or {}).get(kind)
+            if extra is None:
+                return base
+            if base is None:
+                return extra
+            return lambda obj, _b=base, _e=extra: _b(obj) and _e(obj)
+
         self._informers: Dict[Tuple[str, str], Informer] = {
             (av, kind): Informer(
                 av,
                 kind,
                 ns,
-                keep=(
-                    pod_scope_filter(namespace)
-                    if kind == "Pod" and not ns and namespace
-                    else None
-                ),
+                keep=_keep_for(kind, ns),
                 **default_index_spec(kind),
             )
             for av, kind, ns in specs
@@ -955,6 +1048,16 @@ class CachedClient(Client):
             return None  # caller wants all namespaces; we hold one
         return inf
 
+    def world_version(self) -> int:
+        """Sum of every synced informer's store mutation counter — a
+        cheap "did anything change since I last looked" key (the warm
+        journal's periodic saver skips exports of an unchanged world)."""
+        return sum(
+            inf.store_version
+            for inf in self._informers.values()
+            if inf.synced.is_set()
+        )
+
     def store_version(self, api_version: str, kind: str) -> Optional[int]:
         """The kind's informer store mutation counter, or ``None`` when
         the kind has no synced informer (a memo keyed on it must then
@@ -1012,6 +1115,65 @@ class CachedClient(Client):
             self._warm_seed[(av, kind)] = (str(payload.get("rv") or ""), known)
             seeded += 1
         return seeded
+
+    # -- sharded failover (tpu_operator/shard.py) ------------------------
+    def adopt_state(self, state: Dict[str, Dict]) -> int:
+        """Fold a warm-journal informer snapshot into ALREADY-RUNNING
+        stores — the journal-seeded shard handoff: a replica that just
+        took over shard 0 needs the whole world in its mirror without
+        re-LISTing it. Each object rides the normal ingest path
+        (``on_event``), so the per-object rv monotonicity guard keeps a
+        stale journal from rolling back anything a live watch already
+        delivered, and the scope predicates apply. Hooks are NOT
+        dispatched — the caller enqueues one full pass instead of
+        storming the queue with thousands of synthetic keys. Returns
+        how many objects were newly adopted."""
+        adopted = 0
+        for key, payload in (state or {}).items():
+            av, _, kind = key.partition("|")
+            inf = self._informers.get((av, kind))
+            if inf is None or not kind:
+                continue
+            for o in payload.get("objects") or []:
+                o.setdefault("apiVersion", av)
+                o.setdefault("kind", kind)
+                if inf.adopt(o):
+                    adopted += 1
+        return adopted
+
+    def adopt_live(
+        self, specs: List[Tuple[str, str, str, Optional[dict]]]
+    ) -> int:
+        """SCOPED live re-list adoption — the fallback when no (fresh)
+        journal exists at shard handoff: each ``(api_version, kind,
+        namespace, label_selector)`` is ONE server-side-filtered LIST
+        (e.g. Nodes of one shard via the ``tpu.k8s.io/shard`` label)
+        ingested through ``on_event``. Returns LISTs issued."""
+        lists = 0
+        for av, kind, ns, selector in specs:
+            inf = self._informers.get((av, kind))
+            if inf is None:
+                continue
+            try:
+                objs = self.live.list(av, kind, ns, label_selector=selector)
+                lists += 1
+            except Exception:
+                log.exception("scoped adoption list for %s failed", kind)
+                continue
+            for o in objs:
+                o.setdefault("apiVersion", av)
+                o.setdefault("kind", kind)
+                inf.adopt(o)
+        return lists
+
+    def refilter_informers(self, kinds: Iterable[str] = ("Node", "Pod")) -> int:
+        """Re-apply dynamic scope predicates after a shard handoff
+        (lost shard's objects leave the mirror now, not event-by-event)."""
+        dropped = 0
+        for (_, kind), inf in self._informers.items():
+            if kind in kinds:
+                dropped += inf.refilter()
+        return dropped
 
     def cache_info(self) -> Dict[str, Optional[int]]:
         """Per-kind store sizes for the debug surface; an UNSYNCED kind
@@ -1087,12 +1249,21 @@ class CachedClient(Client):
             return self.live.list(
                 api_version, kind, namespace, label_selector, field_selector
             )
-        if inf.keep is not None and namespace != self.namespace:
+        if (
+            inf.keep is not None
+            and namespace != self.namespace
+            and not (
+                kind in self._world_scoped
+                and kind in self._keep_overridden
+            )
+        ):
             # a scope-filtered informer cannot answer a general query it
             # might hold only partially (cluster-wide or foreign-ns Pod
             # lists would be silently truncated to TPU/operand pods);
             # callers whose own filter ⊆ the scope opt in via
-            # list_scoped, everyone else reads live and stays correct
+            # list_scoped, everyone else reads live and stays correct.
+            # EXCEPT world-scoped kinds (the sharded Node mirror): their
+            # truncation IS this replica's intended world view
             return self.live.list(
                 api_version, kind, namespace, label_selector, field_selector
             )

@@ -102,6 +102,9 @@ class KubeSim:
         # the informer-cache bench axis counts apiserver requests per
         # reconcile against these
         self.request_counts: Dict[str, int] = {}
+        # plural -> {verb: count}: per-kind request accounting (the shard
+        # bench separates lease-heartbeat writes from convergence writes)
+        self.request_counts_by_plural: Dict[str, Dict[str, int]] = {}
         # fault injection: plural -> number of watch event lines to
         # silently swallow (first consuming stream eats one) — models a
         # proxy hiccup / lost line that real informers must self-heal
@@ -431,10 +434,15 @@ class KubeSim:
         with self._lock:
             return sum(len(q) for q in self._faults.values())
 
-    def count_request(self, verb: str, is_watch: bool = False) -> None:
+    def count_request(
+        self, verb: str, is_watch: bool = False, plural: str = ""
+    ) -> None:
         key = "WATCH" if is_watch else verb
         with self._lock:
             self.request_counts[key] = self.request_counts.get(key, 0) + 1
+            if plural:
+                by = self.request_counts_by_plural.setdefault(plural, {})
+                by[key] = by.get(key, 0) + 1
 
     def requests_total(self, include_watch: bool = False) -> int:
         with self._lock:
@@ -443,6 +451,20 @@ class KubeSim:
                 for k, n in self.request_counts.items()
                 if include_watch or k != "WATCH"
             )
+
+    def writes_total(self, exclude_plurals: Tuple[str, ...] = ()) -> int:
+        """Mutating requests, optionally excluding plurals — the shard
+        bench's steady-state check excludes ``leases`` (lease renewals
+        are the sharded control plane's heartbeat, not convergence
+        work; counting them makes zero-write steady state unreachable
+        by construction)."""
+        verbs = ("POST", "PUT", "PATCH", "APPLY", "DELETE")
+        with self._lock:
+            total = sum(self.request_counts.get(v, 0) for v in verbs)
+            for plural in exclude_plurals:
+                by = self.request_counts_by_plural.get(plural, {})
+                total -= sum(by.get(v, 0) for v in verbs)
+            return total
 
     # -- helpers ---------------------------------------------------------
     def _bump(self) -> str:
@@ -939,9 +961,20 @@ class KubeSim:
                 return 404, _status(404, "NotFound", f"{plural} {name} not found")
             return 200, copy.deepcopy(stored)
 
-    def list(self, group, version, plural, namespace, label_sel="", field_sel=""):
+    def list(
+        self,
+        group,
+        version,
+        plural,
+        namespace,
+        label_sel="",
+        field_sel="",
+        limit=0,
+        cont="",
+    ):
         code, payload = self._list_refs(
-            group, version, plural, namespace, label_sel, field_sel
+            group, version, plural, namespace, label_sel, field_sel,
+            limit, cont,
         )
         if code != 200:
             return code, payload
@@ -950,7 +983,15 @@ class KubeSim:
         return 200, payload
 
     def list_json(
-        self, group, version, plural, namespace, label_sel="", field_sel=""
+        self,
+        group,
+        version,
+        plural,
+        namespace,
+        label_sel="",
+        field_sel="",
+        limit=0,
+        cont="",
     ) -> Tuple[int, bytes]:
         """LIST serialized straight from the store references — the HTTP
         handler's path. A fleet LIST (1000 Nodes, 9000 operand pods per
@@ -960,17 +1001,55 @@ class KubeSim:
         objects are only ever REPLACED on write, so the references are
         stable for the duration of the dump."""
         code, payload = self._list_refs(
-            group, version, plural, namespace, label_sel, field_sel
+            group, version, plural, namespace, label_sel, field_sel,
+            limit, cont,
         )
         return code, json.dumps(payload).encode()
 
-    def _list_refs(self, group, version, plural, namespace, label_sel, field_sel):
+    @staticmethod
+    def _continue_token(rv: int, after_key) -> str:
+        import base64
+
+        blob = json.dumps({"rv": rv, "after": list(after_key)})
+        return base64.urlsafe_b64encode(blob.encode()).decode()
+
+    @staticmethod
+    def _parse_continue(token: str):
+        """(pinned rv, after (ns, name)) or None for a bad token."""
+        import base64
+
+        try:
+            doc = json.loads(base64.urlsafe_b64decode(token.encode()))
+            return int(doc["rv"]), tuple(doc["after"])
+        except Exception:
+            return None
+
+    def _list_refs(
+        self,
+        group,
+        version,
+        plural,
+        namespace,
+        label_sel,
+        field_sel,
+        limit=0,
+        cont="",
+    ):
         """Shared LIST body; ``items`` holds STORE REFERENCES (callers
         must copy or serialize, never mutate). Serialization/copy happens
         outside the lock — safe because EVERY write path (create/update/
         patch/_mutate_stored/_delete_stored_locked) REPLACES stored objects
         copy-on-write instead of mutating them in place, so a reference
-        always denotes one immutable revision."""
+        always denotes one immutable revision.
+
+        ``limit``/``cont`` implement apiserver chunked LIST semantics
+        (required at 50k nodes, useful at 1k: one unbounded fleet LIST
+        serialized the whole store in one response): results are ordered
+        by (namespace, name), a truncated page carries an opaque
+        ``metadata.continue`` token naming the last key, and EVERY page
+        reports the resourceVersion pinned when the first page was cut —
+        so a watch resumed from it replays anything that landed while
+        the client paged."""
         kind, namespaced = PLURAL_TABLE[plural]
         if plural == "events":
             self.expire_events()
@@ -983,23 +1062,51 @@ class KubeSim:
                 parse_selector(label_sel)
             except ValueError as e:
                 return 400, _status(400, "BadRequest", str(e))
+        pinned_rv = None
+        after = None
+        if cont:
+            parsed = self._parse_continue(cont)
+            if parsed is None:
+                return 400, _status(
+                    400, "BadRequest", "malformed continue token"
+                )
+            pinned_rv, after = parsed
+        limit = max(0, int(limit or 0))
         with self._lock:
             items = []
-            for (g, v, p, ns, _), obj in self._objs.items():
+            for (g, v, p, ns, name), obj in self._objs.items():
                 if (g, v, p) != (group, version, plural):
                     continue
                 if namespaced and namespace and ns != namespace:
+                    continue
+                if after is not None and (ns, name) <= after:
                     continue
                 if label_sel and not _match_label_selector(obj, label_sel):
                     continue
                 if field_sel and not _match_field_selector(obj, field_sel):
                     continue
-                items.append(obj)
+                items.append(((ns, name), obj))
+            meta = {
+                "resourceVersion": str(
+                    pinned_rv if pinned_rv is not None else self._rv
+                )
+            }
+            if limit and len(items) > limit:
+                items.sort(key=lambda e: e[0])
+                page, rest = items[:limit], items[limit:]
+                meta["continue"] = self._continue_token(
+                    pinned_rv if pinned_rv is not None else self._rv,
+                    page[-1][0],
+                )
+                meta["remainingItemCount"] = len(rest)
+                items = page
+            elif after is not None or limit:
+                items.sort(key=lambda e: e[0])
             return 200, {
                 "apiVersion": f"{group}/{version}" if group else version,
                 "kind": f"{kind}List",
-                "metadata": {"resourceVersion": str(self._rv)},
-                "items": items,
+                "metadata": meta,
+                "items": [obj for _, obj in items],
             }
 
     # -- watch ------------------------------------------------------------
@@ -1223,22 +1330,26 @@ class _Handler(BaseHTTPRequestHandler):
         group, version, plural, namespace, name, _ = route
         qs = parse_qs(urlparse(self.path).query)
         if name:
-            self.sim.count_request("GET")
+            self.sim.count_request("GET", plural=plural)
             if self._maybe_fault("GET", plural):
                 return None
             code, obj = self.sim.get(group, version, plural, namespace, name)
             return self._json(code, obj)
         if qs.get("watch", ["false"])[0] == "true":
-            self.sim.count_request("GET", is_watch=True)
+            self.sim.count_request("GET", is_watch=True, plural=plural)
             if self._maybe_fault("WATCH", plural):
                 return None
             return self._watch(group, version, plural, namespace, qs)
-        self.sim.count_request("LIST")
+        self.sim.count_request("LIST", plural=plural)
         if self._maybe_fault("LIST", plural):
             return None
         # zero-copy serialization: the response is dumped straight from
         # store references (fleet LISTs used to deepcopy every object
         # just to discard the copies after serializing)
+        try:
+            limit = int(qs.get("limit", ["0"])[0])
+        except ValueError:
+            limit = 0
         code, data = self.sim.list_json(
             group,
             version,
@@ -1246,6 +1357,8 @@ class _Handler(BaseHTTPRequestHandler):
             namespace,
             label_sel=qs.get("labelSelector", [""])[0],
             field_sel=qs.get("fieldSelector", [""])[0],
+            limit=limit,
+            cont=qs.get("continue", [""])[0],
         )
         return self._json_bytes(code, data)
 
@@ -1283,8 +1396,8 @@ class _Handler(BaseHTTPRequestHandler):
         route = self._route()
         if route is None:
             return self._json(404, _status(404, "NotFound", self.path))
-        self.sim.count_request("POST")
         group, version, plural, namespace, name, sub = route
+        self.sim.count_request("POST", plural=plural)
         body = self._body()
         if self._maybe_fault("POST", plural):
             return None
@@ -1298,8 +1411,8 @@ class _Handler(BaseHTTPRequestHandler):
         route = self._route()
         if route is None:
             return self._json(404, _status(404, "NotFound", self.path))
-        self.sim.count_request("PUT")
         group, version, plural, namespace, name, sub = route
+        self.sim.count_request("PUT", plural=plural)
         # the body MUST be consumed before an injected error reply:
         # unread bytes would corrupt the next request on the keep-alive
         # connection
@@ -1322,7 +1435,7 @@ class _Handler(BaseHTTPRequestHandler):
             # server-side apply rides PATCH on the wire but is its own
             # verb for accounting AND fault injection: the chaos
             # matrices target APPLY directly
-            self.sim.count_request("APPLY")
+            self.sim.count_request("APPLY", plural=plural)
             body = self._body()  # consume before injected replies (framing)
             if self._maybe_fault("APPLY", plural):
                 return None
@@ -1355,7 +1468,7 @@ class _Handler(BaseHTTPRequestHandler):
                     update_only=update_only,
                 )
             return self._json(code, obj)
-        self.sim.count_request("PATCH")
+        self.sim.count_request("PATCH", plural=plural)
         body = self._body()  # consume before any injected reply (framing)
         if self._maybe_fault("PATCH", plural):
             return None
@@ -1379,8 +1492,8 @@ class _Handler(BaseHTTPRequestHandler):
         route = self._route()
         if route is None:
             return self._json(404, _status(404, "NotFound", self.path))
-        self.sim.count_request("DELETE")
         group, version, plural, namespace, name, _ = route
+        self.sim.count_request("DELETE", plural=plural)
         if self._maybe_fault("DELETE", plural):
             return None
         code, obj = self.sim.delete(group, version, plural, namespace, name)

@@ -444,6 +444,49 @@ class RestClient(Client):
                 )
             return json.loads(data) if data else {}
 
+    # -- chunked LIST (server-side limit/continue) ------------------------
+    @staticmethod
+    def list_page_size() -> int:
+        """LIST chunk size (``REST_LIST_PAGE_SIZE``, default 2000; 0
+        disables chunking). Real apiservers bound LIST responses this
+        way (client-go's pager defaults to 500); one unbounded 50k-node
+        LIST is a multi-second, hundreds-of-MB response the informer
+        initial sync should never depend on."""
+        try:
+            return max(0, int(os.environ.get("REST_LIST_PAGE_SIZE", "2000")))
+        except ValueError:
+            return 2000
+
+    def _paged_list(self, base_path: str, params: dict) -> dict:
+        """GET a collection in ``limit``/``continue`` chunks, merging
+        pages into one List document. The returned metadata carries the
+        FIRST page's resourceVersion — the apiserver pins the snapshot
+        rv across a continue chain, so a watch resumed from it replays
+        whatever landed while the client paged."""
+        page = self.list_page_size()
+        merged = None
+        cont = ""
+        while True:
+            p = dict(params)
+            if page > 0:
+                p["limit"] = str(page)
+            if cont:
+                p["continue"] = cont
+            path = base_path + ("?" + urlencode(p) if p else "")
+            result = self._request("GET", path)
+            if merged is None:
+                merged = result
+            else:
+                merged.setdefault("items", []).extend(
+                    result.get("items", [])
+                )
+            cont = (result.get("metadata") or {}).get("continue") or ""
+            if not cont or page <= 0:
+                break
+        if isinstance(merged.get("metadata"), dict):
+            merged["metadata"].pop("continue", None)
+        return merged
+
     # -- Client interface -------------------------------------------------
     def get(self, api_version, kind, name, namespace="", copy=False):
         # ``copy`` accepted for Client-interface parity; every REST read
@@ -478,9 +521,7 @@ class RestClient(Client):
             params["fieldSelector"] = ",".join(
                 f"{k}={v}" for k, v in field_selector.items()
             )
-        if params:
-            path += "?" + urlencode(params)
-        result = self._request("GET", path)
+        result = self._paged_list(path, params)
         items = result.get("items", [])
         # server-side selectors can't express globs; filter client-side
         from tpu_operator.kube.client import match_labels
@@ -504,8 +545,8 @@ class RestClient(Client):
         """Unfiltered list plus the List response's collection
         resourceVersion — the informer resync needs the snapshot rv to
         tell a deleted object from one created after the snapshot."""
-        result = self._request(
-            "GET", _resource_path(api_version, kind, namespace)
+        result = self._paged_list(
+            _resource_path(api_version, kind, namespace), {}
         )
         items = result.get("items", [])
         for item in items:
@@ -781,8 +822,11 @@ class RestClient(Client):
                     )
                     continue  # stream ended: re-list (cold path below)
                 try:
-                    listing = self._request(
-                        "GET", _resource_path(api_version, kind, namespace)
+                    # chunked like every other LIST: the informer
+                    # initial sync at 50k nodes must never hinge on one
+                    # unbounded response
+                    listing = self._paged_list(
+                        _resource_path(api_version, kind, namespace), {}
                     )
                     backoff.reset()
                     listed_once = True

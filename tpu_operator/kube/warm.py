@@ -151,10 +151,53 @@ class WarmJournal:
             return False
 
 
+def journal_shard_slice(informers, keep_node) -> Dict[str, Any]:
+    """Per-shard slice of a warm-journal informer snapshot (sharded
+    scale-out, ``tpu_operator/shard.py``): Node objects failing
+    ``keep_node(name, node)`` are dropped, Pods follow their
+    ``spec.nodeName``, every other kind passes through whole (they are
+    namespace-scoped operator state, not fleet-sharded). The per-kind
+    resume rv is preserved — a seeded watch still resumes from it, and
+    a stale rv 410s into the normal scoped re-list."""
+    out: Dict[str, Any] = {}
+    kept_nodes = set()
+    for key, payload in (informers or {}).items():
+        if key.partition("|")[2] != "Node":
+            continue
+        objs = [
+            o
+            for o in (payload.get("objects") or [])
+            if keep_node(o.get("metadata", {}).get("name", ""), o)
+        ]
+        kept_nodes.update(
+            o.get("metadata", {}).get("name", "") for o in objs
+        )
+        out[key] = dict(payload, objects=objs)
+    for key, payload in (informers or {}).items():
+        kind = key.partition("|")[2]
+        if kind == "Node":
+            continue
+        if kind == "Pod":
+            out[key] = dict(
+                payload,
+                objects=[
+                    o
+                    for o in (payload.get("objects") or [])
+                    if not o.get("spec", {}).get("nodeName")
+                    or o["spec"]["nodeName"] in kept_nodes
+                ],
+            )
+        else:
+            out[key] = payload
+    return out
+
+
 def export_state(client, reconciler, namespace: str = "") -> Dict[str, Any]:
     """Assemble the journal payload from a live operator: informer
     snapshots (when the client is cache-backed), the render cache, and
-    the apply-set membership."""
+    the apply-set membership. Sharded operators journal the WHOLE world
+    (only the shard-0 owner may save); per-shard slicing happens at
+    LOAD time via ``journal_shard_slice``."""
     payload: Dict[str, Any] = {"namespace": namespace}
     export = getattr(client, "export_state", None)
     if callable(export):

@@ -125,6 +125,25 @@ def build_manager(
     warms it before the first reconcile."""
     from tpu_operator.upgrade.upgrade_controller import UpgradeReconciler
 
+    # sharded horizontal scale-out (tpu_operator/shard.py, ISSUE 15):
+    # TPU_SHARDS > 1 runs this replica as one of N cooperating operators
+    # — per-shard Leases decide ownership, the event router drops
+    # foreign-shard keys, and full-pass work pins to the shard-0 holder.
+    # Built BEFORE the cache wrap so the Node/Pod informer mirrors can
+    # scope themselves to owned shards (lease reads are live either way:
+    # Lease is deliberately never cached).
+    from tpu_operator import shard as shard_mod
+
+    shards_n = shard_mod.shards_enabled()
+    shard_mgr = None
+    keep_overrides = None
+    if shards_n > 1:
+        shard_mgr = shard_mod.ShardLeaseManager(client, namespace, shards_n)
+        keep_overrides = {
+            "Node": shard_mgr.keep_node,
+            "Pod": shard_mgr.keep_pod,
+        }
+
     if informer_cache and not hasattr(client, "add_event_hook"):
         from tpu_operator.kube.cache import CachedClient
 
@@ -137,8 +156,20 @@ def build_manager(
             resync_interval_s=float(
                 os.environ.get("INFORMER_RESYNC_INTERVAL_S", "300")
             ),
+            keep_overrides=keep_overrides,
         )
 
+    if leader_election and shard_mgr is not None:
+        # the per-shard leases SUBSUME global leader election (the
+        # shard-0 lease IS the global-arbiter election): blocking a
+        # sharded replica on the legacy single lease would leave its
+        # owned shards renewed-but-never-reconciled — held hostage by a
+        # replica that never starts its workers
+        logging.getLogger("tpu-operator").warning(
+            "leader election disabled: TPU_SHARDS>1 elects per shard "
+            "(shard 0 is the global-arbiter lease)"
+        )
+        leader_election = False
     mgr = Manager(
         client,
         namespace,
@@ -188,6 +219,15 @@ def build_manager(
         def _export():
             return warm_mod.export_state(client, reconciler, namespace)
 
+        def _may_save() -> bool:
+            # sharded replicas share ONE journal path (the failover
+            # seed): only the shard-0 owner holds the whole world, so
+            # only it may write — a scoped worker's READY pass would
+            # otherwise clobber the full-world snapshot with its
+            # shard-scoped mirror, and the next failover would seed the
+            # budget arbiter from a world missing most of the fleet
+            return shard_mgr is None or shard_mgr.owns_full_pass()
+
         last_save = [0.0]
         save_every = warm_mod.save_interval_s()
         save_running = threading.Lock()
@@ -202,6 +242,8 @@ def build_manager(
             # every save path holds save_running: a background save
             # caught mid-export by shutdown must not os.replace() its
             # OLDER snapshot over the stop hook's fresh final save
+            if not _may_save():
+                return
             with save_running:
                 if warm_journal.save(_export()):
                     last_save[0] = time.monotonic()
@@ -213,6 +255,8 @@ def build_manager(
             # every queued key behind pure serialization. One saver at a
             # time; an overlapping tick skips (the next ready pass
             # retries).
+            if not _may_save():
+                return
             if not save_running.acquire(blocking=False):
                 return
             try:
@@ -221,17 +265,60 @@ def build_manager(
             finally:
                 save_running.release()
 
+        ready_seen = [False]
+
         def _cp_reconcile(_key):
             res = reconciler.reconcile()
-            if res.ready and time.monotonic() - last_save[0] >= save_every:
+            if res.ready:
+                ready_seen[0] = True
+            if (
+                res.ready
+                and _may_save()
+                and time.monotonic() - last_save[0] >= save_every
+            ):
                 threading.Thread(
                     target=_save_async, name="warm-save", daemon=True
                 ).start()
             return res
 
+        # periodic freshness loop: a converged fleet PARKS the CP key
+        # (no requeue until the resync), so pass-driven saves alone
+        # leave the journal frozen at the last active pass — at fleet
+        # scale that misses the convergence tail (the last verdict
+        # wave), and a failover seeded from it "corrects" the live
+        # world from stale state. This loop keeps the journal within
+        # one save interval of the informer world whenever the world
+        # actually moved (the store-version key skips no-op exports).
+        saver_stop = threading.Event()
+        last_world = [None]
+
+        def _periodic_saver():
+            while not saver_stop.wait(save_every):
+                if not ready_seen[0] or not _may_save():
+                    continue
+                wv_fn = getattr(client, "world_version", None)
+                wv = wv_fn() if callable(wv_fn) else None
+                if wv is not None and wv == last_world[0]:
+                    continue
+                # BLOCKING save on this thread, and the version key is
+                # recorded only after the save actually ran: a
+                # skip-on-contention here (an in-flight pass-driven
+                # save exporting the PRE-change world) would mark the
+                # changed world as journaled and never retry — the
+                # exact tail-staleness this loop exists to close
+                with save_running:
+                    if warm_journal.save(_export()):
+                        last_save[0] = time.monotonic()
+                        last_world[0] = wv
+
+        threading.Thread(
+            target=_periodic_saver, name="warm-save-loop", daemon=True
+        ).start()
+
         mgr.add_reconciler(
             CP_KEY, _cp_reconcile, resync_s=delta_mod.default_resync_s()
         )
+        mgr.add_stop_hook(saver_stop.set)
         mgr.add_stop_hook(_save_now)
         # explicit save for harnesses that quiesce the world after
         # mgr.stop() and want the journal to reflect the settled state
@@ -262,8 +349,15 @@ def build_manager(
     delta.enqueue_slice = lambda sid, delay=0.0: mgr.enqueue(
         (delta_mod.SLICE_KIND, sid), delay
     )
+    # coalesced status publish: foreign-verdict ingests (sharded mode)
+    # observe on the watch-dispatch thread and must not write the CR
+    # inline there — the queue coalesces a burst into one publish
+    delta.enqueue_status = lambda: mgr.enqueue(("status", "slices"), 0.2)
     mgr.add_keyed_reconciler(delta_mod.NODE_KIND, delta.reconcile_node)
     mgr.add_keyed_reconciler(delta_mod.SLICE_KIND, delta.reconcile_slice)
+    mgr.add_keyed_reconciler(
+        "status", lambda _name: delta.publish_status_now()
+    )
     # wire_event_sources builds its router against this handle
     mgr.delta = delta
     # delta-vs-full pass counts + router trigger/drop disposition
@@ -314,7 +408,149 @@ def build_manager(
     # stable /debug/vars schema
     mgr.register_debug_vars("allocation", lambda: {"active": False})
     upgrade = UpgradeReconciler(client, namespace)
-    mgr.add_reconciler(UPGRADE_KEY, lambda _key: upgrade.reconcile())
+    if shard_mgr is None:
+        # sharding disabled: the stable-schema placeholder
+        mgr.register_debug_vars("shards", lambda: {"enabled": False})
+        mgr.add_reconciler(UPGRADE_KEY, lambda _key: upgrade.reconcile())
+        return mgr, reconciler, upgrade
+
+    # -- sharded scale-out wiring (TPU_SHARDS > 1) ----------------------
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        Result as _Result,
+    )
+
+    mgr.shard_lease_manager = shard_mgr  # started/stopped with the mgr
+    mgr.shard_state = shard_mgr  # the router's drop filter
+    reconciler.shard_state = shard_mgr  # full-pass pinning + fencing
+    reconciler.ctrl.shard_state = shard_mgr  # label-write partition
+    shard_mgr.metrics = reconciler.metrics
+    mgr.register_debug_vars("shards", shard_mgr.stats)
+
+    def _upgrade_pass(_key):
+        # the upgrade FSM admits against the GLOBAL disruption budget:
+        # shard-0 owner only, re-confirmed live (split-brain guard)
+        if not shard_mgr.confirm_full_pass_owner():
+            return _Result()
+        return upgrade.reconcile()
+
+    mgr.add_reconciler(UPGRADE_KEY, _upgrade_pass)
+
+    def _key_in_shard(key, shard: int) -> bool:
+        if not (isinstance(key, tuple) and len(key) == 2):
+            return False
+        kind, name = key
+        if kind == delta_mod.NODE_KIND:
+            return shard_mgr.shard_of_node_name(name) == shard
+        if kind == delta_mod.SLICE_KIND:
+            return shard_mgr.shard_of_slice(name) == shard
+        return False
+
+    def _on_lose(shard):
+        # ownership already flipped (the router drops this shard's
+        # events now): drain pending keys + wait out in-flight ones so
+        # nothing of ours runs concurrently with the new owner, then
+        # shrink the mirror
+        mgr.drain_shard_keys(lambda key: _key_in_shard(key, shard))
+        if hasattr(client, "refilter_informers"):
+            client.refilter_informers()
+        shard_mgr.publish_metrics(reconciler.metrics)
+
+    def _load_journal():
+        if not warm_state:
+            return None
+        from tpu_operator.kube import warm as _warm
+
+        return _warm.WarmJournal(warm_state).load(namespace)
+
+    def _adopt_global_view():
+        """Failover seeding for a NEW shard-0 owner whose informers are
+        already running scoped: journal first (zero re-lists), scoped
+        per-shard label-selector re-lists as the fallback."""
+        stats = {"seeded_from_journal": False, "adopted": 0, "relists": 0}
+        payload = _load_journal()
+        if payload and payload.get("informers"):
+            stats["adopted"] = client.adopt_state(payload["informers"])
+            stats["seeded_from_journal"] = True
+        elif hasattr(client, "adopt_live"):
+            # no (fresh) journal: re-list ONLY the shards we don't
+            # already mirror, server-side filtered by the shard label —
+            # never the whole world
+            missing = [
+                i
+                for i in range(shard_mgr.shards)
+                if i not in shard_mgr.owned()
+            ]
+            specs = [
+                ("v1", "Node", "", {consts.SHARD_LABEL: str(i)})
+                for i in missing
+            ]
+            # cluster-wide, like the Pod informer itself: user TPU
+            # workload pods live in ANY namespace and the upgrade FSM's
+            # drain sweeps read them — a namespace-scoped adoption
+            # would let the budgeted pass see nodes as drained of jobs
+            # they still run. The informer keep predicate filters.
+            specs.append(("v1", "Pod", "", None))
+            stats["relists"] = client.adopt_live(specs)
+        shard_mgr.failover.update(stats)
+
+    def _adopt_shard_view(shard):
+        """Seeding for an ordinary shard gained mid-run (its owner
+        died): the scoped keep predicate was dropping this shard's
+        objects, so the mirror must adopt them — the journal's
+        per-shard slice when fresh, else ONE shard-label-scoped
+        re-list. Without this, a quietly-idle shard would see no
+        label/verdict convergence until the periodic resync."""
+        payload = _load_journal()
+        informers = (payload or {}).get("informers")
+        if informers:
+            from tpu_operator.kube.warm import journal_shard_slice
+
+            client.adopt_state(
+                journal_shard_slice(
+                    informers,
+                    lambda _name, node: (
+                        shard_mgr.shard_of_node_obj(node) == shard
+                    ),
+                )
+            )
+        elif hasattr(client, "adopt_live"):
+            client.adopt_live(
+                [
+                    ("v1", "Node", "", {consts.SHARD_LABEL: str(shard)}),
+                    # cluster-wide for the same reason as the global
+                    # adoption: TPU workload pods live anywhere
+                    ("v1", "Pod", "", None),
+                ]
+            )
+
+    def _on_gain(shard):
+        if getattr(client, "_started", False):
+            # a gain after the informers started is a TAKEOVER: the
+            # mirror must grow by the gained shard (or the whole world
+            # for the global-arbiter shard) before the next pass reads
+            # it
+            try:
+                if shard == shard_mod.FULL_PASS_SHARD:
+                    # the scoped pass's partial aggregate must not
+                    # masquerade as global context: hold delta status
+                    # publishing until the first GLOBAL full pass
+                    # re-seeds the mirror
+                    reconciler.delta.invalidate_context()
+                    _adopt_global_view()
+                else:
+                    _adopt_shard_view(shard)
+            except Exception:
+                logging.getLogger("tpu-operator").exception(
+                    "shard %d takeover adoption failed; the resync "
+                    "repairs the mirror",
+                    shard,
+                )
+        shard_mgr.publish_metrics(reconciler.metrics)
+        mgr.enqueue(CP_KEY)
+        mgr.enqueue(UPGRADE_KEY)
+
+    shard_mgr.on_gain.append(_on_gain)
+    shard_mgr.on_lose.append(_on_lose)
     return mgr, reconciler, upgrade
 
 
@@ -570,6 +806,11 @@ def main(argv=None) -> int:
 
     if args.once:
         try:
+            if mgr.shard_lease_manager is not None:
+                # --once never reaches Manager.start: one synchronous
+                # lease round so a sharded single-pass dev run actually
+                # owns its shards (and shard 0) before reconciling
+                mgr.shard_lease_manager.tick()
             if (args.fake or args.kubesim) and args.simulate_kubelet:
                 from tpu_operator.kube.testing import (
                     simulate_kubelet_nodes,

@@ -16,7 +16,7 @@ import threading
 import time
 from datetime import datetime, timezone
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Optional
+from typing import Callable, List, Optional
 
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.frozen import thaw
@@ -179,6 +179,37 @@ class WorkQueue:
                     wait = min(wait, remaining) if wait is not None else remaining
                 self._cond.wait(wait)
 
+    def remove_if(self, pred) -> List:
+        """Drop every PENDING item matching ``pred`` (shard handoff:
+        the lost shard's queued keys must not run here anymore — the
+        new owner's resync re-derives them). In-flight items are not
+        touched; ``wait_idle`` covers those. Returns the removed items."""
+        with self._cond:
+            removed = [e for e in self._ready if pred(e[1])]
+            for e in removed:
+                self._ready.remove(e)
+                self._pending.discard(e[1])
+            dirty = [i for i in self._dirty if pred(i)]
+            for item in dirty:
+                self._dirty.pop(item, None)
+            if removed or dirty:
+                self._cond.notify_all()
+            return [e[1] for e in removed] + dirty
+
+    def wait_idle(self, pred, timeout: float = 5.0) -> bool:
+        """Block until no IN-FLIGHT item matches ``pred`` (or timeout).
+        With ``remove_if`` this is the handoff drain barrier: once both
+        return, none of the shard's keys is pending or running on this
+        replica, so the new owner's executions cannot overlap ours."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while any(pred(i) for i in self._processing):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
     def due_len(self) -> int:
         """Items dispatchable right now (future-dated resync/requeue
         timers excluded) — the quiescence signal harnesses poll."""
@@ -268,16 +299,14 @@ class LeaderElector:
                 return True
             except Exception:
                 return False
-        spec = lease.get("spec", {})
-        holder = spec.get("holderIdentity")
-        renew = spec.get("renewTime", "")
-        expired = True
-        then = _parse_rfc3339(renew) if renew else None
-        if then is not None:
-            expired = (
-                datetime.now(timezone.utc) - then
-            ).total_seconds() > spec.get("leaseDurationSeconds", 30)
-        if holder == self.identity or expired or not holder:
+        # ONE expiry-semantics implementation for acquisition and the
+        # fencing read: a drift between the two re-opens the
+        # split-brain window the fencing read exists to close
+        holder = self._live_holder(lease)
+        if holder is None or holder == self.identity:
+            # the CAS below carries the read revision: when two
+            # candidates race an expired lease, the apiserver 409s the
+            # loser's update and exactly one acquisition wins
             # the lease may be a zero-copy informer view (frozen);
             # thaw before the read-modify-write or update() dies with
             # FrozenObjectError the first time the Lease kind is served
@@ -292,6 +321,59 @@ class LeaderElector:
             except Exception:
                 return False
         return False
+
+    def _read_lease_live(self):
+        """The lease object from a LIVE read — leader decisions must
+        never come from a cache (two replicas both serving a stale
+        lease view could both believe they hold it)."""
+        getter = getattr(self.client, "get_live", None)
+        if callable(getter):
+            from tpu_operator.kube.client import NotFoundError
+
+            try:
+                return getter(
+                    "coordination.k8s.io/v1",
+                    "Lease",
+                    self.name,
+                    self.namespace,
+                )
+            except NotFoundError:
+                return None
+        return self.client.get_or_none(
+            "coordination.k8s.io/v1", "Lease", self.name, self.namespace
+        )
+
+    @staticmethod
+    def _live_holder(lease) -> Optional[str]:
+        """The identity holding an UNEXPIRED lease, or None when the
+        lease is absent/unheld/expired/unparseable (acquirable). The
+        single expiry-semantics implementation — ``try_acquire`` and
+        the ``holds()`` fencing read must never drift apart."""
+        if lease is None:
+            return None
+        spec = lease.get("spec", {}) or {}
+        holder = spec.get("holderIdentity")
+        if not holder:
+            return None
+        then = _parse_rfc3339(spec.get("renewTime", "") or "")
+        if then is None:
+            return None
+        age = (datetime.now(timezone.utc) - then).total_seconds()
+        if age > spec.get("leaseDurationSeconds", 30):
+            return None
+        return holder
+
+    def current_holder(self) -> Optional[str]:
+        """The identity currently holding an UNEXPIRED lease from a
+        LIVE read, or None when acquirable."""
+        return self._live_holder(self._read_lease_live())
+
+    def holds(self) -> bool:
+        """LIVE check that THIS identity still holds the lease — the
+        fencing read sharded replicas make before budgeted work (a
+        renewal-loop miss can lag a takeover by most of a renew
+        interval; this closes that window at the decision point)."""
+        return self.current_holder() == self.identity
 
 
 class _HealthHandler(BaseHTTPRequestHandler):
@@ -434,6 +516,13 @@ class Manager:
         # the recorder once per stall EPISODE, not per poll)
         self._stall_dumps = 0
         self._metrics_httpd = None
+        # sharded scale-out (tpu_operator/shard.py): build_manager sets
+        # these when TPU_SHARDS > 1 — the per-shard lease loop starts
+        # with the manager and stops with it; shard_state is the
+        # ownership view the router/reconcilers consult. None = the
+        # default single-process operator.
+        self.shard_lease_manager = None
+        self.shard_state = None
 
     def add_reconciler(
         self,
@@ -596,8 +685,28 @@ class Manager:
                 payload[var_name] = {"error": str(e)}
         return payload
 
+    def drain_shard_keys(self, pred, timeout: float = 5.0) -> int:
+        """Shard-handoff drain: drop pending keys matching ``pred`` and
+        wait for matching in-flight keys to finish. Called from the
+        shard lease manager's loss callback AFTER ownership flipped (the
+        router is already dropping the shard's events), so when this
+        returns the lost shard has no work pending, queued or running on
+        this replica."""
+        removed = self.queue.remove_if(pred)
+        if not self.queue.wait_idle(pred, timeout):
+            log.warning(
+                "shard drain timed out with matching key(s) still in "
+                "flight; the ownership re-check at dispatch skips them"
+            )
+        return len(removed)
+
     # ------------------------------------------------------------------
     def start(self) -> None:
+        # per-shard leases first: a sharded replica must know which
+        # shards it owns BEFORE its informers list (the Node/Pod scope
+        # predicates read ownership) and before the first reconcile
+        if self.shard_lease_manager is not None:
+            self.shard_lease_manager.start()
         if self.metrics_port:
             try:
                 from prometheus_client import start_http_server
@@ -724,6 +833,17 @@ class Manager:
                     fn()
                 except Exception:
                     log.exception("stop hook failed")
+        # shard leases released AFTER the stop hooks: the warm
+        # journal's final save is ownership-gated (only the shard-0
+        # holder may write the shared journal), so releasing first
+        # would silently skip it. release=True clears the holder
+        # server-side — a planned restart hands shards to peers on
+        # their next tick instead of costing a full lease window.
+        if self.shard_lease_manager is not None:
+            try:
+                self.shard_lease_manager.stop(release=True)
+            except Exception:
+                log.exception("shard lease manager stop failed")
         # graceful cache shutdown: join informer + resync threads so no
         # loop LISTs a dead apiserver after the manager stops (the
         # reference's manager stops its cache before Start returns,
